@@ -1,0 +1,103 @@
+"""datafusion-tpu: a TPU-native SQL query engine.
+
+A from-scratch rebuild of the capabilities of DataFusion 0.5.1
+(reference: /root/reference, Rust) designed TPU-first:
+
+- SQL text -> AST -> logical plan -> physical plan -> execution, with the
+  same clean layer boundaries as the reference (`src/lib.rs:24-27`).
+- Expression trees compile to jitted XLA computations (one fused kernel
+  per operator pipeline) instead of per-expression interpreted closures
+  (reference `src/execution/expression.rs:29`).
+- Columnar batches are fixed-capacity, padded, validity-masked tensors so
+  every shape is static under `jax.jit`.
+- Distributed/partitioned execution maps onto a `jax.sharding.Mesh` with
+  XLA collectives (psum/pmax) rather than the reference's planned
+  etcd+HTTP+Arrow-IPC worker scheme (`scripts/smoketest.sh:30-66`).
+"""
+
+from datafusion_tpu.errors import (
+    DataFusionError,
+    ExecutionError,
+    InvalidColumnError,
+    IoError,
+    NotSupportedError,
+    ParserError,
+    PlanError,
+)
+from datafusion_tpu.datatypes import (
+    DataType,
+    Field,
+    Schema,
+    StructType,
+    can_coerce_from,
+    get_supertype,
+)
+from datafusion_tpu.plan.expr import (
+    AggregateFunction,
+    BinaryExpr,
+    Cast,
+    Column,
+    Expr,
+    FunctionMeta,
+    FunctionType,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Operator,
+    ScalarFunction,
+    ScalarValue,
+    SortExpr,
+)
+from datafusion_tpu.plan.logical import (
+    Aggregate,
+    EmptyRelation,
+    Limit,
+    LogicalPlan,
+    Projection,
+    Selection,
+    Sort,
+    TableScan,
+)
+from datafusion_tpu.exec.context import ExecutionContext
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataFusionError",
+    "ExecutionError",
+    "InvalidColumnError",
+    "IoError",
+    "NotSupportedError",
+    "ParserError",
+    "PlanError",
+    "DataType",
+    "Field",
+    "Schema",
+    "StructType",
+    "can_coerce_from",
+    "get_supertype",
+    "Expr",
+    "Column",
+    "Literal",
+    "BinaryExpr",
+    "IsNull",
+    "IsNotNull",
+    "Cast",
+    "SortExpr",
+    "ScalarFunction",
+    "AggregateFunction",
+    "ScalarValue",
+    "Operator",
+    "FunctionMeta",
+    "FunctionType",
+    "LogicalPlan",
+    "Projection",
+    "Selection",
+    "Aggregate",
+    "Sort",
+    "Limit",
+    "TableScan",
+    "EmptyRelation",
+    "ExecutionContext",
+    "__version__",
+]

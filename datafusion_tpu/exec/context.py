@@ -1,0 +1,5 @@
+"""ExecutionContext — placeholder, implemented with the columnar runtime."""
+
+
+class ExecutionContext:
+    pass
