@@ -274,7 +274,11 @@ class Field:
 
     @staticmethod
     def from_json(obj) -> "Field":
-        return Field(obj["name"], DataType.from_json(obj["data_type"]), obj["nullable"])
+        try:
+            name, dt, nullable = obj["name"], obj["data_type"], obj["nullable"]
+        except (TypeError, KeyError):
+            raise PlanError(f"Malformed Field wire object: {obj!r}")
+        return Field(name, DataType.from_json(dt), nullable)
 
 
 class Schema:

@@ -139,7 +139,8 @@ class ScalarValue:
         if self.data_type == DataType.BOOLEAN:
             return f"Boolean({'true' if v else 'false'})"
         if self.data_type == DataType.UTF8:
-            return f'Utf8("{v}")'
+            escaped = str(v).replace("\\", "\\\\").replace('"', '\\"')
+            return f'Utf8("{escaped}")'
         if self.data_type.is_float:
             # Rust Debug always shows a decimal point on floats
             s = repr(float(v))
@@ -155,6 +156,8 @@ class ScalarValue:
     def from_json(obj) -> "ScalarValue":
         if obj == "Null":
             return ScalarValue.null()
+        if not isinstance(obj, dict) or len(obj) != 1:
+            raise PlanError(f"Malformed ScalarValue wire object: {obj!r}")
         ((name, value),) = obj.items()
         return ScalarValue(DataType.from_json(name), value)
 
@@ -332,6 +335,9 @@ class BinaryExpr(Expr):
         rt = self.right.get_type(schema)
         st = get_supertype(lt, rt)
         if st is None:
+            # deliberate divergence: the reference falls back to Utf8 here
+            # (logicalplan.rs:188 `unwrap_or(DataType::Utf8) //TODO ???`);
+            # we fail loudly instead of mistyping the expression
             raise PlanError(
                 f"No common supertype for {lt!r} {self.op.name} {rt!r}"
             )

@@ -1,0 +1,306 @@
+"""Planner golden tests.
+
+The 12 tests from the reference (`src/sqlplanner.rs:522-772`) ported
+verbatim — same SQL, same expected plan pretty-print, same mock catalog
+(6-column `person` table + `sqrt` scalar function).  These encode the
+exact plan-shape semantics the engine must reproduce.
+"""
+
+import pytest
+
+from datafusion_tpu import DataType, Field, FunctionMeta, Schema
+from datafusion_tpu.errors import NotSupportedError, ParserError, PlanError
+from datafusion_tpu.plan.expr import FunctionType
+from datafusion_tpu.sql.optimizer import push_down_projection
+from datafusion_tpu.sql.parser import parse_sql
+from datafusion_tpu.sql.planner import SqlToRel
+
+
+class MockSchemaProvider:
+    # ported from sqlplanner.rs:742-770
+    def get_table_meta(self, name):
+        if name == "person":
+            return Schema(
+                [
+                    Field("id", DataType.UINT32, False),
+                    Field("first_name", DataType.UTF8, False),
+                    Field("last_name", DataType.UTF8, False),
+                    Field("age", DataType.INT32, False),
+                    Field("state", DataType.UTF8, False),
+                    Field("salary", DataType.FLOAT64, False),
+                ]
+            )
+        return None
+
+    def get_function_meta(self, name):
+        if name == "sqrt":
+            return FunctionMeta(
+                "sqrt",
+                [Field("n", DataType.FLOAT64, False)],
+                DataType.FLOAT64,
+                FunctionType.Scalar,
+            )
+        return None
+
+
+def quick_test(sql: str, expected: str):
+    planner = SqlToRel(MockSchemaProvider())
+    plan = planner.sql_to_rel(parse_sql(sql))
+    assert repr(plan) == expected
+
+
+def test_select_no_relation():
+    quick_test("SELECT 1", "Projection: Int64(1)\n  EmptyRelation")
+
+
+def test_select_scalar_func_with_literal_no_relation():
+    quick_test(
+        "SELECT sqrt(9)",
+        "Projection: sqrt(CAST(Int64(9) AS Float64))\n  EmptyRelation",
+    )
+
+
+def test_select_simple_selection():
+    quick_test(
+        "SELECT id, first_name, last_name FROM person WHERE state = 'CO'",
+        "Projection: #0, #1, #2\n"
+        '  Selection: #4 Eq Utf8("CO")\n'
+        "    TableScan: person projection=None",
+    )
+
+
+def test_select_compound_selection():
+    quick_test(
+        "SELECT id, first_name, last_name "
+        "FROM person WHERE state = 'CO' AND age >= 21 AND age <= 65",
+        "Projection: #0, #1, #2\n"
+        '  Selection: #4 Eq Utf8("CO") And CAST(#3 AS Int64) GtEq Int64(21)'
+        " And CAST(#3 AS Int64) LtEq Int64(65)\n"
+        "    TableScan: person projection=None",
+    )
+
+
+def test_select_all_boolean_operators():
+    quick_test(
+        "SELECT age, first_name, last_name "
+        "FROM person "
+        "WHERE age = 21 "
+        "AND age != 21 "
+        "AND age > 21 "
+        "AND age >= 21 "
+        "AND age < 65 "
+        "AND age <= 65",
+        "Projection: #3, #1, #2\n"
+        "  Selection: CAST(#3 AS Int64) Eq Int64(21)"
+        " And CAST(#3 AS Int64) NotEq Int64(21)"
+        " And CAST(#3 AS Int64) Gt Int64(21)"
+        " And CAST(#3 AS Int64) GtEq Int64(21)"
+        " And CAST(#3 AS Int64) Lt Int64(65)"
+        " And CAST(#3 AS Int64) LtEq Int64(65)\n"
+        "    TableScan: person projection=None",
+    )
+
+
+def test_select_simple_aggregate():
+    quick_test(
+        "SELECT MIN(age) FROM person",
+        "Aggregate: groupBy=[[]], aggr=[[MIN(#3)]]\n"
+        "  TableScan: person projection=None",
+    )
+
+
+def test_sum_aggregate():
+    quick_test(
+        "SELECT SUM(age) from person",
+        "Aggregate: groupBy=[[]], aggr=[[SUM(#3)]]\n"
+        "  TableScan: person projection=None",
+    )
+
+
+def test_select_simple_aggregate_with_groupby():
+    quick_test(
+        "SELECT state, MIN(age), MAX(age) FROM person GROUP BY state",
+        "Aggregate: groupBy=[[#4]], aggr=[[MIN(#3), MAX(#3)]]\n"
+        "  TableScan: person projection=None",
+    )
+
+
+def test_select_count_one():
+    quick_test(
+        "SELECT COUNT(1) FROM person",
+        "Aggregate: groupBy=[[]], aggr=[[COUNT(#0)]]\n"
+        "  TableScan: person projection=None",
+    )
+
+
+def test_select_scalar_func():
+    quick_test(
+        "SELECT sqrt(age) FROM person",
+        "Projection: sqrt(CAST(#3 AS Float64))\n"
+        "  TableScan: person projection=None",
+    )
+
+
+def test_select_order_by():
+    quick_test(
+        "SELECT id FROM person ORDER BY id",
+        "Sort: #0 ASC\n"
+        "  Projection: #0\n"
+        "    TableScan: person projection=None",
+    )
+
+
+def test_select_order_by_desc():
+    quick_test(
+        "SELECT id FROM person ORDER BY id DESC",
+        "Sort: #0 DESC\n"
+        "  Projection: #0\n"
+        "    TableScan: person projection=None",
+    )
+
+
+def test_select_order_limit():
+    quick_test(
+        "SELECT id FROM person ORDER BY id DESC LIMIT 10",
+        "Limit: 10\n"
+        "  Sort: #0 DESC\n"
+        "    Projection: #0\n"
+        "      TableScan: person projection=None",
+    )
+
+
+def test_select_limit():
+    quick_test(
+        "SELECT id FROM person LIMIT 10",
+        "Limit: 10\n"
+        "  Projection: #0\n"
+        "    TableScan: person projection=None",
+    )
+
+
+# -- beyond the ported 12: behaviors the rebuild completes --
+
+
+def test_select_wildcard():
+    # reference left SELECT * unimplemented (sqlplanner.rs:225-229)
+    quick_test(
+        "SELECT * FROM person",
+        "Projection: #0, #1, #2, #3, #4, #5\n"
+        "  TableScan: person projection=None",
+    )
+
+
+def test_aggregate_with_order_by_and_limit():
+    # reference TODO at sqlplanner.rs:111-117
+    quick_test(
+        "SELECT state, MIN(age) FROM person GROUP BY state ORDER BY state LIMIT 3",
+        "Limit: 3\n"
+        "  Sort: #0 ASC\n"
+        "    Aggregate: groupBy=[[#4]], aggr=[[MIN(#3)]]\n"
+        "      TableScan: person projection=None",
+    )
+
+
+def test_is_null_and_alias():
+    quick_test(
+        "SELECT age AS years FROM person WHERE state IS NOT NULL",
+        "Projection: #3\n"
+        "  Selection: #4 IS NOT NULL\n"
+        "    TableScan: person projection=None",
+    )
+    planner = SqlToRel(MockSchemaProvider())
+    plan = planner.sql_to_rel(parse_sql("SELECT age AS years FROM person"))
+    assert plan.schema.names() == ["years"]
+
+
+def test_having_not_implemented():
+    planner = SqlToRel(MockSchemaProvider())
+    with pytest.raises(NotSupportedError):
+        planner.sql_to_rel(parse_sql("SELECT age FROM person HAVING age > 1"))
+
+
+def test_unknown_table_and_function():
+    planner = SqlToRel(MockSchemaProvider())
+    with pytest.raises(PlanError, match="no schema found"):
+        planner.sql_to_rel(parse_sql("SELECT a FROM missing"))
+    with pytest.raises(PlanError, match="Invalid function"):
+        planner.sql_to_rel(parse_sql("SELECT nope(id) FROM person"))
+
+
+def test_limit_must_be_number():
+    planner = SqlToRel(MockSchemaProvider())
+    with pytest.raises(PlanError, match="LIMIT parameter is not a number"):
+        planner.sql_to_rel(parse_sql("SELECT id FROM person LIMIT id"))
+
+
+def test_parse_errors():
+    for bad in ["SELEC 1", "SELECT 'unterminated", "SELECT (1", "SELECT 1 FROM"]:
+        with pytest.raises(ParserError):
+            parse_sql(bad)
+
+
+def test_create_external_table():
+    from datafusion_tpu.sql import ast
+
+    stmt = parse_sql(
+        "CREATE EXTERNAL TABLE uk_cities (city VARCHAR(100) NOT NULL, "
+        "lat DOUBLE NOT NULL, lng DOUBLE NOT NULL) "
+        "STORED AS CSV WITHOUT HEADER ROW LOCATION 'test/data/uk_cities.csv'"
+    )
+    assert isinstance(stmt, ast.SqlCreateExternalTable)
+    assert stmt.name == "uk_cities"
+    assert [c.name for c in stmt.columns] == ["city", "lat", "lng"]
+    assert stmt.columns[0].data_type == ast.SqlType.Varchar
+    assert not stmt.columns[0].allow_null
+    assert stmt.file_type == ast.FileType.CSV
+    assert stmt.header_row is False
+    assert stmt.location == "test/data/uk_cities.csv"
+
+    stmt2 = parse_sql("CREATE EXTERNAL TABLE t STORED AS PARQUET LOCATION 'x.parquet'")
+    assert stmt2.columns == []
+    assert stmt2.file_type == ast.FileType.Parquet
+
+
+def test_push_down_projection():
+    planner = SqlToRel(MockSchemaProvider())
+    plan = planner.sql_to_rel(
+        parse_sql("SELECT id, first_name FROM person WHERE age > 21")
+    )
+    optimized = push_down_projection(plan)
+    # scan reads only columns {0,1,3}; references remapped to new positions
+    assert repr(optimized) == (
+        "Projection: #0, #1\n"
+        "  Selection: CAST(#2 AS Int64) Gt Int64(21)\n"
+        "    TableScan: person projection=Some([0, 1, 3])"
+    )
+    assert optimized.schema.names() == ["id", "first_name"]
+
+
+def test_push_down_projection_aggregate():
+    planner = SqlToRel(MockSchemaProvider())
+    plan = planner.sql_to_rel(
+        parse_sql("SELECT state, MIN(age) FROM person GROUP BY state")
+    )
+    optimized = push_down_projection(plan)
+    assert repr(optimized) == (
+        "Aggregate: groupBy=[[#1]], aggr=[[MIN(#0)]]\n"
+        "  TableScan: person projection=Some([3, 4])"
+    )
+
+
+def test_push_down_keeps_bare_scan_intact():
+    planner = SqlToRel(MockSchemaProvider())
+    plan = planner.sql_to_rel(parse_sql("SELECT * FROM person"))
+    optimized = push_down_projection(plan)
+    assert optimized.schema.names() == [
+        "id", "first_name", "last_name", "age", "state", "salary",
+    ]
+
+
+def test_statement_splitting():
+    from datafusion_tpu.sql.parser import split_statements
+
+    stmts = split_statements(
+        "-- comment\nSELECT 1;\nSELECT 'a;b';\n  \nSELECT 2"
+    )
+    assert stmts == ["SELECT 1", "SELECT 'a;b'", "SELECT 2"]
