@@ -15,6 +15,12 @@ A from-scratch rebuild of the capabilities of DataFusion 0.5.1
   etcd+HTTP+Arrow-IPC worker scheme (`scripts/smoketest.sh:30-66`).
 """
 
+# A SQL engine's Int64/Float64 semantics require real 64-bit lanes; JAX
+# truncates to 32-bit by default.  Must run before any jax.numpy usage.
+from jax import config as _jax_config
+
+_jax_config.update("jax_enable_x64", True)
+
 from datafusion_tpu.errors import (
     DataFusionError,
     ExecutionError,

@@ -1,0 +1,377 @@
+"""Grouped aggregation on device.
+
+The reference never implemented aggregation (`context.rs:161`
+`unimplemented!()`; even the Avg accumulator is missing from its enum,
+`expression.rs:99-105`).  TPU design:
+
+- **Filter fusion**: when the aggregate sits directly over a Selection
+  (the planner's shape, `sqlplanner.rs:90-117`), the predicate compiles
+  *into the aggregation kernel* — filter + 8-way aggregate is one XLA
+  computation per batch (TPC-H Q1's whole body).
+- **Group-key encoding (host)**: a persistent `GroupKeyEncoder` maps
+  each row's key tuple to a dense, append-only group id (vectorized
+  np.unique per batch + a dict over the per-batch uniques).  Dense ids
+  are stable across batches, so device accumulators grow by zero
+  padding — no rehashing, no remapping.
+- **Accumulation (device, jitted)**: one fused kernel evaluates every
+  aggregate argument and scatter-adds/mins/maxes into fixed-capacity
+  accumulators (`array.at[ids].add/min/max` = XLA scatter).  Masked-out
+  or null rows contribute identity elements — the kernel never syncs a
+  mask to the host.
+- **Finalization**: AVG = SUM/COUNT; grouped keys observed only in
+  filtered-out rows (count 0) are dropped.
+- **Distributed**: the accumulators are exactly the per-shard partial
+  state; partitioned mode combines them with psum/pmin/pmax over the
+  mesh (parallel/partition.py) — the partial->final aggregate the
+  reference's worker mode planned (`README.md:33-35`).
+
+Accumulator dtypes: integer SUM accumulates in 64-bit (overflow
+safety); COUNT is Int64 internally, UInt64 in the output (planner
+contract); MIN/MAX keep the argument dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.errors import ExecutionError, NotSupportedError
+from datafusion_tpu.exec.batch import (
+    RecordBatch,
+    StringDictionary,
+    bucket_capacity,
+    make_host_batch,
+)
+from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
+from datafusion_tpu.exec.relation import Relation
+from datafusion_tpu.plan.expr import AggregateFunction, Column, Expr
+from datafusion_tpu.utils.metrics import METRICS
+
+
+class GroupKeyEncoder:
+    """Host-side dense encoder of group-key tuples -> stable group ids."""
+
+    def __init__(self, num_keys: int):
+        self.num_keys = num_keys
+        self.key_to_id: dict[tuple, int] = {}
+        self.keys: list[tuple] = []
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.keys)
+
+    def encode(
+        self,
+        key_cols: list[np.ndarray],
+        key_valids: list,
+    ) -> np.ndarray:
+        """key_cols: per-key numpy arrays (dict codes for strings);
+        key_valids: per-key bool validity arrays or None.  Returns int32
+        group ids per row.  NULL keys form their own group (SQL
+        semantics): each key contributes (value-with-nulls-zeroed,
+        isnull flag) to the group tuple.
+        """
+        rows = []
+        for c, v in zip(key_cols, key_valids):
+            c = np.asarray(c)
+            if v is None:
+                rows.append(c.astype(np.int64))
+                rows.append(np.zeros(len(c), dtype=np.int64))
+            else:
+                v = np.asarray(v)
+                rows.append(np.where(v, c, 0).astype(np.int64))
+                rows.append((~v).astype(np.int64))
+        stacked = np.stack(rows)  # (2K, n)
+        uniq, inv = np.unique(stacked, axis=1, return_inverse=True)
+        lut = np.empty(uniq.shape[1], dtype=np.int32)
+        for j in range(uniq.shape[1]):
+            key = tuple(uniq[:, j].tolist())
+            gid = self.key_to_id.get(key)
+            if gid is None:
+                gid = len(self.keys)
+                self.key_to_id[key] = gid
+                self.keys.append(key)
+            lut[j] = gid
+        return lut[inv].astype(np.int32)
+
+    def key_column(self, k: int):
+        """(values, validity) of key position k across all groups, in
+        group-id order; validity None when no group has a NULL key."""
+        vals = np.asarray([key[2 * k] for key in self.keys])
+        isnull = np.asarray([bool(key[2 * k + 1]) for key in self.keys])
+        return vals, (None if not isnull.any() else ~isnull)
+
+
+class AggregateSpec:
+    """One aggregate function lowered to accumulator slots."""
+
+    def __init__(self, expr: AggregateFunction, input_schema: Schema):
+        self.name = expr.name.lower()
+        if self.name not in ("sum", "count", "min", "max", "avg"):
+            raise NotSupportedError(f"unknown aggregate {expr.name!r}")
+        if len(expr.args) != 1:
+            raise ExecutionError(f"{expr.name} takes one argument")
+        self.arg = expr.args[0]
+        self.return_type = expr.return_type
+        self.count_star = self.name == "count" and expr.count_star
+        self.arg_type = self.arg.get_type(input_schema)
+        if self.name != "count" and self.arg_type == DataType.UTF8:
+            raise NotSupportedError(f"{expr.name} over Utf8 is not supported yet")
+
+    @property
+    def acc_dtype(self) -> np.dtype:
+        npd = self.arg_type.np_dtype
+        if self.name in ("sum", "avg"):
+            if self.arg_type.is_signed_integer:
+                return np.dtype(np.int64)
+            if self.arg_type.is_unsigned_integer:
+                return np.dtype(np.uint64)
+            return npd
+        if self.name == "count":
+            return np.dtype(np.int64)
+        return npd  # min/max keep the arg dtype
+
+
+def _min_identity(dtype: np.dtype):
+    if dtype.kind == "f":
+        return np.asarray(np.inf, dtype)
+    if dtype.kind in "iu":
+        return np.asarray(np.iinfo(dtype).max, dtype)
+    if dtype.kind == "b":
+        return np.asarray(True, dtype)
+    raise ExecutionError(f"MIN unsupported for {dtype}")
+
+
+def _max_identity(dtype: np.dtype):
+    if dtype.kind == "f":
+        return np.asarray(-np.inf, dtype)
+    if dtype.kind in "iu":
+        return np.asarray(np.iinfo(dtype).min, dtype)
+    if dtype.kind == "b":
+        return np.asarray(False, dtype)
+    raise ExecutionError(f"MAX unsupported for {dtype}")
+
+
+class AggregateRelation(Relation):
+    """Executes [Selection +] Aggregate over a child relation in one
+    fused kernel; emits a single result batch.
+
+    Group expressions must be column references over the child schema
+    (the planner produces exactly that shape today).
+    """
+
+    def __init__(
+        self,
+        child: Relation,
+        group_expr: list[Expr],
+        aggr_expr: list[Expr],
+        out_schema: Schema,
+        predicate: Optional[Expr] = None,
+        functions=None,
+        device=None,
+    ):
+        self.child = child
+        self._schema = out_schema
+        self.device = device
+        in_schema = child.schema
+        for g in group_expr:
+            if not isinstance(g, Column):
+                raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
+        self.key_cols = [g.index for g in group_expr]
+        self.specs = []
+        for a in aggr_expr:
+            if not isinstance(a, AggregateFunction):
+                raise ExecutionError(f"non-aggregate expression {a!r} in aggr_expr")
+            self.specs.append(AggregateSpec(a, in_schema))
+
+        compiler = ExprCompiler(in_schema, functions)
+        self._pred_fn = compiler.compile(predicate) if predicate is not None else None
+        self._arg_fns = [compiler.compile(s.arg) for s in self.specs]
+        self._aux_specs = compiler.aux_specs
+        self._aux_cache: dict = {}
+        self.encoder = GroupKeyEncoder(len(self.key_cols))
+        self._key_dicts: dict[int, StringDictionary] = {}
+        self._jit = jax.jit(self._kernel)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    # -- accumulator state: (counts, tuple(per-spec accumulators)) --
+    def _init_state(self, capacity: int):
+        accs = []
+        for s in self.specs:
+            d = s.acc_dtype
+            if s.name in ("sum", "avg"):
+                accs.append((jnp.zeros(capacity, d), jnp.zeros(capacity, jnp.int64)))
+            elif s.name == "count":
+                accs.append(jnp.zeros(capacity, jnp.int64))
+            elif s.name == "min":
+                accs.append(jnp.full(capacity, _min_identity(d)))
+            else:
+                accs.append(jnp.full(capacity, _max_identity(d)))
+        return jnp.zeros(capacity, jnp.int64), tuple(accs)
+
+    def _grow_state(self, state, new_capacity: int):
+        """Dense group ids are stable: growth is identity padding."""
+        counts, accs = state
+        pad = new_capacity - counts.shape[0]
+
+        def grow(a, fill):
+            return jnp.concatenate([a, jnp.full(pad, jnp.asarray(fill, a.dtype))])
+
+        new_accs = []
+        for s, acc in zip(self.specs, accs):
+            if s.name in ("sum", "avg"):
+                new_accs.append((grow(acc[0], 0), grow(acc[1], 0)))
+            elif s.name == "count":
+                new_accs.append(grow(acc, 0))
+            elif s.name == "min":
+                new_accs.append(grow(acc, _min_identity(np.dtype(acc.dtype))))
+            else:
+                new_accs.append(grow(acc, _max_identity(np.dtype(acc.dtype))))
+        return grow(counts, 0), tuple(new_accs)
+
+    def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state):
+        env = Env(cols, valids, aux)
+        capacity = cols[0].shape[0] if cols else ids.shape[0]
+        mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if base_mask is not None:
+            mask = mask & base_mask
+        if self._pred_fn is not None:
+            pv, pvalid = self._pred_fn(env)
+            pv = jnp.broadcast_to(pv, (capacity,))
+            if pvalid is not None:
+                pv = pv & jnp.broadcast_to(pvalid, (capacity,))
+            mask = mask & pv
+        counts, accs = state
+        counts = counts.at[ids].add(mask.astype(jnp.int64))
+        new_accs = []
+        for s, fn, acc in zip(self.specs, self._arg_fns, accs):
+            v, valid = fn(env)
+            v = jnp.broadcast_to(v, (capacity,))
+            if valid is None or s.count_star:
+                # COUNT(*) counts rows regardless of column nullity
+                ok = mask
+            else:
+                ok = mask & jnp.broadcast_to(valid, (capacity,))
+            if s.name in ("sum", "avg"):
+                acc_sum, acc_cnt = acc
+                contrib = jnp.where(ok, v, 0).astype(acc_sum.dtype)
+                new_accs.append(
+                    (acc_sum.at[ids].add(contrib), acc_cnt.at[ids].add(ok.astype(jnp.int64)))
+                )
+            elif s.name == "count":
+                new_accs.append(acc.at[ids].add(ok.astype(jnp.int64)))
+            elif s.name == "min":
+                ident = _min_identity(np.dtype(acc.dtype))
+                new_accs.append(acc.at[ids].min(jnp.where(ok, v.astype(acc.dtype), ident)))
+            else:
+                ident = _max_identity(np.dtype(acc.dtype))
+                new_accs.append(acc.at[ids].max(jnp.where(ok, v.astype(acc.dtype), ident)))
+        return counts, tuple(new_accs)
+
+    def accumulate(self):
+        """Run the scan, returning the partial-aggregate device state.
+
+        Partitioned mode calls this per shard and combines states with
+        collectives; single-device mode finalizes it directly.
+        """
+        state = None
+        capacity = 0
+        for batch in self.child.batches():
+            for idx in self.key_cols:
+                if batch.dicts[idx] is not None:
+                    self._key_dicts[idx] = batch.dicts[idx]
+            if self.key_cols:
+                key_cols = [np.asarray(batch.data[idx]) for idx in self.key_cols]
+                key_valids = [
+                    None if batch.validity[idx] is None else np.asarray(batch.validity[idx])
+                    for idx in self.key_cols
+                ]
+                ids_np = self.encoder.encode(key_cols, key_valids)
+            else:
+                ids_np = np.zeros(batch.capacity, dtype=np.int32)
+            needed = bucket_capacity(max(self.encoder.num_groups, 1))
+            if state is None:
+                capacity = needed
+                state = self._init_state(capacity)
+            elif needed > capacity:
+                state = self._grow_state(state, needed)
+                capacity = needed
+            aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
+            from datafusion_tpu.exec.relation import device_scope
+
+            with METRICS.timer("execute.aggregate"), device_scope(self.device):
+                state = self._jit(
+                    tuple(batch.data),
+                    tuple(batch.validity),
+                    tuple(aux),
+                    np.int32(batch.num_rows),
+                    batch.mask,
+                    jnp.asarray(ids_np),
+                    state,
+                )
+        if state is None:
+            state = self._init_state(bucket_capacity(1))
+        return state
+
+    def finalize(self, state) -> RecordBatch:
+        counts, accs = state
+        counts = np.asarray(counts)
+        if self.key_cols:
+            n_groups = self.encoder.num_groups
+            live = np.nonzero(counts[:n_groups] > 0)[0]
+        else:
+            # global aggregate: always exactly one output row
+            live = np.array([0], dtype=np.int64)
+
+        out_cols: list[np.ndarray] = []
+        out_valid: list[Optional[np.ndarray]] = []
+        out_dicts: list[Optional[StringDictionary]] = []
+
+        in_schema = self.child.schema
+        for k, idx in enumerate(self.key_cols):
+            keys, kvalid = self.encoder.key_column(k)
+            keys = keys[live]
+            f = in_schema.field(idx)
+            out_cols.append(keys.astype(f.data_type.np_dtype))
+            out_valid.append(None if kvalid is None else kvalid[live])
+            out_dicts.append(self._key_dicts.get(idx))
+
+        for s, acc in zip(self.specs, accs):
+            if s.name in ("sum", "avg"):
+                sums = np.asarray(acc[0])[live]
+                cnts = np.asarray(acc[1])[live]
+                if s.name == "sum":
+                    vals = sums.astype(s.return_type.np_dtype)
+                else:
+                    vals = (sums.astype(np.float64) / np.maximum(cnts, 1)).astype(
+                        s.return_type.np_dtype
+                    )
+                valid = cnts > 0
+            elif s.name == "count":
+                vals = np.asarray(acc)[live].astype(s.return_type.np_dtype)
+                valid = None
+            elif s.name == "min":
+                raw = np.asarray(acc)[live]
+                vals = raw.astype(s.return_type.np_dtype)
+                valid = raw != _min_identity(np.dtype(raw.dtype))
+            else:
+                raw = np.asarray(acc)[live]
+                vals = raw.astype(s.return_type.np_dtype)
+                valid = raw != _max_identity(np.dtype(raw.dtype))
+            if valid is not None and bool(np.asarray(valid).all()):
+                valid = None
+            out_cols.append(vals)
+            out_valid.append(valid)
+            out_dicts.append(None)
+
+        return make_host_batch(self._schema, out_cols, out_valid, out_dicts)
+
+    def batches(self) -> Iterator[RecordBatch]:
+        yield self.finalize(self.accumulate())

@@ -1,0 +1,207 @@
+"""Columnar batches for TPU execution.
+
+The reference pulls Arrow `RecordBatch`es of up to 1024 rows through
+interpreted closures (`src/execution/relation.rs:27-32`).  Under XLA
+every shape is compiled statically, so batches here are:
+
+- **fixed-capacity and padded**: capacity is bucketed to a power of two
+  so a long scan compiles one kernel per bucket, not per batch;
+- **validity-masked**: nulls are first-class bool tensors (the reference
+  punts on nulls, `expression.rs:326-345`);
+- **selection-masked**: filters produce a row mask that is carried
+  through the pipeline instead of gathering every column per batch
+  (the reference's `filter.rs:80-111` row loop disappears);
+- **dictionary-encoded for strings**: Utf8 columns have no tensor
+  representation, so readers maintain *global, append-only* per-column
+  dictionaries and the device sees int32 codes.  Codes are stable
+  across batches, which keeps GROUP BY keys consistent for the whole
+  scan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.errors import ExecutionError
+
+MIN_CAPACITY = 1024
+
+
+def bucket_capacity(n: int) -> int:
+    """Smallest power-of-two capacity >= n (floor MIN_CAPACITY), so jit
+    recompiles O(log max_batch) times total."""
+    cap = MIN_CAPACITY
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+class StringDictionary:
+    """Global append-only string dictionary for one Utf8 column.
+
+    `version` (== len) keys the host-side caches derived from the
+    dictionary: comparison lookup tables and sort-rank tables are
+    recomputed only when the dictionary has grown.
+    """
+
+    __slots__ = ("values", "index")
+
+    def __init__(self):
+        self.values: list[str] = []
+        self.index: dict[str, int] = {}
+
+    @property
+    def version(self) -> int:
+        return len(self.values)
+
+    def add(self, s: str) -> int:
+        code = self.index.get(s)
+        if code is None:
+            code = len(self.values)
+            self.values.append(s)
+            self.index[s] = code
+        return code
+
+    def code_of(self, s: str) -> int:
+        """Code for `s`, or -1 if absent (a -1 never equals any row)."""
+        return self.index.get(s, -1)
+
+    def encode(self, strings) -> np.ndarray:
+        """Encode a sequence of python strings (None for null) to int32
+        codes; nulls encode as 0 (callers carry validity)."""
+        obj = np.asarray(strings, dtype=object)
+        isnull = np.fromiter((s is None for s in obj), dtype=bool, count=len(obj))
+        if isnull.any():
+            obj = obj.copy()
+            obj[isnull] = ""
+        uniq, inv = np.unique(obj.astype(str), return_inverse=True)
+        lut = np.fromiter(
+            (self.add(s) for s in uniq), dtype=np.int32, count=len(uniq)
+        )
+        codes = lut[inv].astype(np.int32)
+        codes[isnull] = 0
+        return codes
+
+    def merge_codes(self, codes: np.ndarray, values: Sequence[str]) -> np.ndarray:
+        """Remap codes expressed in a local dictionary `values` (e.g. a
+        pyarrow per-batch dictionary) into this global dictionary."""
+        lut = np.fromiter(
+            (self.add(v) for v in values), dtype=np.int32, count=len(values)
+        )
+        if len(lut) == 0:
+            return codes.astype(np.int32)
+        return lut[codes].astype(np.int32)
+
+    def decode(self, codes: np.ndarray) -> np.ndarray:
+        arr = np.asarray(self.values, dtype=object)
+        return arr[codes]
+
+    def compare_table(self, op, literal: str) -> np.ndarray:
+        """Bool table t where t[code] == (values[code] <op> literal).
+
+        Ordered comparisons on dictionary codes are meaningless (codes
+        are append-ordered), so the host materializes this table — size
+        = dictionary size, recomputed per version — and the device does
+        a gather.  Lexicographic order means ISO dates compare
+        chronologically (the TPC-H shipdate filter rides this).
+        """
+        vals = np.asarray(self.values, dtype=object)
+        if op == "<":
+            return np.array([v < literal for v in vals], dtype=bool)
+        if op == "<=":
+            return np.array([v <= literal for v in vals], dtype=bool)
+        if op == ">":
+            return np.array([v > literal for v in vals], dtype=bool)
+        if op == ">=":
+            return np.array([v >= literal for v in vals], dtype=bool)
+        raise ExecutionError(f"unsupported string comparison {op!r}")
+
+    def sort_ranks(self, descending: bool = False) -> np.ndarray:
+        """rank[code] = position of values[code] in sorted order, so
+        sorting rows by rank[codes] sorts them by string value."""
+        order = np.argsort(np.asarray(self.values, dtype=object), kind="stable")
+        ranks = np.empty(len(order), dtype=np.int32)
+        ranks[order] = np.arange(len(order), dtype=np.int32)
+        if descending:
+            ranks = (len(order) - 1) - ranks
+        return ranks
+
+
+class RecordBatch:
+    """A padded columnar batch.
+
+    `data[i]` is a numpy (host) or jax (device) array of length
+    `capacity`; rows at index >= num_rows are padding.  `validity[i]`
+    is a bool array (None = all valid).  `mask` is the row-selection
+    mask produced by upstream filters (None = all rows live).  Utf8
+    columns store int32 codes and their StringDictionary in `dicts[i]`.
+    """
+
+    __slots__ = ("schema", "data", "validity", "dicts", "num_rows", "mask")
+
+    def __init__(
+        self,
+        schema: Schema,
+        data: list,
+        validity: Optional[list] = None,
+        dicts: Optional[list] = None,
+        num_rows: Optional[int] = None,
+        mask=None,
+    ):
+        self.schema = schema
+        self.data = data
+        self.validity = validity if validity is not None else [None] * len(data)
+        self.dicts = dicts if dicts is not None else [None] * len(data)
+        self.num_rows = num_rows if num_rows is not None else (len(data[0]) if data else 0)
+        self.mask = mask
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.data)
+
+    @property
+    def capacity(self) -> int:
+        return len(self.data[0]) if self.data else 0
+
+    def column(self, i: int):
+        return self.data[i]
+
+
+def pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
+    """Pad a 1-D host array with zeros up to `capacity`."""
+    n = len(arr)
+    if n == capacity:
+        return np.ascontiguousarray(arr)
+    if n > capacity:
+        raise ExecutionError(f"batch of {n} rows exceeds capacity {capacity}")
+    out = np.zeros(capacity, dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+def make_host_batch(
+    schema: Schema,
+    columns: list[np.ndarray],
+    validity: Optional[list[Optional[np.ndarray]]] = None,
+    dicts: Optional[list[Optional[StringDictionary]]] = None,
+) -> RecordBatch:
+    """Assemble a RecordBatch from unpadded host columns, padding all of
+    them to a common bucketed capacity."""
+    if not columns:
+        return RecordBatch(schema, [], num_rows=0)
+    n = len(columns[0])
+    cap = bucket_capacity(n)
+    data = [pad_to(np.asarray(c), cap) for c in columns]
+    vals: list[Optional[np.ndarray]] = []
+    for i in range(len(columns)):
+        v = validity[i] if validity is not None else None
+        if v is None:
+            vals.append(None)
+        else:
+            pv = np.zeros(cap, dtype=bool)
+            pv[:n] = v
+            vals.append(pv)
+    return RecordBatch(schema, data, vals, dicts, num_rows=n)

@@ -1,0 +1,155 @@
+"""Result materialization: device batches -> host rows.
+
+Compaction (dropping masked-out rows) happens *here*, at the pipeline
+boundary, not inside operators — the fused kernels carry selection
+masks instead (contrast the reference's per-batch per-column gather,
+`filter.rs:80-111`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.exec.batch import RecordBatch
+
+
+def compact_batch(batch: RecordBatch):
+    """Bring a batch to host and drop padding/filtered rows.
+
+    Returns (columns, validity, dicts, num_live_rows); strings stay
+    dictionary-coded.
+    """
+    n = batch.num_rows
+    live: Optional[np.ndarray] = None
+    if batch.mask is not None:
+        live = np.asarray(batch.mask)[: batch.capacity]
+        live = live & (np.arange(batch.capacity) < n)
+    cols = []
+    valids = []
+    for i in range(batch.num_columns):
+        c = np.asarray(batch.data[i])
+        v = batch.validity[i]
+        v = None if v is None else np.asarray(v)
+        if live is not None:
+            c = c[live]
+            v = None if v is None else v[live]
+        else:
+            c = c[:n]
+            v = None if v is None else v[:n]
+        cols.append(c)
+        valids.append(v)
+    count = int(live.sum()) if live is not None else n
+    return cols, valids, list(batch.dicts), count
+
+
+class ResultTable:
+    """A fully-materialized query result (decoded, null-aware)."""
+
+    def __init__(self, schema: Schema, columns: list[np.ndarray],
+                 validity: list[Optional[np.ndarray]]):
+        self.schema = schema
+        self.columns = columns
+        self.validity = validity
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.columns[0]) if self.columns else 0
+
+    def column_values(self, i: int) -> list:
+        """Python values for column i, None where null."""
+        col = self.columns[i]
+        valid = self.validity[i]
+        out = col.tolist()
+        if valid is not None:
+            out = [v if ok else None for v, ok in zip(out, valid)]
+        return out
+
+    def to_pylist(self) -> list[dict]:
+        names = self.schema.names()
+        cols = [self.column_values(i) for i in range(len(names))]
+        return [dict(zip(names, row)) for row in zip(*cols)] if cols else []
+
+    def to_rows(self) -> list[tuple]:
+        cols = [self.column_values(i) for i in range(len(self.schema))]
+        return list(zip(*cols)) if cols else []
+
+    def pretty(self, max_rows: int = 50) -> str:
+        names = self.schema.names()
+        rows = self.to_rows()[:max_rows]
+        cells = [[("NULL" if v is None else str(v)) for v in row] for row in rows]
+        widths = [len(n) for n in names]
+        for row in cells:
+            for j, c in enumerate(row):
+                widths[j] = max(widths[j], len(c))
+        sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+        lines = [sep]
+        lines.append("|" + "|".join(f" {n:<{w}} " for n, w in zip(names, widths)) + "|")
+        lines.append(sep)
+        for row in cells:
+            lines.append("|" + "|".join(f" {c:<{w}} " for c, w in zip(row, widths)) + "|")
+        lines.append(sep)
+        if len(self.to_rows()) > max_rows:
+            lines.append(f"... ({self.num_rows} rows total)")
+        return "\n".join(lines)
+
+
+def collect_columns(relation):
+    """Pull every batch of a Relation and concatenate live rows on host.
+
+    Returns (columns, validity, dicts, total_rows); strings stay
+    dictionary-coded (dicts[i] holds the decoder).
+    """
+    schema = relation.schema
+    ncols = len(schema)
+    parts: list[list[np.ndarray]] = [[] for _ in range(ncols)]
+    vparts: list[list[Optional[np.ndarray]]] = [[] for _ in range(ncols)]
+    dicts: list = [None] * ncols
+    any_null = [False] * ncols
+    total = 0
+    for batch in relation.batches():
+        cols, valids, bdicts, n = compact_batch(batch)
+        if n == 0:
+            continue
+        total += n
+        for i in range(ncols):
+            parts[i].append(cols[i])
+            vparts[i].append(valids[i])
+            if valids[i] is not None:
+                any_null[i] = True
+            if bdicts[i] is not None:
+                dicts[i] = bdicts[i]
+    columns = []
+    validity: list[Optional[np.ndarray]] = []
+    for i in range(ncols):
+        if parts[i]:
+            columns.append(np.concatenate(parts[i]))
+        else:
+            columns.append(np.empty(0, dtype=schema.field(i).data_type.np_dtype))
+        if not any_null[i]:
+            validity.append(None)
+        else:
+            vs = [
+                v if v is not None else np.ones(len(p), dtype=bool)
+                for v, p in zip(vparts[i], parts[i])
+            ]
+            validity.append(np.concatenate(vs))
+    return columns, validity, dicts, total
+
+
+def collect(relation) -> ResultTable:
+    """Materialize a Relation into a ResultTable (decodes strings)."""
+    schema = relation.schema
+    columns, validity, dicts, _ = collect_columns(relation)
+    decoded = []
+    for i in range(len(schema)):
+        c = columns[i]
+        if schema.field(i).data_type == DataType.UTF8:
+            if dicts[i] is not None:
+                c = dicts[i].decode(c)
+            else:
+                c = c.astype(object)
+        decoded.append(c)
+    return ResultTable(schema, decoded, validity)

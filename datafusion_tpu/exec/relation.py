@@ -1,0 +1,170 @@
+"""Relation protocol and the fused pipeline operator.
+
+The reference's operator layer is a volcano-style pull iterator
+(`src/execution/relation.rs:27-32`) with separate Filter and Projection
+operators that interpret closures per batch.  Here a whole
+scan->filter->project fragment executes as **one jitted XLA kernel**
+(`PipelineRelation`): the predicate produces a selection mask that is
+carried in the batch instead of gathering rows (`filter.rs:80-111`'s
+per-column row loop), and projection expressions fuse with it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.exec.batch import RecordBatch
+from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
+from datafusion_tpu.errors import NotSupportedError
+from datafusion_tpu.plan.expr import Column, Expr
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def device_scope(device):
+    """Context manager placing jax computations on `device` (no-op when
+    None: JAX's default device — the TPU when one is attached)."""
+    from contextlib import nullcontext
+
+    return jax.default_device(device) if device is not None else nullcontext()
+
+
+class Relation:
+    """Pull-based iterator of RecordBatches (reference `Relation` trait)."""
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def batches(self) -> Iterator[RecordBatch]:
+        raise NotImplementedError
+
+
+class DataSourceRelation(Relation):
+    """Adapts a DataSource into a Relation (reference `relation.rs:34-54`)."""
+
+    def __init__(self, datasource):
+        self.datasource = datasource
+
+    @property
+    def schema(self) -> Schema:
+        return self.datasource.schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return self.datasource.batches()
+
+
+class PipelineRelation(Relation):
+    """Fused [filter +] [projection] over a child relation.
+
+    One `jax.jit`-compiled function evaluates the predicate and all
+    projection expressions in a single fused XLA computation per batch.
+    jit's own cache handles per-(capacity, dtypes) specialization; the
+    batch capacity bucketing in exec/batch.py bounds how many variants
+    ever compile.
+    """
+
+    def __init__(
+        self,
+        child: Relation,
+        predicate: Optional[Expr],
+        projections: Optional[list[Expr]],
+        out_schema: Optional[Schema] = None,
+        functions: Optional[dict[str, Callable]] = None,
+        device=None,
+    ):
+        self.child = child
+        self.predicate = predicate
+        self.projections = projections
+        self._schema = out_schema if out_schema is not None else child.schema
+        self.device = device
+        in_schema = child.schema
+
+        compiler = ExprCompiler(in_schema, functions)
+        self._pred_fn = compiler.compile(predicate) if predicate is not None else None
+        self._proj_fns = (
+            [compiler.compile(e) for e in projections]
+            if projections is not None
+            else None
+        )
+        self._aux_specs = compiler.aux_specs
+        self._aux_cache: dict = {}
+        # map projection outputs to source dictionaries (Utf8 passthrough)
+        self._out_dict_sources: list[Optional[int]] = []
+        if projections is not None:
+            for e in projections:
+                if (
+                    isinstance(e, Column)
+                    and in_schema.field(e.index).data_type == DataType.UTF8
+                ):
+                    self._out_dict_sources.append(e.index)
+                else:
+                    self._out_dict_sources.append(None)
+
+        self._jit = jax.jit(self._kernel)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _kernel(self, cols, valids, aux, num_rows, base_mask):
+        env = Env(cols, valids, aux)
+        if cols:
+            capacity = cols[0].shape[0]
+        elif base_mask is not None:
+            capacity = base_mask.shape[0]  # zero-column EmptyRelation batch
+        else:
+            capacity = 1
+        mask = base_mask
+        if mask is None:
+            mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        else:
+            mask = mask & (jnp.arange(capacity, dtype=jnp.int32) < num_rows)
+        if self._pred_fn is not None:
+            pv, pvalid = self._pred_fn(env)
+            pv = jnp.broadcast_to(pv, (capacity,))
+            if pvalid is not None:
+                # SQL: NULL predicate drops the row
+                pv = pv & jnp.broadcast_to(pvalid, (capacity,))
+            mask = mask & pv
+        if self._proj_fns is None:
+            return list(cols), list(valids), mask
+        out_cols, out_valids = [], []
+        for f in self._proj_fns:
+            v, valid = f(env)
+            out_cols.append(jnp.broadcast_to(v, (capacity,)))
+            out_valids.append(
+                None if valid is None else jnp.broadcast_to(valid, (capacity,))
+            )
+        return out_cols, out_valids, mask
+
+    def batches(self) -> Iterator[RecordBatch]:
+        for batch in self.child.batches():
+            aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
+            with METRICS.timer("execute.pipeline"), device_scope(self.device):
+                cols, valids, mask = self._jit(
+                    tuple(batch.data),
+                    tuple(batch.validity),
+                    tuple(aux),
+                    np.int32(batch.num_rows),
+                    batch.mask,
+                )
+            if self._proj_fns is None:
+                dicts = batch.dicts
+            else:
+                dicts = [
+                    batch.dicts[src] if src is not None else None
+                    for src in self._out_dict_sources
+                ]
+            yield RecordBatch(
+                self._schema,
+                list(cols),
+                list(valids),
+                dicts,
+                num_rows=batch.num_rows,
+                mask=mask,
+            )

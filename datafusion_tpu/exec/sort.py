@@ -1,0 +1,177 @@
+"""ORDER BY / LIMIT operators.
+
+The reference planned Sort/Limit but left them `unimplemented!()`
+(`context.rs:161`).  TPU design: collect the child's (already filtered/
+projected) batches, compact to a single padded buffer, and run **one
+multi-key `lax.sort` on device** — stable, ascending, with per-key
+transforms:
+
+- DESC numeric keys sort by their negation (unsigned by bitwise
+  complement), so every key is ascending for the one fused sort.
+- Utf8 keys sort by host-computed rank tables
+  (`StringDictionary.sort_ranks`): rank[code] is the value's position
+  in sorted order, so code-ranked ascending == lexicographic.
+- Padding and NULL keys map to the dtype's max sentinel: nulls last.
+
+LIMIT over a sort slices the sorted permutation; a bare LIMIT just
+stops pulling batches early (no device work at all).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.errors import NotSupportedError
+from datafusion_tpu.exec.batch import RecordBatch, bucket_capacity, make_host_batch
+from datafusion_tpu.exec.materialize import collect_columns, compact_batch
+from datafusion_tpu.exec.relation import Relation, device_scope as _device_scope
+from datafusion_tpu.plan.expr import Column, SortExpr
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def _sortable_key(
+    values: np.ndarray,
+    validity: Optional[np.ndarray],
+    dtype_kind: str,
+    asc: bool,
+) -> np.ndarray:
+    """Transform a key column so ascending sort yields the right order,
+    nulls last."""
+    if dtype_kind == "f":
+        k = values.astype(np.float64)
+        if not asc:
+            k = -k
+        if validity is not None:
+            k = np.where(validity, k, np.inf)
+        return k
+    # ints / bools / dict ranks: widen to int64 (uint64 edge: sort as
+    # float64 would lose precision, so map through int64 carefully)
+    k = values.astype(np.int64)
+    if not asc:
+        k = -k
+    if validity is not None:
+        k = np.where(validity, k, np.iinfo(np.int64).max)
+    return k
+
+
+class SortRelation(Relation):
+    def __init__(
+        self,
+        child: Relation,
+        sort_expr: list[SortExpr],
+        out_schema: Schema,
+        limit: Optional[int] = None,
+        device=None,
+    ):
+        self.child = child
+        self.sort_expr = sort_expr
+        self._schema = out_schema
+        self.limit = limit
+        self.device = device
+        for se in sort_expr:
+            if not isinstance(se.expr, Column):
+                raise NotSupportedError(
+                    f"ORDER BY supports column references, got {se.expr!r}"
+                )
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        # 1. compact child output to host columns
+        columns, validity, dicts, n = collect_columns(self.child)
+        if n == 0:
+            yield make_host_batch(self._schema, columns, validity, dicts)
+            return
+
+        # 2. build transformed sort keys
+        keys = []
+        in_schema = self.child.schema
+        for se in self.sort_expr:
+            idx = se.expr.index
+            f = in_schema.field(idx)
+            vals = columns[idx]
+            if f.data_type == DataType.UTF8:
+                d = dicts[idx]
+                ranks = d.sort_ranks() if d is not None else None
+                vals = ranks[vals] if ranks is not None else vals
+                kind = "i"
+            else:
+                kind = f.data_type.np_dtype.kind
+                if kind == "u" and f.data_type.width == 64:
+                    # uint64 doesn't fit int64: flip the sign bit and
+                    # reinterpret — order-preserving and lossless
+                    vals = (
+                        np.ascontiguousarray(vals.astype(np.uint64))
+                        ^ np.uint64(1 << 63)
+                    ).view(np.int64)
+                if kind == "b":
+                    kind = "i"
+            keys.append(_sortable_key(vals, validity[idx], "f" if kind == "f" else "i", se.asc))
+
+        # 3. pad and sort on device: operands = keys + row-index payload
+        cap = bucket_capacity(n)
+        ops = []
+        for k in keys:
+            pad_val = np.inf if k.dtype.kind == "f" else np.iinfo(np.int64).max
+            padded = np.full(cap, pad_val, dtype=k.dtype)
+            padded[:n] = k
+            ops.append(jnp.asarray(padded))
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        with METRICS.timer("execute.sort"), _device_scope(self.device):
+            sorted_ops = lax.sort(
+                tuple(ops) + (iota,), num_keys=len(ops), is_stable=True
+            )
+            perm = np.asarray(sorted_ops[-1])
+
+        take = n if self.limit is None else min(self.limit, n)
+        perm = perm[:take]
+
+        # 4. gather output columns by the permutation (host: result sizes
+        # are post-limit and user-facing)
+        out_cols = [c[perm] for c in columns]
+        out_valid = [None if v is None else v[perm] for v in validity]
+        yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+
+
+class LimitRelation(Relation):
+    """Row-limit: stops pulling child batches as soon as enough rows
+    are materialized (reference `Limit` plan, `logicalplan.rs:310-315`)."""
+
+    def __init__(self, child: Relation, limit: int, out_schema: Schema):
+        self.child = child
+        self.limit = limit
+        self._schema = out_schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        remaining = self.limit
+        if remaining <= 0:
+            return
+        for batch in self.child.batches():
+            cols, valids, dicts, n = compact_batch(batch)
+            if n == 0:
+                continue
+            take = min(n, remaining)
+            remaining -= take
+            yield make_host_batch(
+                batch.schema,
+                [c[:take] for c in cols],
+                [None if v is None else v[:take] for v in valids],
+                dicts,
+            )
+            if remaining <= 0:
+                # stop before pulling (and parsing) another child batch
+                return
+
+

@@ -494,14 +494,28 @@ class ScalarFunction(Expr):
 
 
 class AggregateFunction(Expr):
-    """Aggregate function call; repr ``NAME(arg, ...)``."""
+    """Aggregate function call; repr ``NAME(arg, ...)``.
 
-    __slots__ = ("name", "args", "return_type")
+    ``count_star`` marks COUNT(1)/COUNT(*): the planner rewrites those
+    to COUNT(#0) for plan-shape parity with the reference
+    (`sqlplanner.rs:311-329`, golden test `select_count_one`), but the
+    executor must still count *rows*, not non-null values of column 0.
+    The flag is repr-invisible and serialized only when set.
+    """
 
-    def __init__(self, name: str, args: Sequence[Expr], return_type: DataType):
+    __slots__ = ("name", "args", "return_type", "count_star")
+
+    def __init__(
+        self,
+        name: str,
+        args: Sequence[Expr],
+        return_type: DataType,
+        count_star: bool = False,
+    ):
         self.name = name
         self.args = list(args)
         self.return_type = return_type
+        self.count_star = count_star
 
     def get_type(self, schema: Schema) -> DataType:
         return self.return_type
@@ -510,19 +524,20 @@ class AggregateFunction(Expr):
         return tuple(self.args)
 
     def _key(self):
-        return (self.name, tuple(self.args), self.return_type)
+        return (self.name, tuple(self.args), self.return_type, self.count_star)
 
     def __repr__(self) -> str:
         return f"{self.name}({', '.join(repr(a) for a in self.args)})"
 
     def to_json(self):
-        return {
-            "AggregateFunction": {
-                "name": self.name,
-                "args": [a.to_json() for a in self.args],
-                "return_type": self.return_type.to_json(),
-            }
+        body = {
+            "name": self.name,
+            "args": [a.to_json() for a in self.args],
+            "return_type": self.return_type.to_json(),
         }
+        if self.count_star:
+            body["count_star"] = True
+        return {"AggregateFunction": body}
 
 
 _EXPR_DECODERS: dict[str, Callable] = {
@@ -539,7 +554,10 @@ _EXPR_DECODERS: dict[str, Callable] = {
         b["name"], [Expr.from_json(a) for a in b["args"]], DataType.from_json(b["return_type"])
     ),
     "AggregateFunction": lambda b: AggregateFunction(
-        b["name"], [Expr.from_json(a) for a in b["args"]], DataType.from_json(b["return_type"])
+        b["name"],
+        [Expr.from_json(a) for a in b["args"]],
+        DataType.from_json(b["return_type"]),
+        b.get("count_star", False),
     ),
 }
 

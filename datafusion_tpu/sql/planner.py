@@ -283,9 +283,10 @@ class SqlToRel:
                 raise PlanError("COUNT takes exactly one argument")
             a = node.args[0]
             if isinstance(a, (ast.SqlWildcard, ast.SqlLongLiteral, ast.SqlDoubleLiteral)):
-                arg: Expr = Column(0)
-            else:
-                arg = self.sql_to_rex(a, schema)
+                # plan-shape parity with the reference's COUNT(#0) rewrite,
+                # but flagged so the executor counts rows, not col-0 non-nulls
+                return AggregateFunction(node.name, [Column(0)], DataType.UINT64, True)
+            arg = self.sql_to_rex(a, schema)
             return AggregateFunction(node.name, [arg], DataType.UINT64)
         # scalar UDF lookup with per-argument coercion (sqlplanner.rs:330-351)
         fm = self.schema_provider.get_function_meta(lname)

@@ -9,12 +9,18 @@ imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# this machine's sitecustomize registers the TPU tunnel backend and
+# overrides the env var at interpreter boot; re-pin the config too
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import sys
 

@@ -1,0 +1,312 @@
+"""End-to-end execution tests.
+
+Models the reference's operator/integration tests
+(`projection.rs:85-107`: real file fixtures, no mocks) and its
+example-as-test (`examples/csv_sql.rs` — the uk_cities query is the
+canonical smoke-proof of the full pipeline).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+
+
+@pytest.fixture
+def ctx(test_data_dir):
+    c = ExecutionContext(batch_size=1024)
+    c.register_csv(
+        "cities",
+        os.path.join(test_data_dir, "uk_cities.csv"),
+        Schema(
+            [
+                Field("city", DataType.UTF8, False),
+                Field("lat", DataType.FLOAT64, False),
+                Field("lng", DataType.FLOAT64, False),
+            ]
+        ),
+        has_header=False,
+    )
+    c.register_csv(
+        "people",
+        os.path.join(test_data_dir, "people.csv"),
+        Schema(
+            [
+                Field("id", DataType.INT32, False),
+                Field("first_name", DataType.UTF8, False),
+            ]
+        ),
+        has_header=True,
+    )
+    c.register_csv(
+        "null_test",
+        os.path.join(test_data_dir, "null_test.csv"),
+        Schema(
+            [
+                Field("c_int", DataType.INT32, True),
+                Field("c_float", DataType.FLOAT64, True),
+                Field("c_string", DataType.UTF8, True),
+                Field("c_bool", DataType.BOOLEAN, True),
+            ]
+        ),
+        has_header=True,
+    )
+    c.register_csv(
+        "numerics",
+        os.path.join(test_data_dir, "numerics.csv"),
+        Schema(
+            [
+                Field("a", DataType.INT64, False),
+                Field("b", DataType.INT64, False),
+                Field("a_f", DataType.FLOAT64, False),
+                Field("b_f", DataType.FLOAT64, False),
+            ]
+        ),
+        has_header=True,
+    )
+    return c
+
+
+def test_csv_sql_example(ctx):
+    # the reference's examples/csv_sql.rs workload — its only end-to-end proof
+    t = ctx.sql_collect(
+        "SELECT city, lat, lng, lat + lng FROM cities "
+        "WHERE lat > 51.0 AND lat < 53"
+    )
+    assert t.schema.names() == ["city", "lat", "lng", "binary_expr"]
+    rows = t.to_rows()
+    assert len(rows) == 18  # uk_cities.csv rows with 51 < lat < 53
+    for city, lat, lng, s in rows:
+        assert 51.0 < lat < 53.0
+        assert s == pytest.approx(lat + lng)
+    assert any(r[0].startswith("Solihull") for r in rows)
+
+
+def test_projection_all_columns(ctx):
+    # ported from reference projection.rs:85-107
+    t = ctx.sql_collect("SELECT id FROM people")
+    assert t.schema.names() == ["id"]
+    assert t.column_values(0) == list(range(1, 11))
+
+
+def test_select_star(ctx):
+    t = ctx.sql_collect("SELECT * FROM people")
+    rows = t.to_rows()
+    assert len(rows) == 10
+    assert rows[:4] == [(1, "Andy"), (2, "Brian"), (3, "Chris"), (4, "Donna")]
+    assert rows[-1] == (10, "Juliet")
+
+
+def test_string_filter(ctx):
+    t = ctx.sql_collect("SELECT id FROM people WHERE first_name = 'Brian'")
+    assert t.column_values(0) == [2]
+    t = ctx.sql_collect("SELECT id FROM people WHERE first_name != 'Brian'")
+    assert t.column_values(0) == [1] + list(range(3, 11))
+    # ordered comparison on strings via dictionary lookup table
+    t = ctx.sql_collect("SELECT first_name FROM people WHERE first_name >= 'Gary'")
+    assert sorted(t.column_values(0)) == ["Gary", "Helen", "Irene", "Juliet"]
+
+
+def test_arithmetic(ctx):
+    t = ctx.sql_collect("SELECT a + b, a - b, a * b, a_f / b_f FROM numerics")
+    rows = t.to_rows()
+    assert rows[0][0] == 5 and rows[0][1] == -1 and rows[0][2] == 6
+    assert rows[0][3] == pytest.approx(3.14 / -2.13)
+
+
+def test_int_division_and_modulus(ctx):
+    t = ctx.sql_collect("SELECT b / a, b % a FROM numerics WHERE a > 0")
+    # rows where a>0: (2,3) and (5,5)
+    assert t.to_rows() == [(1, 1), (1, 0)]
+
+
+def test_nulls(ctx):
+    t = ctx.sql_collect("SELECT c_int, c_float, c_string FROM null_test")
+    vals = t.column_values(1)
+    assert vals[2] is None  # row 3 has empty c_float
+    assert t.column_values(2)[3] is None  # row 4 has empty c_string
+    t = ctx.sql_collect("SELECT c_int FROM null_test WHERE c_float IS NULL")
+    assert t.column_values(0) == [3]
+    t = ctx.sql_collect("SELECT c_int FROM null_test WHERE c_float IS NOT NULL")
+    assert t.column_values(0) == [1, 2, 4, 5]
+
+
+def test_null_comparison_drops_rows(ctx):
+    # SQL: a comparison with NULL input is NULL -> row filtered out
+    t = ctx.sql_collect("SELECT c_int FROM null_test WHERE c_float > 0.0")
+    assert t.column_values(0) == [1, 2, 4, 5]
+
+
+def test_global_aggregates(ctx):
+    t = ctx.sql_collect(
+        "SELECT MIN(lat), MAX(lat), SUM(lat), AVG(lat), COUNT(1) FROM cities"
+    )
+    lats = _cities_lats(ctx)
+    row = t.to_rows()[0]
+    assert row[0] == pytest.approx(lats.min())
+    assert row[1] == pytest.approx(lats.max())
+    assert row[2] == pytest.approx(lats.sum())
+    assert row[3] == pytest.approx(lats.mean())
+    assert row[4] == len(lats)
+
+
+def test_aggregate_with_filter(ctx):
+    t = ctx.sql_collect("SELECT COUNT(1), SUM(lat) FROM cities WHERE lat > 52")
+    lats = _cities_lats(ctx)
+    sel = lats[lats > 52]
+    assert t.to_rows()[0][0] == len(sel)
+    assert t.to_rows()[0][1] == pytest.approx(sel.sum())
+
+
+def test_group_by_string(ctx):
+    t = ctx.sql_collect(
+        "SELECT c_bool, COUNT(1), SUM(c_int) FROM null_test GROUP BY c_bool"
+    )
+    by_key = {r[0]: r for r in t.to_rows()}
+    # fixture: rows 1-3 true (c_int 1,2,3), rows 4-5 false (c_int 4,5)
+    assert by_key[True][1] == 3 and by_key[True][2] == 6
+    assert by_key[False][1] == 2 and by_key[False][2] == 9
+    t2 = ctx.sql_collect(
+        "SELECT first_name, COUNT(1) FROM people GROUP BY first_name"
+    )
+    rows2 = sorted(t2.to_rows())
+    assert len(rows2) == 10
+    assert rows2[:2] == [("Andy", 1), ("Brian", 1)]
+
+
+def test_avg_of_nullable_column(ctx):
+    t = ctx.sql_collect("SELECT AVG(c_float), COUNT(c_float) FROM null_test")
+    row = t.to_rows()[0]
+    # null row excluded from both
+    assert row[1] == 4
+    assert row[0] == pytest.approx((1.1 + 2.2 + 4.4 + 6.6) / 4)
+
+
+def test_order_by(ctx):
+    t = ctx.sql_collect("SELECT city, lat FROM cities ORDER BY lat DESC LIMIT 3")
+    lats = [r[1] for r in t.to_rows()]
+    assert lats == sorted(lats, reverse=True)
+    assert len(lats) == 3
+    all_lats = sorted(_cities_lats(ctx), reverse=True)
+    assert lats == pytest.approx(all_lats[:3])
+
+
+def test_order_by_string(ctx):
+    t = ctx.sql_collect("SELECT first_name FROM people ORDER BY first_name DESC")
+    assert t.column_values(0) == [
+        "Juliet", "Irene", "Helen", "Gary", "Fiona",
+        "Edward", "Donna", "Chris", "Brian", "Andy",
+    ]
+
+
+def test_limit(ctx):
+    t = ctx.sql_collect("SELECT id FROM people LIMIT 2")
+    assert t.column_values(0) == [1, 2]
+
+
+def test_select_literal_no_table(ctx):
+    t = ctx.sql_collect("SELECT 1")
+    assert t.to_rows() == [(1,)]
+    t = ctx.sql_collect("SELECT sqrt(9)")
+    assert t.to_rows()[0][0] == pytest.approx(3.0)
+
+
+def test_udf(ctx):
+    import jax.numpy as jnp
+
+    ctx.register_udf("plus_one", [DataType.FLOAT64], DataType.FLOAT64, lambda x: x + 1)
+    t = ctx.sql_collect("SELECT plus_one(lat) FROM cities LIMIT 1")
+    lats = _cities_lats(ctx)
+    assert t.to_rows()[0][0] == pytest.approx(lats[0] + 1)
+
+
+def test_ddl_create_external_table(ctx, test_data_dir):
+    path = os.path.join(test_data_dir, "uk_cities.csv")
+    res = ctx.sql(
+        f"CREATE EXTERNAL TABLE uk (city VARCHAR(100) NOT NULL, "
+        f"lat DOUBLE NOT NULL, lng DOUBLE NOT NULL) "
+        f"STORED AS CSV WITHOUT HEADER ROW LOCATION '{path}'"
+    )
+    assert "uk" in ctx.datasources
+    t = ctx.sql_collect("SELECT COUNT(1) FROM uk")
+    assert t.to_rows()[0][0] == 37
+
+
+def test_explain(ctx):
+    res = ctx.sql("EXPLAIN SELECT id FROM people WHERE id > 2")
+    s = repr(res)
+    assert "Projection" in s and "Selection" in s and "TableScan" in s
+
+
+def test_cast(ctx):
+    t = ctx.sql_collect("SELECT CAST(id AS DOUBLE) FROM people")
+    assert t.column_values(0) == [float(i) for i in range(1, 11)]
+    assert t.schema.fields[0].data_type == DataType.FLOAT64
+
+
+def test_cpu_device_explicit(test_data_dir):
+    c = ExecutionContext(device="cpu")
+    c.register_csv(
+        "cities",
+        os.path.join(test_data_dir, "uk_cities.csv"),
+        Schema(
+            [
+                Field("city", DataType.UTF8, False),
+                Field("lat", DataType.FLOAT64, False),
+                Field("lng", DataType.FLOAT64, False),
+            ]
+        ),
+        has_header=False,
+    )
+    t = c.sql_collect("SELECT COUNT(1) FROM cities")
+    assert t.to_rows()[0][0] == 37
+
+
+def _cities_lats(ctx):
+    import csv
+
+    ds = ctx.datasources["cities"]
+    with open(ds.path) as f:
+        return np.array([float(r[1]) for r in csv.reader(f)])
+
+def test_count_star_vs_count_column(ctx):
+    # COUNT(1) counts rows even where columns are NULL; COUNT(col)
+    # counts non-null values of that column
+    t = ctx.sql_collect("SELECT COUNT(1) FROM null_test")
+    assert t.to_rows()[0][0] == 5
+    t = ctx.sql_collect("SELECT COUNT(c_float) FROM null_test")
+    assert t.to_rows()[0][0] == 4
+    # COUNT(1) where column 0 itself has the NULL (c_int is col 0 and
+    # fully populated here, so force the edge through c_float as arg 0
+    # of the rewritten plan): the flag, not the arg, drives row counting
+    t = ctx.sql_collect("SELECT COUNT(1), COUNT(c_float) FROM null_test WHERE c_int > 0")
+    assert t.to_rows()[0] == (5, 4)
+
+
+def test_group_by_null_keys(ctx):
+    # SQL: NULL forms its own group, distinct from every real value
+    t = ctx.sql_collect(
+        "SELECT c_string, COUNT(1) FROM null_test GROUP BY c_string"
+    )
+    rows = t.to_rows()
+    null_groups = [r for r in rows if r[0] is None]
+    assert len(null_groups) == 1
+    assert null_groups[0][1] == 2  # rows 4 and 5 have null c_string
+    real = {r[0]: r[1] for r in rows if r[0] is not None}
+    assert real == {"1.11": 1, "2.22": 1, "3.33": 1}
+
+
+def test_or_with_null_operand(ctx):
+    # TRUE OR NULL = TRUE: row 3 (c_float null, c_int 3) must survive
+    t = ctx.sql_collect(
+        "SELECT c_int FROM null_test WHERE c_int = 3 OR c_float > 100.0"
+    )
+    assert t.column_values(0) == [3]
+    # FALSE AND NULL = FALSE is just dropped either way; but
+    # NULL AND TRUE = NULL drops the row
+    t = ctx.sql_collect(
+        "SELECT c_int FROM null_test WHERE c_float > 0.0 AND c_int > 0"
+    )
+    assert t.column_values(0) == [1, 2, 4, 5]
