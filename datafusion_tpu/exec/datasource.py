@@ -108,6 +108,17 @@ class NdJsonDataSource(DataSource):
     def with_projection(self, projection: Sequence[int]) -> "NdJsonDataSource":
         return NdJsonDataSource(self.path, self.table_schema, self.batch_size, projection)
 
+    def to_meta(self) -> dict:
+        # same wire shape as the CSV/Parquet variants (datasource.rs:70-85);
+        # the reference declares NDJSON in DDL but never got this far
+        return {
+            "NdJsonFile": {
+                "filename": self.path,
+                "schema": self.table_schema.to_json(),
+                "projection": self.projection,
+            }
+        }
+
 
 class ParquetDataSource(DataSource):
     def __init__(
