@@ -43,13 +43,26 @@ from datafusion_tpu.errors import ExecutionError, NotSupportedError
 from datafusion_tpu.exec.batch import (
     RecordBatch,
     StringDictionary,
-    bucket_capacity,
     make_host_batch,
 )
 from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
 from datafusion_tpu.exec.relation import Relation
 from datafusion_tpu.plan.expr import AggregateFunction, Column, Expr
 from datafusion_tpu.utils.metrics import METRICS
+
+
+DENSE_GROUP_MAX = 64
+
+
+def group_capacity(n: int) -> int:
+    """Accumulator capacity: next power of two, floor 8.  Kept tight
+    (unlike row-batch bucketing) because capacities <= DENSE_GROUP_MAX
+    take the dense one-hot kernel path — matmul on the MXU instead of
+    XLA scatter, which executes serially on both CPU and TPU."""
+    cap = 8
+    while cap < n:
+        cap <<= 1
+    return cap
 
 
 class GroupKeyEncoder:
@@ -86,10 +99,19 @@ class GroupKeyEncoder:
                 rows.append(np.where(v, c, 0).astype(np.int64))
                 rows.append((~v).astype(np.int64))
         stacked = np.stack(rows)  # (2K, n)
-        uniq, inv = np.unique(stacked, axis=1, return_inverse=True)
-        lut = np.empty(uniq.shape[1], dtype=np.int32)
-        for j in range(uniq.shape[1]):
-            key = tuple(uniq[:, j].tolist())
+        # Fast path: pack the key tuple into one int64 (mixed radix), so
+        # uniquing is a single 1-D sort instead of np.unique(axis=1)'s
+        # structured-view argsort (~40x slower).
+        packed = self._pack(stacked)
+        if packed is not None:
+            _, first, inv = np.unique(packed, return_index=True, return_inverse=True)
+        else:
+            _, first, inv = np.unique(
+                stacked, axis=1, return_index=True, return_inverse=True
+            )
+        lut = np.empty(len(first), dtype=np.int32)
+        for j, row_idx in enumerate(first):
+            key = tuple(stacked[:, row_idx].tolist())
             gid = self.key_to_id.get(key)
             if gid is None:
                 gid = len(self.keys)
@@ -97,6 +119,27 @@ class GroupKeyEncoder:
                 self.keys.append(key)
             lut[j] = gid
         return lut[inv].astype(np.int32)
+
+    @staticmethod
+    def _pack(stacked: np.ndarray) -> Optional[np.ndarray]:
+        """Mixed-radix pack of (2K, n) int64 key parts into (n,) int64;
+        None when the combined range could overflow 63 bits."""
+        mins = stacked.min(axis=1).tolist()
+        maxs = stacked.max(axis=1).tolist()
+        # ranges in Python ints: a single int64 column can span > 2^63,
+        # which would wrap (and slip past the bail-out) in int64 math
+        ranges = [int(mx) - int(mn) + 1 for mn, mx in zip(mins, maxs)]
+        total = 1
+        for r in ranges:
+            total *= r
+            if total > (1 << 62):
+                return None
+        # total <= 2^62 implies every range (and every shifted value)
+        # fits comfortably in int64
+        packed = np.zeros(stacked.shape[1], dtype=np.int64)
+        for k in range(stacked.shape[0]):
+            packed = packed * np.int64(ranges[k]) + (stacked[k] - np.int64(mins[k]))
+        return packed
 
     def key_column(self, k: int):
         """(values, validity) of key position k across all groups, in
@@ -248,10 +291,17 @@ class AggregateRelation(Relation):
             if pvalid is not None:
                 pv = pv & jnp.broadcast_to(pvalid, (capacity,))
             mask = mask & pv
+
         counts, accs = state
-        counts = counts.at[ids].add(mask.astype(jnp.int64))
-        new_accs = []
-        for s, fn, acc in zip(self.specs, self._arg_fns, accs):
+        group_cap = counts.shape[0]
+        if group_cap <= DENSE_GROUP_MAX:
+            return self._dense_update(env, capacity, mask, ids, counts, accs)
+        return self._scatter_update(env, capacity, mask, ids, counts, accs)
+
+    def _spec_inputs(self, env, capacity, mask):
+        """(value, ok-mask) per spec, masking padding/filtered/null rows."""
+        out = []
+        for s, fn in zip(self.specs, self._arg_fns):
             v, valid = fn(env)
             v = jnp.broadcast_to(v, (capacity,))
             if valid is None or s.count_star:
@@ -259,6 +309,15 @@ class AggregateRelation(Relation):
                 ok = mask
             else:
                 ok = mask & jnp.broadcast_to(valid, (capacity,))
+            out.append((v, ok))
+        return out
+
+    def _scatter_update(self, env, capacity, mask, ids, counts, accs):
+        """General path (group capacity > DENSE_GROUP_MAX): XLA scatter."""
+        counts = counts.at[ids].add(mask.astype(jnp.int64))
+        new_accs = []
+        inputs = self._spec_inputs(env, capacity, mask)
+        for s, (v, ok), acc in zip(self.specs, inputs, accs):
             if s.name in ("sum", "avg"):
                 acc_sum, acc_cnt = acc
                 contrib = jnp.where(ok, v, 0).astype(acc_sum.dtype)
@@ -275,28 +334,88 @@ class AggregateRelation(Relation):
                 new_accs.append(acc.at[ids].max(jnp.where(ok, v.astype(acc.dtype), ident)))
         return counts, tuple(new_accs)
 
+    def _dense_update(self, env, capacity, mask, ids, counts, accs):
+        """Small-group path: segment reduction via a one-hot [rows, G]
+        matrix.  Float sums/counts stack into ONE [rows, S] @ [rows, G]
+        matmul (the MXU's shape); int sums and min/max are fused
+        broadcast-reduces over [rows, G].  No scatter anywhere."""
+        G = counts.shape[0]
+        onehot_b = ids[:, None] == jnp.arange(G, dtype=ids.dtype)[None, :]
+        inputs = self._spec_inputs(env, capacity, mask)
+
+        # -- one matmul for every f64-accumulated slot + all counts --
+        mat_cols = [mask.astype(jnp.float64)]  # row-count column
+        mat_slots: list[tuple] = [("rowcount", None)]
+        for i, (s, (v, ok)) in enumerate(zip(self.specs, inputs)):
+            if s.name in ("sum", "avg") and np.dtype(s.acc_dtype).kind == "f":
+                mat_cols.append(jnp.where(ok, v, 0.0).astype(jnp.float64))
+                mat_slots.append(("sum", i))
+            if s.name in ("sum", "avg", "count"):
+                mat_cols.append(ok.astype(jnp.float64))
+                mat_slots.append(("cnt", i))
+        stacked = jnp.stack(mat_cols, axis=1)  # [rows, S]
+        onehot_f = onehot_b.astype(jnp.float64)
+        sums = stacked.T @ onehot_f  # [S, G]
+
+        new_counts = counts + sums[0].astype(jnp.int64)
+        per_spec_sum: dict[int, jnp.ndarray] = {}
+        per_spec_cnt: dict[int, jnp.ndarray] = {}
+        for row, (kind, i) in enumerate(mat_slots):
+            if kind == "sum":
+                per_spec_sum[i] = sums[row]
+            elif kind == "cnt":
+                per_spec_cnt[i] = sums[row].astype(jnp.int64)
+
+        new_accs = []
+        for i, (s, (v, ok), acc) in enumerate(zip(self.specs, inputs, accs)):
+            if s.name in ("sum", "avg"):
+                acc_sum, acc_cnt = acc
+                if i in per_spec_sum:
+                    contrib = per_spec_sum[i].astype(acc_sum.dtype)
+                else:
+                    # integer sums: exact int64 broadcast-reduce (a f64
+                    # matmul would round above 2^53)
+                    contrib = jnp.sum(
+                        jnp.where(
+                            onehot_b & ok[:, None], v[:, None].astype(acc_sum.dtype), 0
+                        ),
+                        axis=0,
+                    )
+                new_accs.append((acc_sum + contrib, acc_cnt + per_spec_cnt[i]))
+            elif s.name == "count":
+                new_accs.append(acc + per_spec_cnt[i])
+            elif s.name in ("min", "max"):
+                ident = (
+                    _min_identity(np.dtype(acc.dtype))
+                    if s.name == "min"
+                    else _max_identity(np.dtype(acc.dtype))
+                )
+                cell = jnp.where(
+                    onehot_b & ok[:, None], v[:, None].astype(acc.dtype), ident
+                )
+                red = jnp.min(cell, axis=0) if s.name == "min" else jnp.max(cell, axis=0)
+                new_accs.append(
+                    jnp.minimum(acc, red) if s.name == "min" else jnp.maximum(acc, red)
+                )
+        return new_counts, tuple(new_accs)
+
     def accumulate(self):
         """Run the scan, returning the partial-aggregate device state.
 
         Partitioned mode calls this per shard and combines states with
         collectives; single-device mode finalizes it directly.
         """
+        from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.relation import device_scope
+
         state = None
         capacity = 0
         for batch in self.child.batches():
             for idx in self.key_cols:
                 if batch.dicts[idx] is not None:
                     self._key_dicts[idx] = batch.dicts[idx]
-            if self.key_cols:
-                key_cols = [np.asarray(batch.data[idx]) for idx in self.key_cols]
-                key_valids = [
-                    None if batch.validity[idx] is None else np.asarray(batch.validity[idx])
-                    for idx in self.key_cols
-                ]
-                ids_np = self.encoder.encode(key_cols, key_valids)
-            else:
-                ids_np = np.zeros(batch.capacity, dtype=np.int32)
-            needed = bucket_capacity(max(self.encoder.num_groups, 1))
+            ids = self._group_ids(batch)
+            needed = group_capacity(max(self.encoder.num_groups, 1))
             if state is None:
                 capacity = needed
                 state = self._init_state(capacity)
@@ -304,24 +423,57 @@ class AggregateRelation(Relation):
                 state = self._grow_state(state, needed)
                 capacity = needed
             aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
-            from datafusion_tpu.exec.relation import device_scope
-
             with METRICS.timer("execute.aggregate"), device_scope(self.device):
+                data, validity, mask = device_inputs(batch, self.device)
                 state = self._jit(
-                    tuple(batch.data),
-                    tuple(batch.validity),
+                    data,
+                    validity,
                     tuple(aux),
                     np.int32(batch.num_rows),
-                    batch.mask,
-                    jnp.asarray(ids_np),
+                    mask,
+                    ids,
                     state,
                 )
         if state is None:
-            state = self._init_state(bucket_capacity(1))
+            state = self._init_state(group_capacity(1))
         return state
+
+    def _group_ids(self, batch: RecordBatch):
+        """Device array of dense group ids for one batch; cached on the
+        batch (keyed by this relation's encoder) so re-scanned in-memory
+        batches skip both the host encode and the H2D transfer."""
+        # single slot per batch (a different query's encoder overwrites
+        # it) so long-lived in-memory batches hold at most one ids array,
+        # not one per query ever run; the entry pins the encoder so the
+        # identity check can't hit a recycled object
+        hit = batch.cache.get("group_ids")
+        if hit is not None and hit[0] is self.encoder:
+            return hit[1]
+        if self.key_cols:
+            key_cols = [np.asarray(batch.data[idx]) for idx in self.key_cols]
+            key_valids = [
+                None if batch.validity[idx] is None else np.asarray(batch.validity[idx])
+                for idx in self.key_cols
+            ]
+            ids_np = self.encoder.encode(key_cols, key_valids)
+        else:
+            ids_np = np.zeros(batch.capacity, dtype=np.int32)
+        ids = (
+            jax.device_put(ids_np, self.device)
+            if self.device is not None
+            else jnp.asarray(ids_np)
+        )
+        batch.cache["group_ids"] = (self.encoder, ids)
+        return ids
 
     def finalize(self, state) -> RecordBatch:
         counts, accs = state
+        # kick off every D2H copy concurrently before the first blocking
+        # np.asarray: on high-latency links (tunneled/remote devices) the
+        # per-transfer latencies overlap instead of serializing
+        for leaf in jax.tree.leaves(state):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
         counts = np.asarray(counts)
         if self.key_cols:
             n_groups = self.encoder.num_groups

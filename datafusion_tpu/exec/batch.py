@@ -140,7 +140,8 @@ class RecordBatch:
     columns store int32 codes and their StringDictionary in `dicts[i]`.
     """
 
-    __slots__ = ("schema", "data", "validity", "dicts", "num_rows", "mask")
+    __slots__ = ("schema", "data", "validity", "dicts", "num_rows", "mask",
+                 "cache", "__weakref__")
 
     def __init__(
         self,
@@ -157,6 +158,9 @@ class RecordBatch:
         self.dicts = dicts if dicts is not None else [None] * len(data)
         self.num_rows = num_rows if num_rows is not None else (len(data[0]) if data else 0)
         self.mask = mask
+        # derived-value cache (device copies, group ids); dies with the
+        # batch, so streaming scans don't accumulate state
+        self.cache: dict = {}
 
     @property
     def num_columns(self) -> int:
@@ -168,6 +172,25 @@ class RecordBatch:
 
     def column(self, i: int):
         return self.data[i]
+
+
+def device_inputs(batch: RecordBatch, device=None):
+    """(data, validity, mask) as device-resident arrays, cached on the
+    batch: a re-scanned in-memory batch transfers H2D once, not per
+    query run (transfer latency dominates on tunneled/remote devices)."""
+    import jax
+
+    key = ("device", None if device is None else repr(device))
+    hit = batch.cache.get(key)
+    if hit is not None:
+        return hit
+    put = (lambda a: jax.device_put(a, device)) if device is not None else jax.device_put
+    data = tuple(put(c) for c in batch.data)
+    validity = tuple(None if v is None else put(v) for v in batch.validity)
+    mask = None if batch.mask is None else put(batch.mask)
+    out = (data, validity, mask)
+    batch.cache[key] = out
+    return out
 
 
 def pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:

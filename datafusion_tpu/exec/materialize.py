@@ -23,6 +23,11 @@ def compact_batch(batch: RecordBatch):
     dictionary-coded.
     """
     n = batch.num_rows
+    # overlap D2H latencies: start all copies before the first blocking
+    # np.asarray (matters on tunneled/remote devices)
+    for arr in (*batch.data, *batch.validity, batch.mask):
+        if hasattr(arr, "copy_to_host_async"):
+            arr.copy_to_host_async()
     live: Optional[np.ndarray] = None
     if batch.mask is not None:
         live = np.asarray(batch.mask)[: batch.capacity]

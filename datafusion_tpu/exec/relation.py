@@ -143,15 +143,18 @@ class PipelineRelation(Relation):
         return out_cols, out_valids, mask
 
     def batches(self) -> Iterator[RecordBatch]:
+        from datafusion_tpu.exec.batch import device_inputs
+
         for batch in self.child.batches():
             aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
             with METRICS.timer("execute.pipeline"), device_scope(self.device):
+                data, validity, mask_in = device_inputs(batch, self.device)
                 cols, valids, mask = self._jit(
-                    tuple(batch.data),
-                    tuple(batch.validity),
+                    data,
+                    validity,
                     tuple(aux),
                     np.int32(batch.num_rows),
-                    batch.mask,
+                    mask_in,
                 )
             if self._proj_fns is None:
                 dicts = batch.dicts

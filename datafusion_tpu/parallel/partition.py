@@ -58,7 +58,7 @@ def shard_map(f, mesh, in_specs, out_specs):
 
 from datafusion_tpu.datatypes import Schema
 from datafusion_tpu.errors import ExecutionError, PlanError
-from datafusion_tpu.exec.aggregate import AggregateRelation
+from datafusion_tpu.exec.aggregate import AggregateRelation, group_capacity
 from datafusion_tpu.exec.batch import RecordBatch, bucket_capacity
 from datafusion_tpu.exec.context import ExecutionContext
 from datafusion_tpu.exec.datasource import (
@@ -112,6 +112,9 @@ def _share_dictionaries(partitions: Sequence[DataSource]) -> None:
                         np.asarray(b.data[i]), d.values
                     )
                     b.dicts[i] = shared
+                    # device copies / group ids derived from the old
+                    # codes are now stale
+                    b.cache.clear()
         return
     raise ExecutionError(
         "cannot make string dictionaries consistent across mixed partition "
@@ -297,7 +300,7 @@ class PartitionedAggregateRelation(AggregateRelation):
         in_schema = self.child.schema
         n_cols = len(in_schema)
         state = None
-        group_capacity = 0
+        group_cap = 0
 
         while True:
             round_batches = [f.next_batch() for f in feeds]
@@ -340,13 +343,13 @@ class PartitionedAggregateRelation(AggregateRelation):
                     ]
                     ids_np[s_i, :bc] = self.encoder.encode(key_cols, key_valids)
 
-            needed = bucket_capacity(max(self.encoder.num_groups, 1))
+            needed = group_capacity(max(self.encoder.num_groups, 1))
             if state is None:
-                group_capacity = needed
-                state = self._init_stacked_state(group_capacity)
-            elif needed > group_capacity:
+                group_cap = needed
+                state = self._init_stacked_state(group_cap)
+            elif needed > group_cap:
                 state = self._grow_stacked_state(state, needed)
-                group_capacity = needed
+                group_cap = needed
 
             # aux tables derive from the (shared) dictionaries; compute
             # after all shards' rows are encoded so versions are current
@@ -367,7 +370,7 @@ class PartitionedAggregateRelation(AggregateRelation):
                 )
 
         if state is None:
-            state = self._init_stacked_state(bucket_capacity(1))
+            state = self._init_stacked_state(group_capacity(1))
         with METRICS.timer("execute.collective_combine"):
             return self._combine_jit(state)
 

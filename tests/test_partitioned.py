@@ -232,3 +232,47 @@ class TestPhysicalPlanParity:
             assert rt.kind == pp.kind
             assert rt.filename == pp.filename
             assert rt.count == pp.count
+
+
+class TestCacheConsistency:
+    def test_pack_overflow_keeps_groups_distinct(self):
+        """Mixed-radix pack must bail (not wrap) when an int64 key spans
+        more than 63 bits."""
+        from datafusion_tpu.exec.aggregate import GroupKeyEncoder
+
+        enc = GroupKeyEncoder(2)
+        k0 = np.asarray([-(2**62), 2**62, -(2**62), 2**62], dtype=np.int64)
+        k1 = np.asarray([0, 0, 1, 1], dtype=np.int64)
+        ids = enc.encode([k0, k1], [None, None])
+        assert len(set(ids.tolist())) == 4
+
+    def test_merge_codes_invalidates_device_cache(self):
+        """A query before partitioned registration must not leave stale
+        device copies of pre-merge dict codes."""
+        from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema(
+            [Field("s", DataType.UTF8, False), Field("v", DataType.INT64, False)]
+        )
+
+        def mem(strings, vals):
+            d = StringDictionary()
+            codes = d.encode(strings)
+            return MemoryDataSource(
+                schema,
+                [make_host_batch(schema, [codes, np.asarray(vals, np.int64)],
+                                 [None, None], [d, None])],
+            )
+
+        p0 = mem(["a", "b"], [1, 1])
+        p1 = mem(["b", "a"], [1, 1])  # opposite code order
+        ctx = ExecutionContext()
+        ctx.register_datasource("t0", p1)
+        # populate p1's device cache with pre-merge codes
+        before = ctx.sql_collect("SELECT SUM(v) FROM t0 WHERE s = 'b'")
+        assert before.to_rows() == [(1,)]
+        pctx = PartitionedContext(mesh=make_mesh(2))
+        pctx.register_datasource("t", PartitionedDataSource([p0, p1]))
+        after = pctx.sql_collect("SELECT SUM(v) FROM t WHERE s = 'b'")
+        assert after.to_rows() == [(2,)]
