@@ -124,7 +124,15 @@ def main():
     cpu_p50, cpu_table = bench_device("cpu", src, ROWS)
     if has_tpu:
         dev_p50, dev_table = bench_device("tpu", src, ROWS)
-        assert sorted(dev_table.to_rows()) == sorted(cpu_table.to_rows()) or True
+        got = sorted(dev_table.to_rows())
+        want = sorted(cpu_table.to_rows())
+        assert len(got) == len(want), f"group count differs: {len(got)} vs {len(want)}"
+        for g, w in zip(got, want):
+            assert g[:2] == w[:2], f"group keys differ: {g[:2]} vs {w[:2]}"
+            np.testing.assert_allclose(
+                np.asarray(g[2:], float), np.asarray(w[2:], float), rtol=1e-9,
+                err_msg=f"TPU/CPU aggregate mismatch for group {g[:2]}",
+            )
     else:
         dev_p50 = cpu_p50
 

@@ -1,0 +1,147 @@
+"""`tpusql` console: the reference's `console` binary rebuilt.
+
+Mirrors `src/bin/console/{main.rs,linereader.rs}`: a banner, script mode
+(`--script file.sql`, statements accumulate until `;`), an interactive
+REPL with `datafusion>` / `>` continuation prompts and `quit`/`exit`,
+per-query wall-clock timing — plus the parts the reference's rewrite
+had lost: DDL execution, result-row printing (`main.rs:145-148`
+computed elapsed but printed nothing), and the `ST_Point`/`ST_AsText`
+geo UDFs the golden smoketest expects
+(`test/data/smoketest-expected.txt`; UDF registration was commented out
+at `main.rs:123-125`).
+
+Run: ``python -m datafusion_tpu.cli [--script FILE] [--device cpu|tpu]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def _fmt_float(v: float) -> str:
+    """Shortest round-trip decimal (matches the golden output's
+    `52.412811`, `0.10231` style)."""
+    return repr(float(v))
+
+
+def make_context(device: Optional[str] = None, batch_size: int = 131072):
+    """An ExecutionContext with the console's geo UDFs registered."""
+    from datafusion_tpu.datatypes import DataType, Field, StructType
+    from datafusion_tpu.exec.context import ExecutionContext
+
+    ctx = ExecutionContext(device=device, batch_size=batch_size)
+
+    point_t = StructType(
+        [Field("x", DataType.FLOAT64, False), Field("y", DataType.FLOAT64, False)]
+    )
+
+    def st_point(x, y):
+        return (np.asarray(x, np.float64), np.asarray(y, np.float64))
+
+    def st_astext(pt):
+        x, y = pt
+        return np.asarray(
+            [f"POINT ({_fmt_float(a)} {_fmt_float(b)})" for a, b in zip(x, y)],
+            dtype=object,
+        )
+
+    ctx.register_udf(
+        "ST_Point", [DataType.FLOAT64, DataType.FLOAT64], point_t, host_fn=st_point
+    )
+    ctx.register_udf("ST_AsText", [point_t], DataType.UTF8, host_fn=st_astext)
+    return ctx
+
+
+class Console:
+    """Statement executor (reference `Console`, main.rs:113-153)."""
+
+    def __init__(self, ctx, out=None):
+        self.ctx = ctx
+        self.out = out if out is not None else sys.stdout
+
+    def _print(self, *a):
+        print(*a, file=self.out)
+
+    def execute(self, sql: str) -> None:
+        sql = sql.strip().rstrip(";").strip()
+        if not sql:
+            return
+        self._print("Executing query ...")
+        t0 = time.perf_counter()
+        try:
+            result = self.ctx.sql_collect(sql)
+        except Exception as e:  # errors print, the console survives
+            self._print(f"Error: {e}")
+            return
+        elapsed = time.perf_counter() - t0
+        from datafusion_tpu.exec.materialize import ResultTable
+
+        if isinstance(result, ResultTable):
+            for row in result.to_rows():
+                self._print(
+                    "\t".join("NULL" if v is None else str(v) for v in row)
+                )
+        # "seconds" keeps this line inside the golden diff's -I filter
+        self._print(f"Query executed in {elapsed:.3f} seconds")
+
+
+def run_script(console: Console, path: str) -> None:
+    """Accumulate lines until ';', then execute (main.rs:41-63)."""
+    with open(path, "r", encoding="utf-8") as f:
+        buf = ""
+        for line in f:
+            buf += line
+            while ";" in buf:
+                stmt, buf = buf.split(";", 1)
+                console.execute(stmt)
+        if buf.strip():
+            console.execute(buf)
+
+
+def run_interactive(console: Console) -> None:
+    """REPL with continuation prompts (linereader.rs:47-103)."""
+    buf = ""
+    while True:
+        prompt = "datafusion> " if not buf else "> "
+        try:
+            line = input(prompt)
+        except (EOFError, KeyboardInterrupt):
+            print()
+            return
+        if not buf and line.strip().lower() in ("quit", "exit"):
+            return
+        buf += line + "\n"
+        while ";" in buf:
+            stmt, buf = buf.split(";", 1)
+            console.execute(stmt)
+        if not buf.strip():
+            buf = ""  # whitespace-only leftover must not hold the '>' prompt
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tpusql", description="DataFusion-TPU SQL console"
+    )
+    parser.add_argument("--script", help="execute commands from file, then exit")
+    parser.add_argument(
+        "--device", default=None, help="execution device (cpu / tpu; default: auto)"
+    )
+    parser.add_argument("--batch-size", type=int, default=131072)
+    args = parser.parse_args(argv)
+
+    print("DataFusion Console")
+    console = Console(make_context(args.device, args.batch_size))
+    if args.script:
+        run_script(console, args.script)
+    else:
+        run_interactive(console)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

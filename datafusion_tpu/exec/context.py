@@ -159,16 +159,25 @@ class ExecutionContext:
         name: str,
         arg_types: list[DataType],
         return_type: DataType,
-        jax_fn: Callable,
+        jax_fn: Optional[Callable] = None,
+        host_fn: Optional[Callable] = None,
     ) -> None:
-        """Register a scalar UDF.  The function must be jax-traceable —
-        it fuses into the pipeline kernel like any builtin."""
+        """Register a scalar UDF.
+
+        `jax_fn` must be jax-traceable — it fuses into the pipeline
+        kernel like any builtin.  `host_fn` (numpy in/out) is for
+        functions with no tensor form (string/struct producers, e.g.
+        the console's ST_* geo functions); those evaluate post-kernel
+        at the materialization boundary."""
+        if jax_fn is None and host_fn is None:
+            raise ExecutionError(f"UDF {name!r} needs a jax_fn or a host_fn")
         meta = FunctionMeta(
             name.lower(),
             [Field(f"arg{i}", t, True) for i, t in enumerate(arg_types)],
             return_type,
             FunctionType.Scalar,
             jax_fn,
+            host_fn,
         )
         self.functions[name.lower()] = meta
 
@@ -252,10 +261,12 @@ class ExecutionContext:
                 return PipelineRelation(
                     child, plan.input.expr, plan.expr, plan.schema,
                     functions=fns, device=self.device,
+                    function_metas=self.functions,
                 )
             return PipelineRelation(
                 self.execute(plan.input), None, plan.expr, plan.schema,
                 functions=fns, device=self.device,
+                function_metas=self.functions,
             )
         if isinstance(plan, Aggregate):
             # fuse Aggregate(Selection(x)) into one kernel

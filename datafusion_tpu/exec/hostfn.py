@@ -1,0 +1,139 @@
+"""Host-side expression evaluation for functions with no tensor form.
+
+Some scalar UDFs produce values XLA cannot represent — strings (the
+pre-rewrite reference console's `ST_AsText`) or structs (`ST_Point`;
+smoketest golden output `test/data/smoketest-expected.txt`).  Such
+functions register a `FunctionMeta.host_fn` (numpy in/out) instead of a
+`jax_fn`, and any projection expression containing one is evaluated
+here, on the host, against the input batch — after the fused device
+kernel has handled the predicate and the device-computable projections.
+
+Values flow as numpy arrays; struct values as tuples of numpy arrays;
+Utf8 results as object arrays of python strings (dictionary-encoded at
+the operator boundary).  Validity propagates like the device compiler's
+(`None` = all valid; binary ops AND their inputs' validity).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType
+from datafusion_tpu.errors import ExecutionError, NotSupportedError
+from datafusion_tpu.exec.batch import RecordBatch
+from datafusion_tpu.plan.expr import (
+    BinaryExpr,
+    Cast,
+    Column,
+    Expr,
+    FunctionMeta,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Operator,
+    ScalarFunction,
+)
+
+
+def contains_host_fn(expr: Expr, metas: dict[str, FunctionMeta]) -> bool:
+    """True if any function in the tree only has a host implementation."""
+    if isinstance(expr, ScalarFunction):
+        fm = metas.get(expr.name.lower())
+        if fm is not None and fm.jax_fn is None and fm.host_fn is not None:
+            return True
+        return any(contains_host_fn(a, metas) for a in expr.args)
+    for attr in ("expr", "left", "right"):
+        child = getattr(expr, attr, None)
+        if isinstance(child, Expr) and contains_host_fn(child, metas):
+            return True
+    return False
+
+
+def _and_valid(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+_NUMPY_OPS = {
+    Operator.Plus: np.add,
+    Operator.Minus: np.subtract,
+    Operator.Multiply: np.multiply,
+    Operator.Eq: np.equal,
+    Operator.NotEq: np.not_equal,
+    Operator.Lt: np.less,
+    Operator.LtEq: np.less_equal,
+    Operator.Gt: np.greater,
+    Operator.GtEq: np.greater_equal,
+    Operator.And: np.logical_and,
+    Operator.Or: np.logical_or,
+    Operator.Modulus: np.mod,
+}
+
+
+def eval_host_expr(
+    expr: Expr, batch: RecordBatch, metas: dict[str, FunctionMeta]
+):
+    """Evaluate `expr` against a host batch.
+
+    Returns (value, validity): value is a numpy array (object array of
+    str for Utf8 results), a tuple of arrays for struct results, or a
+    scalar for literals; validity is a bool array or None.
+    """
+    if isinstance(expr, Column):
+        i = expr.index
+        col = np.asarray(batch.data[i])
+        if batch.schema.field(i).data_type == DataType.UTF8:
+            d = batch.dicts[i]
+            if d is not None:
+                col = d.decode(col)
+        v = batch.validity[i]
+        return col, (None if v is None else np.asarray(v))
+    if isinstance(expr, Literal):
+        if expr.value.is_null:
+            return np.zeros((), np.int64), np.zeros(batch.capacity, bool)
+        return expr.value.value, None
+    if isinstance(expr, Cast):
+        v, valid = eval_host_expr(expr.expr, batch, metas)
+        return np.asarray(v).astype(expr.data_type.np_dtype), valid
+    if isinstance(expr, IsNull):
+        _, valid = eval_host_expr(expr.expr, batch, metas)
+        if valid is None:
+            return np.zeros(batch.capacity, bool), None
+        return ~valid, None
+    if isinstance(expr, IsNotNull):
+        _, valid = eval_host_expr(expr.expr, batch, metas)
+        if valid is None:
+            return np.ones(batch.capacity, bool), None
+        return valid, None
+    if isinstance(expr, BinaryExpr):
+        lv, lvalid = eval_host_expr(expr.left, batch, metas)
+        rv, rvalid = eval_host_expr(expr.right, batch, metas)
+        if expr.op == Operator.Divide:
+            out_int = expr.get_type(batch.schema).is_integer
+            with np.errstate(divide="ignore", invalid="ignore"):
+                val = (
+                    np.floor_divide(lv, rv) if out_int else np.true_divide(lv, rv)
+                )
+            return val, _and_valid(lvalid, rvalid)
+        op = _NUMPY_OPS.get(expr.op)
+        if op is None:
+            raise NotSupportedError(f"host eval of operator {expr.op!r}")
+        return op(lv, rv), _and_valid(lvalid, rvalid)
+    if isinstance(expr, ScalarFunction):
+        fm = metas.get(expr.name.lower())
+        args = [eval_host_expr(a, batch, metas) for a in expr.args]
+        vals = [a[0] for a in args]
+        valid = None
+        for _, av in args:
+            valid = _and_valid(valid, av)
+        if fm is not None and fm.host_fn is not None:
+            return fm.host_fn(*vals), valid
+        if fm is not None and fm.jax_fn is not None:
+            return np.asarray(fm.jax_fn(*vals)), valid
+        raise ExecutionError(f"no implementation for function {expr.name!r}")
+    raise NotSupportedError(f"host eval of expression {expr!r}")

@@ -76,21 +76,37 @@ class PipelineRelation(Relation):
         out_schema: Optional[Schema] = None,
         functions: Optional[dict[str, Callable]] = None,
         device=None,
+        function_metas=None,
     ):
+        from datafusion_tpu.exec.hostfn import contains_host_fn
+
         self.child = child
         self.predicate = predicate
         self.projections = projections
         self._schema = out_schema if out_schema is not None else child.schema
         self.device = device
+        self._metas = function_metas or {}
         in_schema = child.schema
 
         compiler = ExprCompiler(in_schema, functions)
+        if predicate is not None and contains_host_fn(predicate, self._metas):
+            raise NotSupportedError(
+                "host-only functions are not supported in WHERE predicates"
+            )
         self._pred_fn = compiler.compile(predicate) if predicate is not None else None
-        self._proj_fns = (
-            [compiler.compile(e) for e in projections]
-            if projections is not None
-            else None
-        )
+        # projections containing host-only functions (string/struct
+        # producers) are evaluated post-kernel against the input batch
+        self._host_proj: dict[int, Expr] = {}
+        self._host_dicts: dict[int, "StringDictionary"] = {}
+        self._proj_fns = None
+        if projections is not None:
+            self._proj_fns = []
+            for j, e in enumerate(projections):
+                if contains_host_fn(e, self._metas):
+                    self._host_proj[j] = e
+                    self._proj_fns.append(None)
+                else:
+                    self._proj_fns.append(compiler.compile(e))
         self._aux_specs = compiler.aux_specs
         self._aux_cache: dict = {}
         # map projection outputs to source dictionaries (Utf8 passthrough)
@@ -135,6 +151,8 @@ class PipelineRelation(Relation):
             return list(cols), list(valids), mask
         out_cols, out_valids = [], []
         for f in self._proj_fns:
+            if f is None:  # host-evaluated projection: filled in post-kernel
+                continue
             v, valid = f(env)
             out_cols.append(jnp.broadcast_to(v, (capacity,)))
             out_valids.append(
@@ -163,6 +181,10 @@ class PipelineRelation(Relation):
                     batch.dicts[src] if src is not None else None
                     for src in self._out_dict_sources
                 ]
+            if self._host_proj:
+                cols, valids, dicts = self._merge_host_projections(
+                    batch, list(cols), list(valids), list(dicts)
+                )
             yield RecordBatch(
                 self._schema,
                 list(cols),
@@ -171,3 +193,37 @@ class PipelineRelation(Relation):
                 num_rows=batch.num_rows,
                 mask=mask,
             )
+
+    def _merge_host_projections(self, batch, dev_cols, dev_valids, dicts):
+        """Interleave post-kernel host-evaluated projections (string /
+        struct producers) with the device kernel's outputs."""
+        from datafusion_tpu.exec.batch import StringDictionary
+        from datafusion_tpu.exec.hostfn import eval_host_expr
+
+        cols, valids = [], []
+        dev_i = 0
+        for j in range(len(self.projections)):
+            host_expr = self._host_proj.get(j)
+            if host_expr is None:
+                cols.append(dev_cols[dev_i])
+                valids.append(dev_valids[dev_i])
+                dev_i += 1
+                continue
+            v, valid = eval_host_expr(host_expr, batch, self._metas)
+            if self._schema.field(j).data_type == DataType.UTF8:
+                d = self._host_dicts.get(j)
+                if d is None:
+                    d = self._host_dicts[j] = StringDictionary()
+                v = d.encode(list(np.asarray(v, dtype=object)))
+                dicts[j] = d
+            elif isinstance(v, tuple):
+                raise NotSupportedError(
+                    "struct-valued projections cannot be materialized; wrap "
+                    "them in a function returning a primitive (e.g. ST_AsText)"
+                )
+            v = np.broadcast_to(np.asarray(v), (batch.capacity,))
+            cols.append(v)
+            valids.append(
+                None if valid is None else np.broadcast_to(valid, (batch.capacity,))
+            )
+        return cols, valids, dicts

@@ -578,7 +578,7 @@ class FunctionMeta:
     function so it fuses into the operator pipeline kernel.
     """
 
-    __slots__ = ("name", "args", "return_type", "function_type", "jax_fn")
+    __slots__ = ("name", "args", "return_type", "function_type", "jax_fn", "host_fn")
 
     def __init__(
         self,
@@ -587,12 +587,18 @@ class FunctionMeta:
         return_type: DataType,
         function_type: FunctionType,
         jax_fn: Optional[Callable] = None,
+        host_fn: Optional[Callable] = None,
     ):
         self.name = name
         self.args = list(args)
         self.return_type = return_type
         self.function_type = function_type
         self.jax_fn = jax_fn
+        # host_fn: a numpy-columns-in / numpy-column-out implementation
+        # for functions with no tensor form (string producers, struct
+        # builders — e.g. the console's ST_Point/ST_AsText geo UDFs);
+        # evaluated post-kernel at the materialization boundary
+        self.host_fn = host_fn
 
 
 # -- output-field naming (reference expr_to_field, sqlplanner.rs:376-406) --
