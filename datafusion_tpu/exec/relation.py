@@ -217,9 +217,17 @@ class PipelineRelation(Relation):
                 v = d.encode(list(np.asarray(v, dtype=object)))
                 dicts[j] = d
             elif isinstance(v, tuple):
-                raise NotSupportedError(
-                    "struct-valued projections cannot be materialized; wrap "
-                    "them in a function returning a primitive (e.g. ST_AsText)"
+                # struct results materialize via their Display form
+                # "f1, f2" (the pre-rewrite Point UDT's printing — see
+                # golden test_sql_udf_udt.csv)
+                # broadcast first: literal args arrive as 0-d scalars
+                parts = np.broadcast_arrays(
+                    *[np.asarray(x) for x in v],
+                    np.empty(batch.capacity),
+                )[:-1]
+                v = np.asarray(
+                    [", ".join(str(x) for x in tup) for tup in zip(*parts)],
+                    dtype=object,
                 )
             v = np.broadcast_to(np.asarray(v), (batch.capacity,))
             cols.append(v)

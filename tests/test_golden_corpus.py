@@ -1,0 +1,456 @@
+"""Legacy golden-corpus conformance tests.
+
+`test/data/expected/` holds 66 golden outputs from the pre-rewrite
+reference engine (SURVEY §4: "a ready-made conformance suite the
+rebuild can re-attach"); the rewrite never re-attached them and the
+defining test sources are not in the v0.5.1 snapshot.  The queries
+below were reconstructed by matching each golden file against the
+fixture data (`all_types_flat.csv/parquet`, `numerics.csv`,
+`null_test.csv`, `uk_cities.csv`).
+
+Comparison is type-aware: float fields compare by parsed value
+(tolerating shortest-repr formatting differences between engines),
+ints/bools/strings compare exactly.
+
+Excluded goldens, with reasons:
+- c_int8_{eq,gt,gteq,lt,lteq,col_eq,scalar_gt}.csv are EMPTY — the
+  pre-rewrite engine returned no rows for int8-vs-literal ordered
+  comparisons (its noteq golden proves the data has matching rows, so
+  these are artifacts of a reference bug, not a spec).
+- aggregate goldens' MIN/MAX(c_utf8) fields: the golden prints the
+  same string for both min and max per group — another pre-rewrite
+  artifact; the numeric fields of those rows ARE asserted.
+- parquet aggregate SUM(c_int32)/SUM(c_int64): golden values reflect
+  the reference's 32/64-bit overflow behavior (c_int32 sum shows
+  i32::MAX); this engine accumulates in 64-bit.
+- test_sqrt/test_limit use a 1..10 integer table absent from the
+  fixtures; rebuilt in-memory with the same values.
+- test_df_udf_udt is the DataFrame-API twin of test_sql_udf_udt (same
+  golden), asserted through the SQL path.
+"""
+
+import csv
+import math
+import os
+
+import numpy as np
+import pytest
+
+from datafusion_tpu import DataType, Field, Schema
+from datafusion_tpu.exec.context import ExecutionContext
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "test", "data")
+EXPECTED = os.path.join(DATA, "expected")
+
+ALL_TYPES_SCHEMA = Schema(
+    [
+        Field("c_bool", DataType.BOOLEAN, False),
+        Field("c_uint8", DataType.UINT8, False),
+        Field("c_uint16", DataType.UINT16, False),
+        Field("c_uint32", DataType.UINT32, False),
+        Field("c_uint64", DataType.UINT64, False),
+        Field("c_int8", DataType.INT8, False),
+        Field("c_int16", DataType.INT16, False),
+        Field("c_int32", DataType.INT32, False),
+        Field("c_int64", DataType.INT64, False),
+        Field("c_float32", DataType.FLOAT32, False),
+        Field("c_float64", DataType.FLOAT64, False),
+        Field("c_utf8", DataType.UTF8, False),
+    ]
+)
+
+NULL_TEST_SCHEMA = Schema(
+    [
+        Field("c_int", DataType.INT32, True),
+        Field("c_float", DataType.FLOAT32, True),
+        Field("c_string", DataType.UTF8, True),
+        Field("c_bool", DataType.BOOLEAN, True),
+    ]
+)
+
+NUMERICS_SCHEMA = Schema(
+    [
+        Field("a", DataType.INT64, False),
+        Field("b", DataType.INT64, False),
+        Field("a_f", DataType.FLOAT32, False),
+        Field("b_f", DataType.FLOAT32, False),
+    ]
+)
+
+UK_SCHEMA = Schema(
+    [
+        Field("city", DataType.UTF8, False),
+        Field("lat", DataType.FLOAT64, False),
+        Field("lng", DataType.FLOAT64, False),
+    ]
+)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    c = ExecutionContext(batch_size=4096)
+    c.register_csv("all_types", os.path.join(DATA, "all_types_flat.csv"),
+                   ALL_TYPES_SCHEMA, has_header=False)
+    c.register_parquet("all_types_pq", os.path.join(DATA, "all_types_flat.parquet"))
+    c.register_csv("null_test", os.path.join(DATA, "null_test.csv"),
+                   NULL_TEST_SCHEMA, has_header=True)
+    c.register_csv("numerics", os.path.join(DATA, "numerics.csv"),
+                   NUMERICS_SCHEMA, has_header=True)
+    c.register_csv("uk_cities", os.path.join(DATA, "uk_cities.csv"),
+                   UK_SCHEMA, has_header=False)
+    return c
+
+
+def golden_lines(name):
+    with open(os.path.join(EXPECTED, name), encoding="utf-8") as f:
+        return [l for l in f.read().splitlines() if l != ""]
+
+
+def _parse_field(s: str):
+    s = s.strip()
+    if s in ("true", "false"):
+        return s == "true"
+    try:
+        return int(s)
+    except ValueError:
+        pass
+    try:
+        return float(s)
+    except ValueError:
+        return s
+
+
+def _value(v):
+    if v is None:
+        return None
+    if isinstance(v, (np.bool_, bool)):
+        return bool(v)
+    if isinstance(v, (np.integer, int)):
+        return int(v)
+    if isinstance(v, (np.floating, float)):
+        return float(v)
+    return str(v)
+
+
+def _eq(got, want):
+    if isinstance(want, float) or isinstance(got, float):
+        g, w = float(got), float(want)
+        if math.isnan(g) and math.isnan(w):
+            return True
+        # shortest-repr differences between engines: compare values,
+        # tolerating one half-ulp of float32 for f32-printed fields
+        return math.isclose(g, w, rel_tol=1e-6, abs_tol=1e-9)
+    return got == want
+
+
+def assert_rows_match(table, name, left_fields=None, right_fields=0, ncols=None):
+    """Compare engine output against a golden file.
+
+    Golden rows are unquoted comma-joins, so utf8 fields may contain
+    commas: `left_fields` takes that many fields from the left and
+    `right_fields` from the right of each golden line, skipping the
+    middle; None compares every field (only safe when the final column
+    is the sole utf8 one, handled via maxsplit).
+    """
+    rows = table.to_rows()
+    want = golden_lines(name)
+    assert len(rows) == len(want), f"{name}: {len(rows)} rows vs golden {len(want)}"
+    for row, line in zip(rows, want):
+        if left_fields is None:
+            n = ncols if ncols is not None else len(row)
+            fields = line.split(",", n - 1)
+            got_vals = [_value(v) for v in row]
+        else:
+            parts = line.split(",")
+            fields = parts[:left_fields] + (
+                parts[len(parts) - right_fields:] if right_fields else []
+            )
+            got_vals = [_value(v) for v in row[: left_fields]] + (
+                [_value(v) for v in row[len(row) - right_fields:]]
+                if right_fields
+                else []
+            )
+        assert len(got_vals) == len(fields), f"{name}: field count {len(got_vals)} vs {len(fields)}\n{line}"
+        for g, f in zip(got_vals, fields):
+            w = _parse_field(f)
+            assert _eq(g, w), f"{name}: {g!r} != {w!r} in line {line!r}"
+
+
+# ---------------------------------------------------------------- filters --
+
+FILTER_CASES = [
+    # (golden file, SQL)
+    ("c_int8_noteq.csv", "SELECT c_int8 FROM all_types WHERE c_int8 != 0"),
+    ("c_int8_positive.csv", "SELECT c_int8 FROM all_types WHERE c_int8 >= 0"),
+    ("c_int8_negative.csv", "SELECT c_int8 FROM all_types WHERE c_int8 < 0"),
+    ("c_int8_range_inclusive.csv",
+     "SELECT c_int8 FROM all_types WHERE c_int8 >= 2 AND c_int8 <= 100"),
+    ("c_int8_range_exclusive.csv",
+     "SELECT c_int8 FROM all_types WHERE c_int8 > 100"),
+    ("c_int8_col_gt.csv", "SELECT c_int8 FROM all_types WHERE c_int8 > c_int16"),
+    ("c_int8_col_gteq.csv", "SELECT c_int8 FROM all_types WHERE c_int8 >= c_int16"),
+    ("c_int8_col_lt.csv", "SELECT c_int8 FROM all_types WHERE c_int8 < c_int16"),
+    ("c_int8_col_lteq.csv", "SELECT c_int8 FROM all_types WHERE c_int8 <= c_int16"),
+    ("c_int8_col_noteq.csv", "SELECT c_int8 FROM all_types WHERE c_int8 != c_int16"),
+    ("c_int16_positive.csv", "SELECT c_int16 FROM all_types WHERE c_int16 >= 0"),
+    ("c_int16_negative.csv", "SELECT c_int16 FROM all_types WHERE c_int16 < 0"),
+    ("c_int32_positive.csv", "SELECT c_int32 FROM all_types WHERE c_int32 >= 0"),
+    ("c_int32_negative.csv", "SELECT c_int32 FROM all_types WHERE c_int32 < 0"),
+    ("c_int64_positive.csv", "SELECT c_int64 FROM all_types WHERE c_int64 >= 0"),
+    ("c_int64_negative.csv", "SELECT c_int64 FROM all_types WHERE c_int64 < 0"),
+    ("c_float32_high.csv", "SELECT c_float32 FROM all_types WHERE c_float32 > 0.5"),
+    ("c_float32_low.csv", "SELECT c_float32 FROM all_types WHERE c_float32 < 0.5"),
+    ("c_float64_high.csv", "SELECT c_float64 FROM all_types WHERE c_float64 > 0.5"),
+    ("c_float64_low.csv", "SELECT c_float64 FROM all_types WHERE c_float64 < 0.5"),
+]
+
+CAST_CASES = [
+    ("c_int8_cast.csv",
+     "SELECT CAST(c_int8 AS SMALLINT) FROM all_types WHERE c_int8 < 0"),
+    ("c_int16_cast.csv",
+     "SELECT CAST(c_int16 AS INT) FROM all_types WHERE c_int16 < 0"),
+    ("c_int32_cast.csv",
+     "SELECT CAST(c_int32 AS BIGINT) FROM all_types WHERE c_int32 < 0"),
+    ("c_int64_cast.csv",
+     "SELECT c_int64 FROM all_types WHERE c_int64 < 0"),
+    ("c_uint8_cast.csv", "SELECT CAST(c_uint8 AS SMALLINT) FROM all_types"),
+    ("c_uint16_cast.csv", "SELECT CAST(c_uint16 AS INT) FROM all_types"),
+    ("c_uint32_cast.csv", "SELECT CAST(c_uint32 AS BIGINT) FROM all_types"),
+    ("c_uint64_cast.csv", "SELECT c_uint64 FROM all_types"),
+    ("c_float32_cast.csv",
+     "SELECT c_float32 FROM all_types WHERE c_float32 < CAST(0.5 AS FLOAT)"),
+    ("c_float64_cast.csv",
+     "SELECT c_float64 FROM all_types WHERE c_float64 < CAST(0.5 AS DOUBLE)"),
+    # uint32-literal coercion family: predicates true for every row
+    ("c_float32_high_uint32.csv",
+     "SELECT c_float32 FROM all_types WHERE c_float32 > CAST(0 AS INT)"),
+    ("c_float32_low_uint32.csv",
+     "SELECT c_float32 FROM all_types WHERE c_float32 < CAST(1 AS INT)"),
+    ("c_float32_cast_uint32.csv",
+     "SELECT c_float32 FROM all_types WHERE c_float32 <= CAST(1 AS INT)"),
+]
+
+
+class TestFilterGoldens:
+    @pytest.mark.parametrize("name,sql", FILTER_CASES, ids=[c[0] for c in FILTER_CASES])
+    def test_filter(self, ctx, name, sql):
+        assert_rows_match(ctx.sql_collect(sql), name)
+
+    @pytest.mark.parametrize("name,sql", CAST_CASES, ids=[c[0] for c in CAST_CASES])
+    def test_cast(self, ctx, name, sql):
+        assert_rows_match(ctx.sql_collect(sql), name)
+
+    def test_query_all_types(self, ctx):
+        table = ctx.sql_collect(
+            "SELECT c_bool, c_uint8, c_uint16, c_uint32, c_uint64, c_int8, "
+            "c_int16, c_int32, c_int64, c_float32, c_float64, c_utf8 "
+            "FROM all_types WHERE c_float64 < 0.1"
+        )
+        assert_rows_match(table, "csv_query_all_types.csv", ncols=12)
+
+    def test_parquet_query_all_types(self, ctx):
+        table = ctx.sql_collect(
+            "SELECT c_bool, c_uint8, c_uint16, c_uint32, c_uint64, c_int8, "
+            "c_int16, c_int32, c_int64, c_float32, c_float64, c_utf8 "
+            "FROM all_types_pq WHERE c_float64 < 0.1"
+        )
+        assert_rows_match(table, "parquet_query_all_types.csv", ncols=12)
+
+
+# ----------------------------------------------------------------- nulls --
+
+class TestNullGoldens:
+    def test_is_null(self, ctx):
+        assert_rows_match(
+            ctx.sql_collect("SELECT c_int FROM null_test WHERE c_float IS NULL"),
+            "is_null_csv.csv",
+        )
+
+    def test_is_not_null(self, ctx):
+        assert_rows_match(
+            ctx.sql_collect("SELECT c_int FROM null_test WHERE c_float IS NOT NULL"),
+            "is_not_null_csv.csv",
+        )
+
+
+# -------------------------------------------------------------- numerics --
+
+NUMERIC_OPS = [
+    ("numerics_plus.csv", "+"),
+    ("numerics_minus.csv", "-"),
+    ("numerics_multiply.csv", "*"),
+    ("numerics_divide.csv", "/"),
+    ("numerics_modulo.csv", "%"),
+]
+
+
+class TestNumericsGoldens:
+    @pytest.mark.parametrize("name,op", NUMERIC_OPS, ids=[c[0] for c in NUMERIC_OPS])
+    def test_binary_op(self, ctx, name, op):
+        sql = (
+            f"SELECT a {op} b, a {op} 2, a {op} 2.5, "
+            f"a_f {op} b_f, a_f {op} 2, a_f {op} 2.5 FROM numerics"
+        )
+        assert_rows_match(ctx.sql_collect(sql), name)
+
+
+# ------------------------------------------------------------ aggregates --
+
+class TestAggregateGoldens:
+    def test_csv_aggregate_all_types(self, ctx):
+        # golden layout: count, count, then min/max per column in order;
+        # the final MIN/MAX(c_utf8) pair is excluded (pre-rewrite
+        # artifact: golden shows the same string for both)
+        table = ctx.sql_collect(
+            "SELECT COUNT(1), COUNT(c_bool), "
+            "MIN(c_bool), MAX(c_bool), MIN(c_uint8), MAX(c_uint8), "
+            "MIN(c_uint16), MAX(c_uint16), MIN(c_uint32), MAX(c_uint32), "
+            "MIN(c_uint64), MAX(c_uint64), MIN(c_int8), MAX(c_int8), "
+            "MIN(c_int16), MAX(c_int16), MIN(c_int32), MAX(c_int32), "
+            "MIN(c_int64), MAX(c_int64), MIN(c_float32), MAX(c_float32), "
+            "MIN(c_float64), MAX(c_float64) FROM all_types"
+        )
+        assert_rows_match(table, "csv_aggregate_all_types.csv", left_fields=24)
+
+    def test_parquet_aggregate_all_types(self, ctx):
+        # same 24 leading fields, plus the tail of SUMs; SUM(c_int32) and
+        # SUM(c_int64) are excluded (reference overflow artifacts: the
+        # golden's int32 sum is exactly i32::MAX)
+        table = ctx.sql_collect(
+            "SELECT COUNT(1), COUNT(c_bool), "
+            "MIN(c_bool), MAX(c_bool), MIN(c_uint8), MAX(c_uint8), "
+            "MIN(c_uint16), MAX(c_uint16), MIN(c_uint32), MAX(c_uint32), "
+            "MIN(c_uint64), MAX(c_uint64), MIN(c_int8), MAX(c_int8), "
+            "MIN(c_int16), MAX(c_int16), MIN(c_int32), MAX(c_int32), "
+            "MIN(c_int64), MAX(c_int64), MIN(c_float32), MAX(c_float32), "
+            "MIN(c_float64), MAX(c_float64) FROM all_types_pq"
+        )
+        assert_rows_match(table, "parquet_aggregate_all_types.csv", left_fields=24)
+        # narrow-int sums widen via CAST: the reference planner types
+        # SUM(x) as x's type, but the golden's values are the widened
+        # sums (SUM(c_int8) = -169, outside int8)
+        sums = ctx.sql_collect(
+            "SELECT SUM(CAST(c_int8 AS BIGINT)), SUM(CAST(c_int16 AS BIGINT)), "
+            "SUM(CAST(c_uint8 AS INT)), SUM(CAST(c_uint16 AS INT)), "
+            "SUM(CAST(c_uint32 AS BIGINT)), SUM(c_uint64), "
+            "SUM(c_float32), SUM(c_float64) "
+            "FROM all_types_pq"
+        ).to_rows()[0]
+        tail = [_parse_field(f) for f in
+                golden_lines("parquet_aggregate_all_types.csv")[0].split(",")[-10:]]
+        want = [tail[0], tail[1], tail[4], tail[5], tail[6], tail[7], tail[8], tail[9]]
+        for g, w in zip(sums, want):
+            assert _eq(_value(g), w), f"SUM mismatch: {g} vs {w}"
+
+    def test_csv_aggregate_by_c_bool(self, ctx):
+        table = ctx.sql_collect(
+            "SELECT c_bool, MIN(c_uint8), MAX(c_uint8), "
+            "MIN(c_uint16), MAX(c_uint16), MIN(c_uint32), MAX(c_uint32), "
+            "MIN(c_uint64), MAX(c_uint64), MIN(c_int8), MAX(c_int8), "
+            "MIN(c_int16), MAX(c_int16), MIN(c_int32), MAX(c_int32), "
+            "MIN(c_int64), MAX(c_int64), MIN(c_float32), MAX(c_float32), "
+            "MIN(c_float64), MAX(c_float64) FROM all_types GROUP BY c_bool"
+        )
+        rows = sorted(table.to_rows(), key=lambda r: r[0])  # false, true
+        want = golden_lines("csv_aggregate_by_c_bool.csv")
+        assert len(rows) == len(want)
+        for row, line in zip(rows, want):
+            fields = [_parse_field(f) for f in line.split(",")[:21]]
+            for g, w in zip([_value(v) for v in row], fields):
+                assert _eq(g, w), f"{g!r} != {w!r} in {line[:80]!r}"
+
+    def test_sql_min_max(self, ctx):
+        assert_rows_match(
+            ctx.sql_collect(
+                "SELECT MIN(lat), MAX(lat), MIN(lng), MAX(lng) FROM uk_cities"
+            ),
+            "test_sql_min_max.csv",
+        )
+
+
+# -------------------------------------------------- uk_cities / UDF / misc --
+
+class TestUkCitiesGoldens:
+    def test_filter(self, ctx):
+        table = ctx.sql_collect(
+            "SELECT city, lat, lng FROM uk_cities WHERE lat > 52.0"
+        )
+        rows = table.to_rows()
+        want = golden_lines("test_filter.csv")
+        assert len(rows) == len(want)
+        for (city, lat, lng), line in zip(rows, want):
+            # city names contain commas: take lat/lng from the right
+            parts = line.split(",")
+            assert _eq(float(lat), float(parts[-2]))
+            assert _eq(float(lng), float(parts[-1]))
+            assert ",".join(parts[:-2]) == city
+
+    def _geo_ctx(self):
+        from datafusion_tpu.cli import make_context
+
+        c = make_context()
+        c.register_csv("uk_cities", os.path.join(DATA, "uk_cities.csv"),
+                       UK_SCHEMA, has_header=False)
+        return c
+
+    def test_simple_predicate(self):
+        ctx = self._geo_ctx()
+        table = ctx.sql_collect(
+            "SELECT ST_AsText(ST_Point(lat, lng)) FROM uk_cities WHERE lat < 53.0"
+        )
+        got = [r[0] for r in table.to_rows()]
+        assert got == golden_lines("test_simple_predicate.csv")
+
+    def test_chaining_functions(self):
+        ctx = self._geo_ctx()
+        table = ctx.sql_collect(
+            "SELECT ST_AsText(ST_Point(lat, lng)) FROM uk_cities"
+        )
+        assert [r[0] for r in table.to_rows()] == golden_lines(
+            "test_chaining_functions.csv"
+        )
+
+    def test_sql_udf_udt(self):
+        # the golden prints the Point UDT's Display: "lat, lng"
+        ctx = self._geo_ctx()
+        table = ctx.sql_collect("SELECT ST_Point(lat, lng) FROM uk_cities")
+        assert [r[0] for r in table.to_rows()] == golden_lines("test_sql_udf_udt.csv")
+
+    def test_df_udf_udt_same_golden(self):
+        assert golden_lines("test_df_udf_udt.csv") == golden_lines(
+            "test_sql_udf_udt.csv"
+        )
+
+
+class TestMiscGoldens:
+    def test_cast_null_test(self, ctx):
+        table = ctx.sql_collect(
+            "SELECT c_int, CAST(c_int AS SMALLINT), CAST(c_int AS INT), "
+            "CAST(c_int AS BIGINT), c_float, CAST(c_float AS FLOAT), "
+            "c_string, c_string FROM null_test WHERE c_float < 3.0"
+        )
+        assert_rows_match(table, "test_cast.csv", left_fields=6)
+
+    def test_sqrt(self):
+        # the 1..10 fixture table is not in the snapshot; rebuild it
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema([Field("c_int", DataType.INT64, False)])
+        batch = make_host_batch(schema, [np.arange(1, 11, dtype=np.int64)], [None])
+        c = ExecutionContext()
+        c.register_datasource("t", MemoryDataSource(schema, [batch]))
+        table = c.sql_collect("SELECT c_int, sqrt(c_int) FROM t")
+        assert_rows_match(table, "test_sqrt.csv")
+
+    def test_limit(self):
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema([Field("c_int", DataType.INT64, False)])
+        batch = make_host_batch(schema, [np.arange(1, 11, dtype=np.int64)], [None])
+        c = ExecutionContext()
+        c.register_datasource("t", MemoryDataSource(schema, [batch]))
+        table = c.sql_collect("SELECT c_int, sqrt(c_int) FROM t LIMIT 5")
+        assert_rows_match(table, "test_limit.csv")
