@@ -65,6 +65,7 @@ from datafusion_tpu.plan.logical import (
     TableScan,
 )
 from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.dataframe import DataFrame, f, lit
 
 __version__ = "0.1.0"
 
@@ -105,5 +106,8 @@ __all__ = [
     "TableScan",
     "EmptyRelation",
     "ExecutionContext",
+    "DataFrame",
+    "f",
+    "lit",
     "__version__",
 ]

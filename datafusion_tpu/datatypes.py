@@ -102,6 +102,11 @@ class StructType(DataType):
         self.name = "Struct"
         self.fields = list(fields)
 
+    @property
+    def np_dtype(self) -> np.dtype:
+        # struct columns materialize as their Display strings
+        return np.dtype(object)
+
     def to_json(self):
         return {"Struct": [f.to_json() for f in self.fields]}
 

@@ -224,6 +224,8 @@ class AggregateRelation(Relation):
         for g in group_expr:
             if not isinstance(g, Column):
                 raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
+            if in_schema.field(g.index).data_type.np_dtype.kind == "O":
+                raise NotSupportedError("struct columns cannot be GROUP BY keys")
         self.key_cols = [g.index for g in group_expr]
         self.specs = []
         for a in aggr_expr:

@@ -184,6 +184,16 @@ class ExecutionContext:
     def _jax_functions(self) -> dict[str, Callable]:
         return {name: fm.jax_fn for name, fm in self.functions.items() if fm.jax_fn}
 
+    def table(self, name: str):
+        """A DataFrame over a registered datasource (the programmatic
+        twin of `FROM name`)."""
+        from datafusion_tpu.dataframe import DataFrame
+
+        ds = self.datasources.get(name)
+        if ds is None:
+            raise ExecutionError(f"No datasource registered as {name!r}")
+        return DataFrame(self, TableScan("default", name, ds.schema))
+
     # -- entry points --
     def sql(self, sql_text: str) -> Union[Relation, DdlResult, ExplainResult]:
         """Parse, plan, optimize, build the operator tree (lazy — no data

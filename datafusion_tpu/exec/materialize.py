@@ -132,11 +132,7 @@ def collect_columns(relation):
         if parts[i]:
             columns.append(np.concatenate(parts[i]))
         else:
-            try:
-                dt = schema.field(i).data_type.np_dtype
-            except KeyError:
-                dt = np.dtype(object)  # struct columns materialize as strings
-            columns.append(np.empty(0, dtype=dt))
+            columns.append(np.empty(0, dtype=schema.field(i).data_type.np_dtype))
         if not any_null[i]:
             validity.append(None)
         else:

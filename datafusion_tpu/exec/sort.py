@@ -105,6 +105,10 @@ class SortRelation(Relation):
                 kind = "i"
             else:
                 kind = f.data_type.np_dtype.kind
+                if kind == "O":
+                    raise NotSupportedError(
+                        "struct columns cannot be ORDER BY keys"
+                    )
                 if kind == "u" and f.data_type.width == 64:
                     # uint64 doesn't fit int64: flip the sign bit and
                     # reinterpret — order-preserving and lossless
