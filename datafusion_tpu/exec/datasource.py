@@ -58,7 +58,20 @@ class CsvDataSource(DataSource):
         self.has_header = has_header
         self.batch_size = batch_size
         self.projection = list(projection) if projection is not None else None
-        self._reader = CsvReader(path, schema, has_header, batch_size, self.projection)
+        # native C++ parser when built (the host hot loop — reference
+        # `datasource.rs:31-50` is native too); pyarrow fallback
+        from datafusion_tpu.native import native_available
+
+        if native_available():
+            from datafusion_tpu.native.csv import NativeCsvReader
+
+            self._reader = NativeCsvReader(
+                path, schema, has_header, batch_size, self.projection
+            )
+        else:
+            self._reader = CsvReader(
+                path, schema, has_header, batch_size, self.projection
+            )
 
     @property
     def schema(self) -> Schema:

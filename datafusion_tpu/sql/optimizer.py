@@ -55,7 +55,8 @@ def _remap(e: Expr, mapping: dict[int, int]) -> Expr:
         return ScalarFunction(e.name, [_remap(a, mapping) for a in e.args], e.return_type)
     if isinstance(e, AggregateFunction):
         return AggregateFunction(
-            e.name, [_remap(a, mapping) for a in e.args], e.return_type
+            e.name, [_remap(a, mapping) for a in e.args], e.return_type,
+            e.count_star,
         )
     raise TypeError(f"unknown Expr {e!r}")
 

@@ -1,0 +1,232 @@
+"""Native C++ runtime tests: parity between the C++ CSV reader and the
+pyarrow-backed one, and end-to-end engine behavior on the native path."""
+
+import os
+
+import numpy as np
+import pytest
+
+from datafusion_tpu import DataType, Field, Schema
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.io.readers import CsvReader
+from datafusion_tpu.native import build_library, native_available
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "test", "data")
+
+pytestmark = pytest.mark.skipif(
+    not (build_library() and native_available()), reason="native library unavailable"
+)
+
+
+def _native_reader(*args, **kw):
+    from datafusion_tpu.native.csv import NativeCsvReader
+
+    return NativeCsvReader(*args, **kw)
+
+
+def _collect_reader(reader):
+    """(columns, validity, decoded strings) concatenated across batches."""
+    cols = None
+    for batch in reader.batches():
+        n = batch.num_rows
+        vals = []
+        for i in range(batch.num_columns):
+            c = np.asarray(batch.data[i])[:n]
+            if batch.dicts[i] is not None:
+                c = batch.dicts[i].decode(c).copy()
+            v = batch.validity[i]
+            v = np.ones(n, bool) if v is None else np.asarray(v)[:n]
+            vals.append((c, v))
+        if cols is None:
+            cols = [([c], [v]) for c, v in vals]
+        else:
+            for i, (c, v) in enumerate(vals):
+                cols[i][0].append(c)
+                cols[i][1].append(v)
+    if cols is None:
+        return []
+    return [
+        (np.concatenate(cs), np.concatenate(vs)) for cs, vs in cols
+    ]
+
+
+def _assert_reader_parity(path, schema, has_header, batch_size=64, projection=None):
+    native = _collect_reader(
+        _native_reader(path, schema, has_header, batch_size, projection)
+    )
+    arrow = _collect_reader(
+        CsvReader(path, schema, has_header, batch_size, projection)
+    )
+    assert len(native) == len(arrow)
+    for i, ((nc, nv), (ac, av)) in enumerate(zip(native, arrow)):
+        np.testing.assert_array_equal(nv, av, err_msg=f"validity col {i}")
+        # compare only valid positions (null fill values may differ)
+        if nc.dtype == object:
+            assert nc[nv].tolist() == ac[av].tolist(), f"col {i}"
+        else:
+            np.testing.assert_array_equal(nc[nv], ac[av], err_msg=f"col {i}")
+
+
+UK_SCHEMA = Schema(
+    [
+        Field("city", DataType.UTF8, False),
+        Field("lat", DataType.FLOAT64, False),
+        Field("lng", DataType.FLOAT64, False),
+    ]
+)
+
+ALL_TYPES_SCHEMA = Schema(
+    [
+        Field("c_bool", DataType.BOOLEAN, False),
+        Field("c_uint8", DataType.UINT8, False),
+        Field("c_uint16", DataType.UINT16, False),
+        Field("c_uint32", DataType.UINT32, False),
+        Field("c_uint64", DataType.UINT64, False),
+        Field("c_int8", DataType.INT8, False),
+        Field("c_int16", DataType.INT16, False),
+        Field("c_int32", DataType.INT32, False),
+        Field("c_int64", DataType.INT64, False),
+        Field("c_float32", DataType.FLOAT32, False),
+        Field("c_float64", DataType.FLOAT64, False),
+        Field("c_utf8", DataType.UTF8, False),
+    ]
+)
+
+NULL_SCHEMA = Schema(
+    [
+        Field("c_int", DataType.INT32, True),
+        Field("c_float", DataType.FLOAT32, True),
+        Field("c_string", DataType.UTF8, True),
+        Field("c_bool", DataType.BOOLEAN, True),
+    ]
+)
+
+
+class TestNativeCsvParity:
+    def test_uk_cities_headerless(self):
+        _assert_reader_parity(
+            os.path.join(DATA, "uk_cities.csv"), UK_SCHEMA, has_header=False,
+            batch_size=7,
+        )
+
+    def test_all_types_quoted_multiline_strings(self):
+        # row 26's c_utf8 contains a quoted embedded newline
+        _assert_reader_parity(
+            os.path.join(DATA, "all_types_flat.csv"), ALL_TYPES_SCHEMA,
+            has_header=False, batch_size=100,
+        )
+
+    def test_null_test_with_header(self):
+        _assert_reader_parity(
+            os.path.join(DATA, "null_test.csv"), NULL_SCHEMA, has_header=True,
+        )
+
+    def test_projection(self):
+        _assert_reader_parity(
+            os.path.join(DATA, "uk_cities.csv"), UK_SCHEMA, has_header=False,
+            projection=[1, 0],
+        )
+
+    def test_open_error(self):
+        from datafusion_tpu.errors import IoError
+
+        with pytest.raises(IoError):
+            list(_native_reader("/nonexistent.csv", UK_SCHEMA, False, 64).batches())
+
+    def test_malformed_row_errors(self, tmp_path):
+        from datafusion_tpu.errors import IoError
+
+        p = tmp_path / "bad.csv"
+        p.write_text("a,1.0,2.0\nb,3.0\n")
+        with pytest.raises(IoError):
+            list(_native_reader(str(p), UK_SCHEMA, False, 64).batches())
+
+
+class TestNativeEngine:
+    def test_sql_through_native_reader(self):
+        ctx = ExecutionContext(batch_size=8)
+        ctx.register_csv("cities", os.path.join(DATA, "uk_cities.csv"),
+                         UK_SCHEMA, has_header=False)
+        from datafusion_tpu.native.csv import NativeCsvReader
+
+        assert isinstance(ctx.datasources["cities"]._reader, NativeCsvReader)
+        t = ctx.sql_collect(
+            "SELECT city, lat + lng FROM cities WHERE lat > 51.0 AND lat < 53"
+        )
+        assert t.num_rows == 18
+        t2 = ctx.sql_collect("SELECT COUNT(1), MIN(lat), MAX(lat) FROM cities")
+        assert t2.to_rows()[0][0] == 37
+
+    def test_partitioned_native_shared_dicts(self, tmp_path):
+        from datafusion_tpu.parallel import PartitionedContext, make_mesh
+
+        paths = []
+        for p in range(3):
+            f = tmp_path / f"p{p}.csv"
+            f.write_text("k,v\n" + "".join(
+                f"{k},{i}\n" for i, k in enumerate(["x", "y", "z"][p % 3:] + ["x"])
+            ))
+            paths.append(str(f))
+        schema = Schema([Field("k", DataType.UTF8, False), Field("v", DataType.INT64, False)])
+        ctx = PartitionedContext(mesh=make_mesh(2), batch_size=4)
+        ctx.register_partitioned_csv("t", paths, schema)
+        got = dict(
+            (r[0], r[1]) for r in ctx.sql_collect(
+                "SELECT k, COUNT(v) FROM t GROUP BY k"
+            ).to_rows()
+        )
+        import csv as _csv
+
+        want = {}
+        for path in paths:
+            with open(path) as fh:
+                for row in list(_csv.reader(fh))[1:]:
+                    want[row[0]] = want.get(row[0], 0) + 1
+        assert got == want
+
+
+class TestRegressions:
+    def test_count_star_survives_pushdown(self, tmp_path):
+        """push_down_projection must preserve count_star: COUNT(1)
+        counts rows, not non-null values of column 0."""
+        from datafusion_tpu import f as aggf
+
+        p = tmp_path / "n.csv"
+        p.write_text("a,b,c\n,x,1\n5,x,2\n,y,3\n")
+        schema = Schema([
+            Field("a", DataType.INT64, True),
+            Field("b", DataType.UTF8, False),
+            Field("c", DataType.INT64, False),
+        ])
+        ctx = ExecutionContext()
+        ctx.register_csv("t", str(p), schema)
+        got = sorted(
+            ctx.table("t").aggregate(["b"], [aggf.count()]).collect().to_rows()
+        )
+        assert got == [("x", 2), ("y", 1)]
+        got_sql = sorted(
+            ctx.sql_collect("SELECT b, COUNT(1) FROM t GROUP BY b").to_rows()
+        )
+        assert got_sql == [("x", 2), ("y", 1)]
+
+    def test_bool_spellings_match_pyarrow(self, tmp_path):
+        p = tmp_path / "b.csv"
+        p.write_text("x\nTrue\nFALSE\ntrue\n0\n")
+        schema = Schema([Field("x", DataType.BOOLEAN, False)])
+        _assert_reader_parity(str(p), schema, has_header=True)
+
+    def test_native_projection_skips_columns(self, tmp_path):
+        """A projected native scan must not choke on (or pay for)
+        unprojected columns — even unparseable ones."""
+        p = tmp_path / "w.csv"
+        p.write_text("1,notanumber,2.5\n3,alsobad,4.5\n")
+        schema = Schema([
+            Field("a", DataType.INT64, False),
+            Field("bad", DataType.INT64, False),
+            Field("c", DataType.FLOAT64, False),
+        ])
+        r = _native_reader(str(p), schema, False, 64, projection=[0, 2])
+        out = _collect_reader(r)
+        np.testing.assert_array_equal(out[0][0], [1, 3])
+        np.testing.assert_array_equal(out[1][0], [2.5, 4.5])
