@@ -43,6 +43,7 @@ from datafusion_tpu.errors import ExecutionError, NotSupportedError
 from datafusion_tpu.exec.batch import (
     RecordBatch,
     StringDictionary,
+    bucket_capacity,
     make_host_batch,
 )
 from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
@@ -162,11 +163,21 @@ class AggregateSpec:
         self.return_type = expr.return_type
         self.count_star = self.name == "count" and expr.count_star
         self.arg_type = self.arg.get_type(input_schema)
-        if self.name != "count" and self.arg_type == DataType.UTF8:
-            raise NotSupportedError(f"{expr.name} over Utf8 is not supported yet")
+        # MIN/MAX over Utf8: the accumulator is the best dictionary
+        # *code* per group; comparison rides per-version rank tables
+        # (codes are append-ordered, ranks are lexicographic)
+        self.is_string = self.arg_type == DataType.UTF8 and self.name in ("min", "max")
+        if self.is_string and not isinstance(self.arg, Column):
+            raise NotSupportedError(
+                f"{expr.name} over a computed Utf8 expression is not supported"
+            )
+        if self.name in ("sum", "avg") and self.arg_type == DataType.UTF8:
+            raise NotSupportedError(f"{expr.name} over Utf8 is not supported")
 
     @property
     def acc_dtype(self) -> np.dtype:
+        if self.is_string:
+            return np.dtype(np.int32)  # best code; -1 = no value yet
         npd = self.arg_type.np_dtype
         if self.name in ("sum", "avg"):
             if self.arg_type.is_signed_integer:
@@ -240,7 +251,38 @@ class AggregateRelation(Relation):
         self._aux_cache: dict = {}
         self.encoder = GroupKeyEncoder(len(self.key_cols))
         self._key_dicts: dict[int, StringDictionary] = {}
+        self._str_dicts: dict[int, StringDictionary] = {}
+        self._str_aux_cache: dict = {}
         self._jit = jax.jit(self._kernel)
+
+    def _compute_str_aux(self, batch: RecordBatch):
+        """(ranks, rank->code) pair per string min/max spec, padded to a
+        bucketed capacity, cached per dictionary version."""
+        out = []
+        for k, s in enumerate(self.specs):
+            if not s.is_string:
+                out.append(None)
+                continue
+            d = batch.dicts[s.arg.index]
+            if d is None:
+                raise ExecutionError(
+                    f"column {s.arg.index} has no dictionary for {s.name.upper()}"
+                )
+            self._str_dicts[k] = d
+            key = (k, d.version)
+            hit = self._str_aux_cache.get(key)
+            if hit is None:
+                ranks = d.sort_ranks().astype(np.int32)
+                order = np.argsort(ranks).astype(np.int32)  # rank -> code
+                cap = bucket_capacity(max(len(ranks), 1))
+                pr = np.zeros(cap, np.int32)
+                pr[: len(ranks)] = ranks
+                po = np.zeros(cap, np.int32)
+                po[: len(order)] = order
+                hit = (pr, po)
+                self._str_aux_cache[key] = hit
+            out.append(hit)
+        return tuple(out)
 
     @property
     def schema(self) -> Schema:
@@ -251,7 +293,9 @@ class AggregateRelation(Relation):
         accs = []
         for s in self.specs:
             d = s.acc_dtype
-            if s.name in ("sum", "avg"):
+            if s.is_string:
+                accs.append(jnp.full(capacity, -1, jnp.int32))
+            elif s.name in ("sum", "avg"):
                 accs.append((jnp.zeros(capacity, d), jnp.zeros(capacity, jnp.int64)))
             elif s.name == "count":
                 accs.append(jnp.zeros(capacity, jnp.int64))
@@ -271,7 +315,9 @@ class AggregateRelation(Relation):
 
         new_accs = []
         for s, acc in zip(self.specs, accs):
-            if s.name in ("sum", "avg"):
+            if s.is_string:
+                new_accs.append(grow(acc, -1))
+            elif s.name in ("sum", "avg"):
                 new_accs.append((grow(acc[0], 0), grow(acc[1], 0)))
             elif s.name == "count":
                 new_accs.append(grow(acc, 0))
@@ -281,7 +327,8 @@ class AggregateRelation(Relation):
                 new_accs.append(grow(acc, _max_identity(np.dtype(acc.dtype))))
         return grow(counts, 0), tuple(new_accs)
 
-    def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state):
+    def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state,
+                str_aux=()):
         env = Env(cols, valids, aux)
         capacity = cols[0].shape[0] if cols else ids.shape[0]
         mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
@@ -297,8 +344,8 @@ class AggregateRelation(Relation):
         counts, accs = state
         group_cap = counts.shape[0]
         if group_cap <= DENSE_GROUP_MAX:
-            return self._dense_update(env, capacity, mask, ids, counts, accs)
-        return self._scatter_update(env, capacity, mask, ids, counts, accs)
+            return self._dense_update(env, capacity, mask, ids, counts, accs, str_aux)
+        return self._scatter_update(env, capacity, mask, ids, counts, accs, str_aux)
 
     def _spec_inputs(self, env, capacity, mask):
         """(value, ok-mask) per spec, masking padding/filtered/null rows."""
@@ -314,12 +361,46 @@ class AggregateRelation(Relation):
             out.append((v, ok))
         return out
 
-    def _scatter_update(self, env, capacity, mask, ids, counts, accs):
+    @staticmethod
+    def _string_combine(s, acc, batch_best_rank, str_aux_k):
+        """Merge a per-group best-rank candidate into a best-code
+        accumulator (codes are stable across batches; ranks are valid
+        only within the current dictionary version)."""
+        ranks, order = str_aux_k
+        cap = ranks.shape[0]
+        sentinel = jnp.int32(2**31 - 1) if s.name == "min" else jnp.int32(-1)
+        old_rank = jnp.where(
+            acc >= 0, ranks[jnp.clip(acc, 0, cap - 1)], sentinel
+        )
+        if s.name == "min":
+            best = jnp.minimum(batch_best_rank, old_rank)
+            alive = best != sentinel
+        else:
+            best = jnp.maximum(batch_best_rank, old_rank)
+            alive = best != sentinel
+        return jnp.where(alive, order[jnp.clip(best, 0, cap - 1)], -1).astype(jnp.int32)
+
+    def _scatter_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """General path (group capacity > DENSE_GROUP_MAX): XLA scatter."""
         counts = counts.at[ids].add(mask.astype(jnp.int64))
         new_accs = []
         inputs = self._spec_inputs(env, capacity, mask)
-        for s, (v, ok), acc in zip(self.specs, inputs, accs):
+        G = counts.shape[0]
+        for k, (s, (v, ok), acc) in enumerate(zip(self.specs, inputs, accs)):
+            if s.is_string:
+                ranks, _ = str_aux[k]
+                cap = ranks.shape[0]
+                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
+                if s.name == "min":
+                    sentinel = jnp.int32(2**31 - 1)
+                    cand = jnp.where(ok, r, sentinel)
+                    batch_best = jnp.full(G, sentinel).at[ids].min(cand)
+                else:
+                    sentinel = jnp.int32(-1)
+                    cand = jnp.where(ok, r, sentinel)
+                    batch_best = jnp.full(G, sentinel).at[ids].max(cand)
+                new_accs.append(self._string_combine(s, acc, batch_best, str_aux[k]))
+                continue
             if s.name in ("sum", "avg"):
                 acc_sum, acc_cnt = acc
                 contrib = jnp.where(ok, v, 0).astype(acc_sum.dtype)
@@ -336,7 +417,7 @@ class AggregateRelation(Relation):
                 new_accs.append(acc.at[ids].max(jnp.where(ok, v.astype(acc.dtype), ident)))
         return counts, tuple(new_accs)
 
-    def _dense_update(self, env, capacity, mask, ids, counts, accs):
+    def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """Small-group path: segment reduction via a one-hot [rows, G]
         matrix.  Float sums/counts stack into ONE [rows, S] @ [rows, G]
         matmul (the MXU's shape); int sums and min/max are fused
@@ -370,6 +451,20 @@ class AggregateRelation(Relation):
 
         new_accs = []
         for i, (s, (v, ok), acc) in enumerate(zip(self.specs, inputs, accs)):
+            if s.is_string:
+                ranks, _ = str_aux[i]
+                cap = ranks.shape[0]
+                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
+                if s.name == "min":
+                    sentinel = jnp.int32(2**31 - 1)
+                    cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
+                    batch_best = jnp.min(cell, axis=0)
+                else:
+                    sentinel = jnp.int32(-1)
+                    cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
+                    batch_best = jnp.max(cell, axis=0)
+                new_accs.append(self._string_combine(s, acc, batch_best, str_aux[i]))
+                continue
             if s.name in ("sum", "avg"):
                 acc_sum, acc_cnt = acc
                 if i in per_spec_sum:
@@ -425,6 +520,7 @@ class AggregateRelation(Relation):
                 state = self._grow_state(state, needed)
                 capacity = needed
             aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
+            str_aux = self._compute_str_aux(batch)
             with METRICS.timer("execute.aggregate"), device_scope(self.device):
                 data, validity, mask = device_inputs(batch, self.device)
                 state = self._jit(
@@ -435,6 +531,7 @@ class AggregateRelation(Relation):
                     mask,
                     ids,
                     state,
+                    str_aux,
                 )
         if state is None:
             state = self._init_state(group_capacity(1))
@@ -497,7 +594,14 @@ class AggregateRelation(Relation):
             out_valid.append(None if kvalid is None else kvalid[live])
             out_dicts.append(self._key_dicts.get(idx))
 
-        for s, acc in zip(self.specs, accs):
+        for k, (s, acc) in enumerate(zip(self.specs, accs)):
+            if s.is_string:
+                codes = np.asarray(acc)[live].astype(np.int32)
+                valid = codes >= 0
+                out_cols.append(np.where(valid, codes, 0).astype(np.int32))
+                out_valid.append(None if bool(valid.all()) else valid)
+                out_dicts.append(self._str_dicts.get(k))
+                continue
             if s.name in ("sum", "avg"):
                 sums = np.asarray(acc[0])[live]
                 cnts = np.asarray(acc[1])[live]

@@ -23,7 +23,7 @@ from typing import Callable, Iterator, Optional, Union
 import numpy as np
 
 from datafusion_tpu.datatypes import DataType, Field, Schema
-from datafusion_tpu.errors import ExecutionError, PlanError
+from datafusion_tpu.errors import ExecutionError, NotSupportedError, PlanError
 from datafusion_tpu.exec.aggregate import AggregateRelation
 from datafusion_tpu.exec.batch import RecordBatch
 from datafusion_tpu.exec.datasource import (
@@ -306,6 +306,34 @@ class ExecutionContext:
                 )
             return LimitRelation(self.execute(plan.input), plan.limit, plan.schema)
         raise ExecutionError(f"Cannot execute plan node {type(plan).__name__}")
+
+    def execute_physical(self, physical_plan):
+        """Execute a PhysicalPlan statement wrapper — the unit of work
+        the reference defined but never consumed (`physicalplan.rs:18-34`).
+
+        Interactive -> Relation (lazy); Write -> materialize to the
+        target file, returns row count; Show -> first `count` rows as a
+        ResultTable.
+        """
+        kind = physical_plan.kind
+        if kind == "interactive":
+            return self.execute(physical_plan.plan)
+        if kind == "write":
+            if (physical_plan.file_format or "csv").lower() != "csv":
+                raise NotSupportedError(
+                    f"write format {physical_plan.file_format!r} not supported"
+                )
+            table = collect(self.execute(physical_plan.plan))
+            table.to_csv(physical_plan.filename)
+            return table.num_rows
+        if kind == "show":
+            table = collect(self.execute(physical_plan.plan))
+            return ResultTable(
+                table.schema,
+                [c[: physical_plan.count] for c in table.columns],
+                [None if v is None else v[: physical_plan.count] for v in table.validity],
+            )
+        raise ExecutionError(f"unknown physical plan kind {kind!r}")
 
     def metrics(self) -> dict:
         return METRICS.snapshot()

@@ -81,6 +81,18 @@ class ResultTable:
         cols = [self.column_values(i) for i in range(len(self.schema))]
         return list(zip(*cols)) if cols else []
 
+    def to_csv(self, path: str, header: bool = True) -> None:
+        """Materialize to a CSV file (the `PhysicalPlan::Write` sink,
+        reference `physicalplan.rs:25-29`)."""
+        import csv as _csv
+
+        with open(path, "w", newline="", encoding="utf-8") as fh:
+            w = _csv.writer(fh)
+            if header:
+                w.writerow(self.schema.names())
+            for row in self.to_rows():
+                w.writerow(["" if v is None else v for v in row])
+
     def pretty(self, max_rows: int = 50) -> str:
         names = self.schema.names()
         rows = self.to_rows()[:max_rows]

@@ -465,4 +465,18 @@ def _match_partitioned_aggregate(plan: LogicalPlan, datasources: dict):
     ds = datasources.get(inner.table_name)
     if not isinstance(ds, PartitionedDataSource):
         return None, None, None
+    from datafusion_tpu.datatypes import DataType
+    from datafusion_tpu.plan.expr import AggregateFunction, Column as _Col
+
+    for a in plan.aggr_expr:
+        # MIN/MAX over Utf8 needs rank-table aux in the collective
+        # combine; not wired into the mesh path yet — run the (still
+        # correct) union-scan single-device aggregate instead
+        if (
+            isinstance(a, AggregateFunction)
+            and a.name.lower() in ("min", "max")
+            and isinstance(a.args[0], _Col)
+            and inner.schema.field(a.args[0].index).data_type == DataType.UTF8
+        ):
+            return None, None, None
     return plan, pred, inner

@@ -28,9 +28,13 @@ from datafusion_tpu.datatypes import DataType, Field, Schema, get_supertype
 from datafusion_tpu.errors import NotSupportedError, PlanError
 from datafusion_tpu.plan.expr import (
     AggregateFunction,
+    BinaryExpr,
+    Cast,
     Column,
     Expr,
     FunctionMeta,
+    IsNotNull,
+    IsNull,
     Literal,
     Operator,
     ScalarFunction,
@@ -159,11 +163,29 @@ class SqlToRel:
             plan: LogicalPlan = Aggregate(
                 aggregate_input, group_expr, aggr_expr, aggr_schema
             )
+            # Completing the reference's explicit TODO ("selection,
+            # projection, everything else" on the aggregate path,
+            # sqlplanner.rs:111-117): HAVING / ORDER BY / LIMIT over the
+            # aggregate, with aggregate calls resolved to their output
+            # columns.
             if sel.having is not None:
-                raise NotSupportedError("HAVING is not implemented yet")
-            # extension beyond the reference's TODO: ORDER BY / LIMIT over
-            # aggregates, resolved against the aggregate output schema
-            plan = self._apply_order_by(plan, sel.order_by)
+                plan = Selection(
+                    self._post_aggregate_rex(
+                        sel.having, input_schema, group_expr, aggr_expr
+                    ),
+                    plan,
+                )
+            if sel.order_by:
+                sort_exprs = [
+                    SortExpr(
+                        self._post_aggregate_rex(
+                            o.expr, input_schema, group_expr, aggr_expr
+                        ),
+                        o.asc,
+                    )
+                    for o in sel.order_by
+                ]
+                plan = Sort(sort_exprs, plan, plan.schema)
             plan = self._apply_limit(plan, sel.limit)
             return plan
 
@@ -180,6 +202,51 @@ class SqlToRel:
         plan = self._apply_order_by(plan, sel.order_by)
         plan = self._apply_limit(plan, sel.limit)
         return plan
+
+    def _post_aggregate_rex(
+        self,
+        node: ast.SqlNode,
+        input_schema: Schema,
+        group_expr: list[Expr],
+        aggr_expr: list[Expr],
+    ) -> Expr:
+        """Translate a HAVING / post-aggregate ORDER BY expression:
+        plan it against the *input* schema, then rewrite every subtree
+        equal to a group key or aggregate into its output-column
+        position.  Aggregates not present in the SELECT list are
+        rejected (the output column does not exist to reference)."""
+        e = self.sql_to_rex(node, input_schema)
+        positions: dict = {}
+        for i, g in enumerate(group_expr):
+            positions.setdefault(g, i)
+        for j, a in enumerate(aggr_expr):
+            positions.setdefault(a, len(group_expr) + j)
+
+        def rewrite(x: Expr) -> Expr:
+            pos = positions.get(x)
+            if pos is not None:
+                return Column(pos)
+            if isinstance(x, BinaryExpr):
+                return BinaryExpr(rewrite(x.left), x.op, rewrite(x.right))
+            if isinstance(x, Cast):
+                return Cast(rewrite(x.expr), x.data_type)
+            if isinstance(x, IsNull):
+                return IsNull(rewrite(x.expr))
+            if isinstance(x, IsNotNull):
+                return IsNotNull(rewrite(x.expr))
+            if isinstance(x, AggregateFunction):
+                raise PlanError(
+                    f"aggregate {x!r} in HAVING/ORDER BY must also appear "
+                    "in the SELECT list"
+                )
+            if isinstance(x, Column):
+                raise PlanError(
+                    f"column {x!r} in HAVING/ORDER BY is neither a GROUP BY "
+                    "key nor an aggregate output"
+                )
+            return x
+
+        return rewrite(e)
 
     def _apply_order_by(
         self, plan: LogicalPlan, order_by: list[ast.SqlOrderByExpr]
@@ -238,6 +305,11 @@ class SqlToRel:
             # implicit supertype casts on both sides (sqlplanner.rs:268-287)
             lt = left.get_type(schema)
             rt = right.get_type(schema)
+            # a non-negative integer literal adapts to an unsigned
+            # operand's type (else COUNT(1) > 0 fails: no implicit
+            # UInt64 <-> Int64 coercion exists in the lattice)
+            left, lt = self._adapt_int_literal(left, lt, rt)
+            right, rt = self._adapt_int_literal(right, rt, lt)
             st = get_supertype(lt, rt)
             if st is None:
                 raise PlanError(f"No common supertype for {lt!r} and {rt!r}")
@@ -248,6 +320,19 @@ class SqlToRel:
             # aliases outside a projection list have no meaning
             return self.sql_to_rex(node.expr, schema)
         raise NotSupportedError(f"Unsupported expression {node!r}")
+
+    @staticmethod
+    def _adapt_int_literal(e: Expr, et: DataType, other: DataType):
+        if (
+            isinstance(e, Literal)
+            and not e.value.is_null
+            and et.is_signed_integer
+            and other.is_unsigned_integer
+            and isinstance(e.value.value, int)
+            and e.value.value >= 0
+        ):
+            return Literal(ScalarValue.of(other, e.value.value)), other
+        return e, et
 
     def _plan_unary(self, node: ast.SqlUnary, schema: Schema) -> Expr:
         if node.op == "-":
