@@ -22,6 +22,8 @@ from typing import Optional
 
 import numpy as np
 
+from datafusion_tpu.sql.parser import split_statements_partial
+
 
 def _fmt_float(v: float) -> str:
     """Shortest round-trip decimal (matches the golden output's
@@ -96,11 +98,13 @@ def run_script(console: Console, path: str) -> None:
         buf = ""
         for line in f:
             buf += line
-            while ";" in buf:
-                stmt, buf = buf.split(";", 1)
+            stmts, buf = split_statements_partial(buf)
+            for stmt in stmts:
                 console.execute(stmt)
-        if buf.strip():
-            console.execute(buf)
+        from datafusion_tpu.sql.parser import split_statements
+
+        for stmt in split_statements(buf):  # comment-stripped leftover
+            console.execute(stmt)
 
 
 def run_interactive(console: Console) -> None:
@@ -116,11 +120,15 @@ def run_interactive(console: Console) -> None:
         if not buf and line.strip().lower() in ("quit", "exit"):
             return
         buf += line + "\n"
-        while ";" in buf:
-            stmt, buf = buf.split(";", 1)
+        stmts, buf = split_statements_partial(buf)
+        for stmt in stmts:
             console.execute(stmt)
-        if not buf.strip():
-            buf = ""  # whitespace-only leftover must not hold the '>' prompt
+        from datafusion_tpu.sql.parser import split_statements
+
+        if not split_statements(buf):
+            # whitespace- or comment-only leftover must not hold the
+            # '>' continuation prompt (or disable quit/exit)
+            buf = ""
 
 
 def main(argv=None) -> int:

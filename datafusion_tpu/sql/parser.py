@@ -330,14 +330,12 @@ def parse_sql(sql: str) -> ast.SqlNode:
     return Parser(sql).parse_statement()
 
 
-def split_statements(text: str) -> list[str]:
-    """Split a script into semicolon-terminated statements, respecting
-    string literals and comments (console --script mode,
-    reference `bin/console/main.rs:41-63`)."""
+def _split(text: str, flush: bool) -> tuple[list[str], str]:
     stmts: list[str] = []
     buf: list[str] = []
     i, n = 0, len(text)
     in_str = False
+    tail_start = 0  # index just past the last statement terminator
     while i < n:
         c = text[i]
         if in_str:
@@ -355,15 +353,45 @@ def split_statements(text: str) -> list[str]:
             while i < n and text[i] != "\n":
                 i += 1
             continue
+        elif c == "/" and i + 1 < n and text[i + 1] == "*":
+            end = text.find("*/", i + 2)
+            if end < 0:
+                # unclosed block comment: keep the raw text (a REPL may
+                # append the closing */; a flush surfaces the
+                # tokenizer's "Unterminated block comment" error)
+                buf.append(text[i:])
+                i = n
+                continue
+            i = end + 2
+            continue
         elif c == ";":
             s = "".join(buf).strip()
             if s:
                 stmts.append(s)
             buf = []
+            tail_start = i + 1
         else:
             buf.append(c)
         i += 1
-    s = "".join(buf).strip()
-    if s:
-        stmts.append(s)
-    return stmts
+    if flush:
+        s = "".join(buf).strip()
+        if s:
+            stmts.append(s)
+    return stmts, text[tail_start:]
+
+
+def split_statements_partial(text: str) -> tuple[list[str], str]:
+    """Split semicolon-terminated statements, respecting string
+    literals (with ``''`` escapes) and ``--`` comments.  Returns the
+    comment-stripped complete statements plus the *raw* unterminated
+    tail, so a REPL can append more input to it (a tail ending inside
+    a comment keeps the comment text: the next appended line's newline
+    is what terminates it)."""
+    return _split(text, flush=False)
+
+
+def split_statements(text: str) -> list[str]:
+    """Split a whole script into statements (console --script mode,
+    reference `bin/console/main.rs:41-63`); an unterminated final
+    statement is included."""
+    return _split(text, flush=True)[0]

@@ -234,6 +234,10 @@ class SqlToRel:
                 return IsNull(rewrite(x.expr))
             if isinstance(x, IsNotNull):
                 return IsNotNull(rewrite(x.expr))
+            if isinstance(x, ScalarFunction):
+                return ScalarFunction(
+                    x.name, [rewrite(a) for a in x.args], x.return_type
+                )
             if isinstance(x, AggregateFunction):
                 raise PlanError(
                     f"aggregate {x!r} in HAVING/ORDER BY must also appear "

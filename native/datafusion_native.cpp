@@ -188,7 +188,16 @@ bool parse_value(Column& col, int64_t row, const std::string& s,
     }
     case T_INT8: case T_INT16: case T_INT32: case T_INT64: {
       long long v = strtoll(p, &end, 10);
-      if (end == p || *end != '\0' || errno == ERANGE) {
+      // Per-width range check: out-of-range values must error (the
+      // pyarrow fallback raises), never silently wrap via the cast.
+      long long lo, hi;
+      switch (col.type) {
+        case T_INT8: lo = INT8_MIN; hi = INT8_MAX; break;
+        case T_INT16: lo = INT16_MIN; hi = INT16_MAX; break;
+        case T_INT32: lo = INT32_MIN; hi = INT32_MAX; break;
+        default: lo = INT64_MIN; hi = INT64_MAX; break;
+      }
+      if (end == p || *end != '\0' || errno == ERANGE || v < lo || v > hi) {
         *err = "bad int: " + s;
         return false;
       }
@@ -202,7 +211,15 @@ bool parse_value(Column& col, int64_t row, const std::string& s,
     }
     case T_UINT8: case T_UINT16: case T_UINT32: case T_UINT64: {
       unsigned long long v = strtoull(p, &end, 10);
-      if (end == p || *end != '\0' || errno == ERANGE || s[0] == '-') {
+      unsigned long long hi;
+      switch (col.type) {
+        case T_UINT8: hi = UINT8_MAX; break;
+        case T_UINT16: hi = UINT16_MAX; break;
+        case T_UINT32: hi = UINT32_MAX; break;
+        default: hi = UINT64_MAX; break;
+      }
+      if (end == p || *end != '\0' || errno == ERANGE || s[0] == '-' ||
+          v > hi) {
         *err = "bad uint: " + s;
         return false;
       }

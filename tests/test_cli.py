@@ -104,3 +104,60 @@ class TestCliSubprocess:
         )
         assert proc.returncode == 0, proc.stderr
         assert "Executing query ..." in proc.stdout
+
+
+class TestStatementSplitting:
+    def test_semicolon_inside_string_literal(self, tmp_path):
+        # a ';' inside a SQL string literal must not terminate the
+        # statement (quote-aware splitting): a LOCATION path with ';'
+        import shutil
+
+        src = os.path.join(DATA, "people.csv")
+        dst = tmp_path / "people;v2.csv"
+        shutil.copy(src, dst)
+        lines = _run_sql_text(
+            "CREATE EXTERNAL TABLE people (id INT, first_name VARCHAR(100)) "
+            f"STORED AS CSV WITH HEADER ROW LOCATION '{dst}';\n"
+            "SELECT COUNT(1) FROM people;\n",
+            tmp_path,
+        )
+        assert lines.count("Executing query ...") == 2
+        assert not any(l.startswith("Error") for l in lines)
+
+    def test_escaped_quote_in_literal(self):
+        from datafusion_tpu.sql.parser import split_statements_partial
+
+        stmts, rest = split_statements_partial("SELECT 'it''s;ok'; SELECT 2")
+        assert stmts == ["SELECT 'it''s;ok'"]
+        assert rest == " SELECT 2"
+
+    def test_comment_with_apostrophe_does_not_open_literal(self):
+        from datafusion_tpu.sql.parser import split_statements_partial
+
+        stmts, rest = split_statements_partial(
+            "-- don't trip on this\nSELECT 1;\nSELECT 2;\n"
+        )
+        assert stmts == ["SELECT 1", "SELECT 2"]
+        assert rest.strip() == ""
+        # a tail ending mid-comment keeps its raw text so appended
+        # input continues the comment until a newline arrives
+        stmts, rest = split_statements_partial("SELECT 1; -- note")
+        assert stmts == ["SELECT 1"]
+        assert rest == " -- note"
+
+    def test_block_comment_with_semicolon(self):
+        from datafusion_tpu.sql.parser import (
+            split_statements,
+            split_statements_partial,
+        )
+
+        assert split_statements("SELECT /* a;b */ 1;") == ["SELECT  1"]
+        # unclosed block comment: raw tail kept so a REPL can close it
+        stmts, rest = split_statements_partial("SELECT 1; /* note")
+        assert stmts == ["SELECT 1"]
+        assert rest == " /* note"
+
+    def test_script_trailing_comment_no_error(self, tmp_path):
+        lines = _run_sql_text("SELECT 1 + 1;\n-- trailing comment\n", tmp_path)
+        assert lines.count("Executing query ...") == 1
+        assert not any(l.startswith("Error") for l in lines)

@@ -230,3 +230,34 @@ class TestRegressions:
         out = _collect_reader(r)
         np.testing.assert_array_equal(out[0][0], [1, 3])
         np.testing.assert_array_equal(out[1][0], [2.5, 4.5])
+
+
+class TestNativeRangeChecks:
+    def test_int_out_of_range_errors(self, tmp_path):
+        # ADVICE: 300 in an Int8 column must error (as the pyarrow
+        # fallback does), not silently wrap to 44
+        from datafusion_tpu.errors import IoError
+
+        schema = Schema([Field("v", DataType.INT8, False)])
+        p = tmp_path / "over.csv"
+        p.write_text("300\n")
+        with pytest.raises(IoError):
+            list(_native_reader(str(p), schema, False, 64).batches())
+        p.write_text("-129\n")
+        with pytest.raises(IoError):
+            list(_native_reader(str(p), schema, False, 64).batches())
+        p.write_text("127\n-128\n")
+        (col, _), = _collect_reader(_native_reader(str(p), schema, False, 64))
+        assert col.tolist() == [127, -128]
+
+    def test_uint_out_of_range_errors(self, tmp_path):
+        from datafusion_tpu.errors import IoError
+
+        schema = Schema([Field("v", DataType.UINT16, False)])
+        p = tmp_path / "over.csv"
+        p.write_text("65536\n")
+        with pytest.raises(IoError):
+            list(_native_reader(str(p), schema, False, 64).batches())
+        p.write_text("65535\n0\n")
+        (col, _), = _collect_reader(_native_reader(str(p), schema, False, 64))
+        assert col.tolist() == [65535, 0]

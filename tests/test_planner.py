@@ -304,3 +304,25 @@ def test_statement_splitting():
         "-- comment\nSELECT 1;\nSELECT 'a;b';\n  \nSELECT 2"
     )
     assert stmts == ["SELECT 1", "SELECT 'a;b'", "SELECT 2"]
+
+
+def test_aggregate_under_scalar_function_in_having():
+    # ScalarFunction args participate in the post-aggregate rewrite:
+    # an aggregate inside a function resolves to its output column when
+    # it appears in the SELECT list ...
+    quick_test(
+        "SELECT state, SUM(salary) FROM person GROUP BY state "
+        "HAVING sqrt(SUM(salary)) > 10",
+        "Selection: sqrt(#1) Gt CAST(Int64(10) AS Float64)\n"
+        "  Aggregate: groupBy=[[#4]], aggr=[[SUM(#5)]]\n"
+        "    TableScan: person projection=None",
+    )
+    # ... and is rejected with a plan-time diagnostic when it does not.
+    planner = SqlToRel(MockSchemaProvider())
+    with pytest.raises(PlanError, match="must also appear"):
+        planner.sql_to_rel(
+            parse_sql(
+                "SELECT state, SUM(salary) FROM person GROUP BY state "
+                "HAVING sqrt(MAX(salary)) > 10"
+            )
+        )
