@@ -9,15 +9,24 @@ The reference never implemented aggregation (`context.rs:161`
   *into the aggregation kernel* — filter + 8-way aggregate is one XLA
   computation per batch (TPC-H Q1's whole body).
 - **Group-key encoding (host)**: a persistent `GroupKeyEncoder` maps
-  each row's key tuple to a dense, append-only group id (vectorized
-  np.unique per batch + a dict over the per-batch uniques).  Dense ids
-  are stable across batches, so device accumulators grow by zero
-  padding — no rehashing, no remapping.
+  each row's key tuple to a dense, append-only group id.  Fully
+  vectorized: per-batch uniques via a mixed-radix pack (or a row-bytes
+  view when the pack overflows), matched against the known key set
+  with `searchsorted` — no Python loop over uniques, so 10^5-10^6
+  groups per batch encode in numpy time.  Dense ids are stable across
+  batches, so device accumulators grow by zero padding.
+- **Slot deduplication**: aggregates lower to accumulator *slots*
+  shared across functions — SUM(x) and AVG(x) share one sum slot and
+  one count slot; COUNT(*) rides the per-group row count, and any
+  count whose ok-mask turns out to equal the row mask at trace time
+  aliases the row-count reduction instead of re-running it.  TPC-H
+  Q1's 8 aggregates touch 5 unique sum slots, not 8 sums + 8 counts.
 - **Accumulation (device, jitted)**: one fused kernel evaluates every
-  aggregate argument and scatter-adds/mins/maxes into fixed-capacity
-  accumulators (`array.at[ids].add/min/max` = XLA scatter).  Masked-out
-  or null rows contribute identity elements — the kernel never syncs a
-  mask to the host.
+  slot argument and updates fixed-capacity accumulators.  Small group
+  counts (<= DENSE_GROUP_MAX) use a one-hot [rows, G] matmul — the
+  MXU's shape; XLA lowers the f64 contraction to double-float passes.
+  Masked-out or null rows contribute identity elements — the kernel
+  never syncs a mask to the host.
 - **Finalization**: AVG = SUM/COUNT; grouped keys observed only in
   filtered-out rows (count 0) are dropped.
 - **Distributed**: the accumulators are exactly the per-shard partial
@@ -66,17 +75,43 @@ def group_capacity(n: int) -> int:
     return cap
 
 
+def _row_bytes_view(a: np.ndarray) -> np.ndarray:
+    """(N, K) int64 -> (N,) opaque-bytes view with a consistent total
+    order (memcmp), used for cross-batch key identity."""
+    a = np.ascontiguousarray(a)
+    return a.view([("", a.dtype)] * a.shape[1]).ravel()
+
+
 class GroupKeyEncoder:
-    """Host-side dense encoder of group-key tuples -> stable group ids."""
+    """Host-side dense encoder of group-key tuples -> stable group ids.
+
+    Vectorized: the known key set lives in a sorted row-view array
+    matched with `searchsorted`; no per-key Python dict operations, so
+    encoding stays numpy-speed at 10^6 groups.
+    """
 
     def __init__(self, num_keys: int):
         self.num_keys = num_keys
-        self.key_to_id: dict[tuple, int] = {}
-        self.keys: list[tuple] = []
+        k = max(2 * num_keys, 1)
+        self._arr = np.empty((0, k), dtype=np.int64)  # key rows by group id
+        self._sorted_rows = _row_bytes_view(self._arr)  # sorted row view
+        self._sorted_ids = np.empty(0, dtype=np.int64)
 
     @property
     def num_groups(self) -> int:
-        return len(self.keys)
+        return len(self._arr)
+
+    @staticmethod
+    def _to_int64(c: np.ndarray) -> np.ndarray:
+        """Lossless int64 image of a key column.  Floats are *bit-cast*
+        (a value cast would merge 1.5 and 1.7); -0.0 normalizes to 0.0
+        and NaNs to one canonical NaN so SQL equality groups them."""
+        if c.dtype.kind == "f":
+            c = c.astype(np.float64)
+            c = np.where(c == 0.0, 0.0, c)  # -0.0 == 0.0
+            c = np.where(np.isnan(c), np.float64(np.nan), c)
+            return c.view(np.int64)
+        return c.astype(np.int64)
 
     def encode(
         self,
@@ -91,42 +126,57 @@ class GroupKeyEncoder:
         """
         rows = []
         for c, v in zip(key_cols, key_valids):
-            c = np.asarray(c)
+            c = self._to_int64(np.asarray(c))
             if v is None:
-                rows.append(c.astype(np.int64))
+                rows.append(c)
                 rows.append(np.zeros(len(c), dtype=np.int64))
             else:
                 v = np.asarray(v)
-                rows.append(np.where(v, c, 0).astype(np.int64))
+                rows.append(np.where(v, c, np.int64(0)))
                 rows.append((~v).astype(np.int64))
-        stacked = np.stack(rows)  # (2K, n)
+        stacked = np.stack(rows, axis=1)  # (n, 2K)
         # Fast path: pack the key tuple into one int64 (mixed radix), so
-        # uniquing is a single 1-D sort instead of np.unique(axis=1)'s
-        # structured-view argsort (~40x slower).
+        # per-batch uniquing is a single 1-D sort; the pack is per-batch
+        # only — cross-batch identity goes through the row-bytes view.
         packed = self._pack(stacked)
         if packed is not None:
             _, first, inv = np.unique(packed, return_index=True, return_inverse=True)
         else:
             _, first, inv = np.unique(
-                stacked, axis=1, return_index=True, return_inverse=True
+                _row_bytes_view(stacked), return_index=True, return_inverse=True
             )
-        lut = np.empty(len(first), dtype=np.int32)
-        for j, row_idx in enumerate(first):
-            key = tuple(stacked[:, row_idx].tolist())
-            gid = self.key_to_id.get(key)
-            if gid is None:
-                gid = len(self.keys)
-                self.key_to_id[key] = gid
-                self.keys.append(key)
-            lut[j] = gid
+        urows = stacked[first]  # (U, 2K), per-batch unique keys
+        uview = _row_bytes_view(urows)
+        order = np.argsort(uview)  # row-bytes order for searchsorted
+        sview = uview[order]
+        pos = np.searchsorted(self._sorted_rows, sview)
+        found = np.zeros(len(sview), dtype=bool)
+        in_range = pos < len(self._sorted_rows)
+        found[in_range] = self._sorted_rows[pos[in_range]] == sview[in_range]
+
+        lut_sorted = np.empty(len(sview), dtype=np.int64)
+        lut_sorted[found] = self._sorted_ids[pos[found]]
+        n_new = int((~found).sum())
+        if n_new:
+            new_ids = np.arange(
+                self.num_groups, self.num_groups + n_new, dtype=np.int64
+            )
+            lut_sorted[~found] = new_ids
+            self._arr = np.concatenate([self._arr, urows[order][~found]])
+            ins = pos[~found]  # insertion points into the old sorted view
+            self._sorted_rows = np.insert(self._sorted_rows, ins, sview[~found])
+            self._sorted_ids = np.insert(self._sorted_ids, ins, new_ids)
+
+        lut = np.empty(len(uview), dtype=np.int64)
+        lut[order] = lut_sorted
         return lut[inv].astype(np.int32)
 
     @staticmethod
     def _pack(stacked: np.ndarray) -> Optional[np.ndarray]:
-        """Mixed-radix pack of (2K, n) int64 key parts into (n,) int64;
+        """Mixed-radix pack of (n, 2K) int64 key parts into (n,) int64;
         None when the combined range could overflow 63 bits."""
-        mins = stacked.min(axis=1).tolist()
-        maxs = stacked.max(axis=1).tolist()
+        mins = stacked.min(axis=0).tolist()
+        maxs = stacked.max(axis=0).tolist()
         # ranges in Python ints: a single int64 column can span > 2^63,
         # which would wrap (and slip past the bail-out) in int64 math
         ranges = [int(mx) - int(mn) + 1 for mn, mx in zip(mins, maxs)]
@@ -137,21 +187,42 @@ class GroupKeyEncoder:
                 return None
         # total <= 2^62 implies every range (and every shifted value)
         # fits comfortably in int64
-        packed = np.zeros(stacked.shape[1], dtype=np.int64)
-        for k in range(stacked.shape[0]):
-            packed = packed * np.int64(ranges[k]) + (stacked[k] - np.int64(mins[k]))
+        packed = np.zeros(stacked.shape[0], dtype=np.int64)
+        for k in range(stacked.shape[1]):
+            packed = packed * np.int64(ranges[k]) + (stacked[:, k] - np.int64(mins[k]))
         return packed
 
     def key_column(self, k: int):
         """(values, validity) of key position k across all groups, in
         group-id order; validity None when no group has a NULL key."""
-        vals = np.asarray([key[2 * k] for key in self.keys])
-        isnull = np.asarray([bool(key[2 * k + 1]) for key in self.keys])
+        vals = self._arr[:, 2 * k].copy()
+        isnull = self._arr[:, 2 * k + 1] != 0
         return vals, (None if not isnull.any() else ~isnull)
 
 
+class _Slot:
+    """One deduplicated accumulator column.
+
+    kind: "sum" (also serves AVG), "cnt" (non-null count of one arg),
+    "min"/"max", "smin"/"smax" (Utf8 via dictionary ranks).
+    """
+
+    __slots__ = ("kind", "arg", "fn", "acc_dtype", "arg_index")
+
+    def __init__(self, kind, arg, fn, acc_dtype, arg_index=None):
+        self.kind = kind
+        self.arg = arg
+        self.fn = fn
+        self.acc_dtype = acc_dtype
+        self.arg_index = arg_index  # column index for string slots
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind in ("smin", "smax")
+
+
 class AggregateSpec:
-    """One aggregate function lowered to accumulator slots."""
+    """One aggregate function, resolved to its accumulator slots."""
 
     def __init__(self, expr: AggregateFunction, input_schema: Schema):
         self.name = expr.name.lower()
@@ -173,21 +244,19 @@ class AggregateSpec:
             )
         if self.name in ("sum", "avg") and self.arg_type == DataType.UTF8:
             raise NotSupportedError(f"{expr.name} over Utf8 is not supported")
+        # slot references, filled by AggregateRelation._build_slots
+        self.sum_slot: Optional[int] = None
+        self.cnt_slot: Optional[int] = None  # None => per-group row count
+        self.minmax_slot: Optional[int] = None
 
     @property
-    def acc_dtype(self) -> np.dtype:
-        if self.is_string:
-            return np.dtype(np.int32)  # best code; -1 = no value yet
+    def sum_dtype(self) -> np.dtype:
         npd = self.arg_type.np_dtype
-        if self.name in ("sum", "avg"):
-            if self.arg_type.is_signed_integer:
-                return np.dtype(np.int64)
-            if self.arg_type.is_unsigned_integer:
-                return np.dtype(np.uint64)
-            return npd
-        if self.name == "count":
+        if self.arg_type.is_signed_integer:
             return np.dtype(np.int64)
-        return npd  # min/max keep the arg dtype
+        if self.arg_type.is_unsigned_integer:
+            return np.dtype(np.uint64)
+        return npd
 
 
 def _min_identity(dtype: np.dtype):
@@ -246,7 +315,7 @@ class AggregateRelation(Relation):
 
         compiler = ExprCompiler(in_schema, functions)
         self._pred_fn = compiler.compile(predicate) if predicate is not None else None
-        self._arg_fns = [compiler.compile(s.arg) for s in self.specs]
+        self.slots = self._build_slots(compiler)
         self._aux_specs = compiler.aux_specs
         self._aux_cache: dict = {}
         self.encoder = GroupKeyEncoder(len(self.key_cols))
@@ -255,18 +324,55 @@ class AggregateRelation(Relation):
         self._str_aux_cache: dict = {}
         self._jit = jax.jit(self._kernel)
 
+    def _build_slots(self, compiler: ExprCompiler) -> list[_Slot]:
+        """Deduplicate aggregates into accumulator slots.  SUM(x) and
+        AVG(x) share one sum slot; their validity counts (and any
+        COUNT(x)) share one cnt slot per distinct argument; COUNT(*)
+        rides the per-group row count (slot None).  A cnt slot whose
+        argument carries no validity further aliases the row-count
+        reduction at trace time (see _dense_update/_scatter_update)."""
+        slots: list[_Slot] = []
+        index: dict[tuple, int] = {}
+
+        def get(kind, arg, acc_dtype, arg_index=None):
+            key = (kind, arg)
+            hit = index.get(key)
+            if hit is not None:
+                return hit
+            index[key] = len(slots)
+            slots.append(_Slot(kind, arg, compiler.compile(arg), acc_dtype, arg_index))
+            return index[key]
+
+        for s in self.specs:
+            if s.is_string:
+                kind = "smin" if s.name == "min" else "smax"
+                s.minmax_slot = get(kind, s.arg, np.dtype(np.int32), s.arg.index)
+            elif s.name in ("sum", "avg"):
+                s.sum_slot = get("sum", s.arg, s.sum_dtype)
+                s.cnt_slot = get("cnt", s.arg, np.dtype(np.int64))
+            elif s.name == "count":
+                # COUNT(*) counts rows; COUNT(x) counts non-null x
+                s.cnt_slot = None if s.count_star else get(
+                    "cnt", s.arg, np.dtype(np.int64)
+                )
+            else:
+                s.minmax_slot = get(
+                    s.name, s.arg, np.dtype(s.arg_type.np_dtype)
+                )
+        return slots
+
     def _compute_str_aux(self, batch: RecordBatch):
-        """(ranks, rank->code) pair per string min/max spec, padded to a
+        """(ranks, rank->code) pair per string min/max slot, padded to a
         bucketed capacity, cached per dictionary version."""
         out = []
-        for k, s in enumerate(self.specs):
-            if not s.is_string:
+        for k, sl in enumerate(self.slots):
+            if not sl.is_string:
                 out.append(None)
                 continue
-            d = batch.dicts[s.arg.index]
+            d = batch.dicts[sl.arg_index]
             if d is None:
                 raise ExecutionError(
-                    f"column {s.arg.index} has no dictionary for {s.name.upper()}"
+                    f"column {sl.arg_index} has no dictionary for {sl.kind}"
                 )
             self._str_dicts[k] = d
             key = (k, d.version)
@@ -288,22 +394,22 @@ class AggregateRelation(Relation):
     def schema(self) -> Schema:
         return self._schema
 
-    # -- accumulator state: (counts, tuple(per-spec accumulators)) --
+    # -- accumulator state: (counts, tuple(per-slot accumulators)) --
+    def _slot_identity(self, sl: _Slot):
+        if sl.kind == "smin" or sl.kind == "smax":
+            return np.asarray(-1, np.int32)
+        if sl.kind in ("sum", "cnt"):
+            return np.asarray(0, sl.acc_dtype)
+        if sl.kind == "min":
+            return _min_identity(sl.acc_dtype)
+        return _max_identity(sl.acc_dtype)
+
     def _init_state(self, capacity: int):
-        accs = []
-        for s in self.specs:
-            d = s.acc_dtype
-            if s.is_string:
-                accs.append(jnp.full(capacity, -1, jnp.int32))
-            elif s.name in ("sum", "avg"):
-                accs.append((jnp.zeros(capacity, d), jnp.zeros(capacity, jnp.int64)))
-            elif s.name == "count":
-                accs.append(jnp.zeros(capacity, jnp.int64))
-            elif s.name == "min":
-                accs.append(jnp.full(capacity, _min_identity(d)))
-            else:
-                accs.append(jnp.full(capacity, _max_identity(d)))
-        return jnp.zeros(capacity, jnp.int64), tuple(accs)
+        accs = tuple(
+            jnp.full(capacity, jnp.asarray(self._slot_identity(sl)))
+            for sl in self.slots
+        )
+        return jnp.zeros(capacity, jnp.int64), accs
 
     def _grow_state(self, state, new_capacity: int):
         """Dense group ids are stable: growth is identity padding."""
@@ -313,19 +419,10 @@ class AggregateRelation(Relation):
         def grow(a, fill):
             return jnp.concatenate([a, jnp.full(pad, jnp.asarray(fill, a.dtype))])
 
-        new_accs = []
-        for s, acc in zip(self.specs, accs):
-            if s.is_string:
-                new_accs.append(grow(acc, -1))
-            elif s.name in ("sum", "avg"):
-                new_accs.append((grow(acc[0], 0), grow(acc[1], 0)))
-            elif s.name == "count":
-                new_accs.append(grow(acc, 0))
-            elif s.name == "min":
-                new_accs.append(grow(acc, _min_identity(np.dtype(acc.dtype))))
-            else:
-                new_accs.append(grow(acc, _max_identity(np.dtype(acc.dtype))))
-        return grow(counts, 0), tuple(new_accs)
+        new_accs = tuple(
+            grow(acc, self._slot_identity(sl)) for sl, acc in zip(self.slots, accs)
+        )
+        return grow(counts, 0), new_accs
 
     def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state,
                 str_aux=()):
@@ -347,14 +444,15 @@ class AggregateRelation(Relation):
             return self._dense_update(env, capacity, mask, ids, counts, accs, str_aux)
         return self._scatter_update(env, capacity, mask, ids, counts, accs, str_aux)
 
-    def _spec_inputs(self, env, capacity, mask):
-        """(value, ok-mask) per spec, masking padding/filtered/null rows."""
+    def _slot_inputs(self, env, capacity, mask):
+        """(value, ok-mask) per slot, masking padding/filtered/null
+        rows.  `ok is mask` when the argument has no validity — update
+        paths use that identity to alias the row-count reduction."""
         out = []
-        for s, fn in zip(self.specs, self._arg_fns):
-            v, valid = fn(env)
+        for sl in self.slots:
+            v, valid = sl.fn(env)
             v = jnp.broadcast_to(v, (capacity,))
-            if valid is None or s.count_star:
-                # COUNT(*) counts rows regardless of column nullity
+            if valid is None:
                 ok = mask
             else:
                 ok = mask & jnp.broadcast_to(valid, (capacity,))
@@ -362,36 +460,36 @@ class AggregateRelation(Relation):
         return out
 
     @staticmethod
-    def _string_combine(s, acc, batch_best_rank, str_aux_k):
+    def _string_combine(kind, acc, batch_best_rank, str_aux_k):
         """Merge a per-group best-rank candidate into a best-code
         accumulator (codes are stable across batches; ranks are valid
         only within the current dictionary version)."""
         ranks, order = str_aux_k
         cap = ranks.shape[0]
-        sentinel = jnp.int32(2**31 - 1) if s.name == "min" else jnp.int32(-1)
+        sentinel = jnp.int32(2**31 - 1) if kind == "smin" else jnp.int32(-1)
         old_rank = jnp.where(
             acc >= 0, ranks[jnp.clip(acc, 0, cap - 1)], sentinel
         )
-        if s.name == "min":
+        if kind == "smin":
             best = jnp.minimum(batch_best_rank, old_rank)
-            alive = best != sentinel
         else:
             best = jnp.maximum(batch_best_rank, old_rank)
-            alive = best != sentinel
+        alive = best != sentinel
         return jnp.where(alive, order[jnp.clip(best, 0, cap - 1)], -1).astype(jnp.int32)
 
     def _scatter_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """General path (group capacity > DENSE_GROUP_MAX): XLA scatter."""
+        counts_in = counts
         counts = counts.at[ids].add(mask.astype(jnp.int64))
         new_accs = []
-        inputs = self._spec_inputs(env, capacity, mask)
+        inputs = self._slot_inputs(env, capacity, mask)
         G = counts.shape[0]
-        for k, (s, (v, ok), acc) in enumerate(zip(self.specs, inputs, accs)):
-            if s.is_string:
+        for k, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+            if sl.is_string:
                 ranks, _ = str_aux[k]
                 cap = ranks.shape[0]
                 r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
-                if s.name == "min":
+                if sl.kind == "smin":
                     sentinel = jnp.int32(2**31 - 1)
                     cand = jnp.where(ok, r, sentinel)
                     batch_best = jnp.full(G, sentinel).at[ids].min(cand)
@@ -399,17 +497,18 @@ class AggregateRelation(Relation):
                     sentinel = jnp.int32(-1)
                     cand = jnp.where(ok, r, sentinel)
                     batch_best = jnp.full(G, sentinel).at[ids].max(cand)
-                new_accs.append(self._string_combine(s, acc, batch_best, str_aux[k]))
-                continue
-            if s.name in ("sum", "avg"):
-                acc_sum, acc_cnt = acc
-                contrib = jnp.where(ok, v, 0).astype(acc_sum.dtype)
-                new_accs.append(
-                    (acc_sum.at[ids].add(contrib), acc_cnt.at[ids].add(ok.astype(jnp.int64)))
-                )
-            elif s.name == "count":
-                new_accs.append(acc.at[ids].add(ok.astype(jnp.int64)))
-            elif s.name == "min":
+                new_accs.append(self._string_combine(sl.kind, acc, batch_best, str_aux[k]))
+            elif sl.kind == "sum":
+                contrib = jnp.where(ok, v, 0).astype(acc.dtype)
+                new_accs.append(acc.at[ids].add(contrib))
+            elif sl.kind == "cnt":
+                if ok is mask:
+                    # trace-time alias: this count is the row count —
+                    # reuse its scatter instead of re-running it
+                    new_accs.append(acc + (counts - counts_in))
+                else:
+                    new_accs.append(acc.at[ids].add(ok.astype(jnp.int64)))
+            elif sl.kind == "min":
                 ident = _min_identity(np.dtype(acc.dtype))
                 new_accs.append(acc.at[ids].min(jnp.where(ok, v.astype(acc.dtype), ident)))
             else:
@@ -419,43 +518,41 @@ class AggregateRelation(Relation):
 
     def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """Small-group path: segment reduction via a one-hot [rows, G]
-        matrix.  Float sums/counts stack into ONE [rows, S] @ [rows, G]
-        matmul (the MXU's shape); int sums and min/max are fused
-        broadcast-reduces over [rows, G].  No scatter anywhere."""
+        matrix.  Float sums and all counts stack into ONE
+        [S, rows] @ [rows, G] matmul (the MXU's shape; XLA lowers the
+        f64 contraction to double-float MXU passes); int sums and
+        min/max are fused broadcast-reduces over [rows, G].  Count
+        columns whose ok-mask IS the row mask alias the row-count
+        matmul row instead of duplicating it.  No scatter anywhere."""
         G = counts.shape[0]
         onehot_b = ids[:, None] == jnp.arange(G, dtype=ids.dtype)[None, :]
-        inputs = self._spec_inputs(env, capacity, mask)
+        inputs = self._slot_inputs(env, capacity, mask)
 
-        # -- one matmul for every f64-accumulated slot + all counts --
-        mat_cols = [mask.astype(jnp.float64)]  # row-count column
-        mat_slots: list[tuple] = [("rowcount", None)]
-        for i, (s, (v, ok)) in enumerate(zip(self.specs, inputs)):
-            if s.name in ("sum", "avg") and np.dtype(s.acc_dtype).kind == "f":
+        # -- one matmul for every f-dtype sum slot + all count columns --
+        mat_cols = [mask.astype(jnp.float64)]  # row 0: row count
+        mat_row_of: dict[int, int] = {}  # slot index -> matmul row
+        for i, (sl, (v, ok)) in enumerate(zip(self.slots, inputs)):
+            if sl.kind == "sum" and sl.acc_dtype.kind == "f":
+                mat_row_of[i] = len(mat_cols)
                 mat_cols.append(jnp.where(ok, v, 0.0).astype(jnp.float64))
-                mat_slots.append(("sum", i))
-            if s.name in ("sum", "avg", "count"):
-                mat_cols.append(ok.astype(jnp.float64))
-                mat_slots.append(("cnt", i))
+            elif sl.kind == "cnt":
+                if ok is mask:
+                    mat_row_of[i] = 0  # alias the row-count column
+                else:
+                    mat_row_of[i] = len(mat_cols)
+                    mat_cols.append(ok.astype(jnp.float64))
         stacked = jnp.stack(mat_cols, axis=1)  # [rows, S]
         onehot_f = onehot_b.astype(jnp.float64)
         sums = stacked.T @ onehot_f  # [S, G]
 
         new_counts = counts + sums[0].astype(jnp.int64)
-        per_spec_sum: dict[int, jnp.ndarray] = {}
-        per_spec_cnt: dict[int, jnp.ndarray] = {}
-        for row, (kind, i) in enumerate(mat_slots):
-            if kind == "sum":
-                per_spec_sum[i] = sums[row]
-            elif kind == "cnt":
-                per_spec_cnt[i] = sums[row].astype(jnp.int64)
-
         new_accs = []
-        for i, (s, (v, ok), acc) in enumerate(zip(self.specs, inputs, accs)):
-            if s.is_string:
+        for i, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+            if sl.is_string:
                 ranks, _ = str_aux[i]
                 cap = ranks.shape[0]
                 r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
-                if s.name == "min":
+                if sl.kind == "smin":
                     sentinel = jnp.int32(2**31 - 1)
                     cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
                     batch_best = jnp.min(cell, axis=0)
@@ -463,36 +560,34 @@ class AggregateRelation(Relation):
                     sentinel = jnp.int32(-1)
                     cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
                     batch_best = jnp.max(cell, axis=0)
-                new_accs.append(self._string_combine(s, acc, batch_best, str_aux[i]))
-                continue
-            if s.name in ("sum", "avg"):
-                acc_sum, acc_cnt = acc
-                if i in per_spec_sum:
-                    contrib = per_spec_sum[i].astype(acc_sum.dtype)
+                new_accs.append(self._string_combine(sl.kind, acc, batch_best, str_aux[i]))
+            elif sl.kind == "sum":
+                if i in mat_row_of:
+                    contrib = sums[mat_row_of[i]].astype(acc.dtype)
                 else:
                     # integer sums: exact int64 broadcast-reduce (a f64
                     # matmul would round above 2^53)
                     contrib = jnp.sum(
                         jnp.where(
-                            onehot_b & ok[:, None], v[:, None].astype(acc_sum.dtype), 0
+                            onehot_b & ok[:, None], v[:, None].astype(acc.dtype), 0
                         ),
                         axis=0,
                     )
-                new_accs.append((acc_sum + contrib, acc_cnt + per_spec_cnt[i]))
-            elif s.name == "count":
-                new_accs.append(acc + per_spec_cnt[i])
-            elif s.name in ("min", "max"):
+                new_accs.append(acc + contrib)
+            elif sl.kind == "cnt":
+                new_accs.append(acc + sums[mat_row_of[i]].astype(jnp.int64))
+            else:
                 ident = (
                     _min_identity(np.dtype(acc.dtype))
-                    if s.name == "min"
+                    if sl.kind == "min"
                     else _max_identity(np.dtype(acc.dtype))
                 )
                 cell = jnp.where(
                     onehot_b & ok[:, None], v[:, None].astype(acc.dtype), ident
                 )
-                red = jnp.min(cell, axis=0) if s.name == "min" else jnp.max(cell, axis=0)
+                red = jnp.min(cell, axis=0) if sl.kind == "min" else jnp.max(cell, axis=0)
                 new_accs.append(
-                    jnp.minimum(acc, red) if s.name == "min" else jnp.maximum(acc, red)
+                    jnp.minimum(acc, red) if sl.kind == "min" else jnp.maximum(acc, red)
                 )
         return new_counts, tuple(new_accs)
 
@@ -590,21 +685,28 @@ class AggregateRelation(Relation):
             keys, kvalid = self.encoder.key_column(k)
             keys = keys[live]
             f = in_schema.field(idx)
-            out_cols.append(keys.astype(f.data_type.np_dtype))
+            npd = np.dtype(f.data_type.np_dtype)
+            if npd.kind == "f":
+                # float keys were bit-cast into the encoder; bit-cast back
+                out_cols.append(keys.view(np.float64).astype(npd))
+            else:
+                out_cols.append(keys.astype(npd))
             out_valid.append(None if kvalid is None else kvalid[live])
             out_dicts.append(self._key_dicts.get(idx))
 
-        for k, (s, acc) in enumerate(zip(self.specs, accs)):
+        slot_host = [np.asarray(a)[live] for a in accs]
+        live_counts = counts[live]
+        for s in self.specs:
             if s.is_string:
-                codes = np.asarray(acc)[live].astype(np.int32)
+                codes = slot_host[s.minmax_slot].astype(np.int32)
                 valid = codes >= 0
                 out_cols.append(np.where(valid, codes, 0).astype(np.int32))
                 out_valid.append(None if bool(valid.all()) else valid)
-                out_dicts.append(self._str_dicts.get(k))
+                out_dicts.append(self._str_dicts.get(s.minmax_slot))
                 continue
             if s.name in ("sum", "avg"):
-                sums = np.asarray(acc[0])[live]
-                cnts = np.asarray(acc[1])[live]
+                sums = slot_host[s.sum_slot]
+                cnts = slot_host[s.cnt_slot]
                 if s.name == "sum":
                     vals = sums.astype(s.return_type.np_dtype)
                 else:
@@ -613,14 +715,15 @@ class AggregateRelation(Relation):
                     )
                 valid = cnts > 0
             elif s.name == "count":
-                vals = np.asarray(acc)[live].astype(s.return_type.np_dtype)
+                raw = live_counts if s.cnt_slot is None else slot_host[s.cnt_slot]
+                vals = raw.astype(s.return_type.np_dtype)
                 valid = None
             elif s.name == "min":
-                raw = np.asarray(acc)[live]
+                raw = slot_host[s.minmax_slot]
                 vals = raw.astype(s.return_type.np_dtype)
                 valid = raw != _min_identity(np.dtype(raw.dtype))
             else:
-                raw = np.asarray(acc)[live]
+                raw = slot_host[s.minmax_slot]
                 vals = raw.astype(s.return_type.np_dtype)
                 valid = raw != _max_identity(np.dtype(raw.dtype))
             if valid is not None and bool(np.asarray(valid).all()):

@@ -245,17 +245,17 @@ class PartitionedAggregateRelation(AggregateRelation):
         counts, accs = state
         fin_counts = lax.psum(counts, MESH_AXIS)[0]
         fin_accs = []
-        for s, acc in zip(self.specs, accs):
-            if s.name in ("sum", "avg"):
-                fin_accs.append(
-                    (lax.psum(acc[0], MESH_AXIS)[0], lax.psum(acc[1], MESH_AXIS)[0])
-                )
-            elif s.name == "count":
+        for sl, acc in zip(self.slots, accs):
+            if sl.kind in ("sum", "cnt"):
                 fin_accs.append(lax.psum(acc, MESH_AXIS)[0])
-            elif s.name == "min":
+            elif sl.kind == "min":
                 fin_accs.append(lax.pmin(acc, MESH_AXIS)[0])
-            else:
+            elif sl.kind == "max":
                 fin_accs.append(lax.pmax(acc, MESH_AXIS)[0])
+            else:  # smin/smax: excluded by _match_partitioned_aggregate
+                raise ExecutionError(
+                    "string min/max is not wired into the mesh combine"
+                )
         return fin_counts, tuple(fin_accs)
 
     # -- stacked state management --
@@ -277,19 +277,11 @@ class PartitionedAggregateRelation(AggregateRelation):
             block = jnp.full((self.n_shards, pad), jnp.asarray(fill, a.dtype))
             return jnp.concatenate([a, block], axis=1)
 
-        from datafusion_tpu.exec.aggregate import _max_identity, _min_identity
-
-        new_accs = []
-        for s, acc in zip(self.specs, accs):
-            if s.name in ("sum", "avg"):
-                new_accs.append((grow(acc[0], 0), grow(acc[1], 0)))
-            elif s.name == "count":
-                new_accs.append(grow(acc, 0))
-            elif s.name == "min":
-                new_accs.append(grow(acc, _min_identity(np.dtype(acc.dtype))))
-            else:
-                new_accs.append(grow(acc, _max_identity(np.dtype(acc.dtype))))
-        return self._shard_state((grow(counts, 0), tuple(new_accs)))
+        new_accs = tuple(
+            grow(acc, self._slot_identity(sl))
+            for sl, acc in zip(self.slots, accs)
+        )
+        return self._shard_state((grow(counts, 0), new_accs))
 
     # -- the partitioned scan loop --
     def accumulate(self):

@@ -310,3 +310,109 @@ def test_or_with_null_operand(ctx):
         "SELECT c_int FROM null_test WHERE c_float > 0.0 AND c_int > 0"
     )
     assert t.column_values(0) == [1, 2, 4, 5]
+
+
+class TestHighCardinalityGroupBy:
+    def _mem_ctx(self, n, n_groups, seed=0, batch=4096):
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        rng = np.random.default_rng(seed)
+        schema = Schema(
+            [Field("k", DataType.INT64, False), Field("v", DataType.FLOAT64, False)]
+        )
+        keys = rng.integers(0, n_groups, n)
+        vals = rng.uniform(0, 100, n)
+        batches = [
+            make_host_batch(
+                schema,
+                [keys[i : i + batch], vals[i : i + batch]],
+                [None, None],
+                [None, None],
+            )
+            for i in range(0, n, batch)
+        ]
+        ctx = ExecutionContext(batch_size=batch)
+        ctx.register_datasource("t", MemoryDataSource(schema, batches))
+        return ctx, keys, vals
+
+    def test_many_groups_across_batches(self):
+        # far above DENSE_GROUP_MAX: exercises the vectorized encoder
+        # and the large-capacity update path over multiple batches
+        n, n_groups = 40_000, 5_000
+        ctx, keys, vals = self._mem_ctx(n, n_groups)
+        t = ctx.sql_collect(
+            "SELECT k, SUM(v), COUNT(1), MIN(v), AVG(v) FROM t GROUP BY k"
+        )
+        assert t.num_rows == len(np.unique(keys))
+        got = {r[0]: r[1:] for r in t.to_rows()}
+        for g in np.unique(keys)[:50]:
+            sel = vals[keys == g]
+            s, c, mn, av = got[int(g)]
+            np.testing.assert_allclose(s, sel.sum(), rtol=1e-12)
+            assert c == len(sel)
+            np.testing.assert_allclose(mn, sel.min(), rtol=1e-12)
+            np.testing.assert_allclose(av, sel.mean(), rtol=1e-12)
+
+    def test_slot_sharing_sum_avg_count(self):
+        # SUM(v)/AVG(v)/COUNT(v) share accumulator slots; results must
+        # still be independent and correct
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+
+        n, n_groups = 10_000, 7
+        ctx, keys, vals = self._mem_ctx(n, n_groups)
+        rel = ctx.sql("SELECT k, SUM(v), AVG(v), COUNT(1), COUNT(k) FROM t GROUP BY k")
+        agg = rel
+        while not isinstance(agg, AggregateRelation):
+            agg = agg.child
+        # 1 shared sum slot + 1 shared cnt slot for v, 1 cnt slot for k
+        assert len(agg.slots) == 3
+        from datafusion_tpu.exec.materialize import collect
+
+        t = collect(rel)
+        got = {r[0]: r[1:] for r in t.to_rows()}
+        for g in range(n_groups):
+            sel = vals[keys == g]
+            s, av, c1, ck = got[g]
+            np.testing.assert_allclose(s, sel.sum(), rtol=1e-12)
+            np.testing.assert_allclose(av, sel.mean(), rtol=1e-12)
+            assert c1 == len(sel) and ck == len(sel)
+
+    def test_encoder_null_keys_and_growth(self):
+        from datafusion_tpu.exec.aggregate import GroupKeyEncoder
+
+        enc = GroupKeyEncoder(1)
+        a = np.asarray([5, 7, 5, 9], np.int64)
+        ids1 = enc.encode([a], [np.asarray([True, True, False, True])])
+        # 5, 7, NULL, 9 -> 4 distinct groups (NULL groups separately)
+        assert len(set(ids1.tolist())) == 4
+        # same keys in a later batch map to the same ids
+        ids2 = enc.encode([a], [np.asarray([True, True, False, True])])
+        np.testing.assert_array_equal(ids1, ids2)
+        # new keys get fresh ids, old ids stable
+        ids3 = enc.encode([np.asarray([7, 100], np.int64)], [None])
+        assert ids3[0] == ids1[1]
+        assert ids3[1] == enc.num_groups - 1
+        vals, valid = enc.key_column(0)
+        assert valid is not None and not valid[ids1[2]]
+
+    def test_float_group_keys_bitcast(self):
+        # float GROUP BY keys must not merge 1.5 and 1.7 (value cast)
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema(
+            [Field("k", DataType.FLOAT64, False), Field("v", DataType.INT64, False)]
+        )
+        k = np.asarray([1.5, 1.7, 2.5, 1.5, -0.0, 0.0])
+        v = np.asarray([1, 2, 4, 8, 16, 32], np.int64)
+        ctx2 = ExecutionContext()
+        ctx2.register_datasource(
+            "ft",
+            MemoryDataSource(
+                schema, [make_host_batch(schema, [k, v], [None, None], [None, None])]
+            ),
+        )
+        t = ctx2.sql_collect("SELECT k, SUM(v) FROM ft GROUP BY k")
+        got = {r[0]: r[1] for r in t.to_rows()}
+        assert got == {1.5: 9, 1.7: 2, 2.5: 4, 0.0: 48}
