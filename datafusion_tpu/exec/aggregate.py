@@ -25,8 +25,12 @@ The reference never implemented aggregation (`context.rs:161`
   slot argument and updates fixed-capacity accumulators.  Small group
   counts (<= DENSE_GROUP_MAX) use a one-hot [rows, G] matmul — the
   MXU's shape; XLA lowers the f64 contraction to double-float passes.
-  Masked-out or null rows contribute identity elements — the kernel
-  never syncs a mask to the host.
+  Larger group counts use **sort-merge aggregation**: XLA scatter is
+  serial on TPU, so the state and batch are sorted together by group
+  id (`lax.sort` is fast), runs of equal ids reduce with segmented
+  associative scans, and a second sort compacts totals back to the
+  dense layout.  Masked-out or null rows contribute identity
+  elements — the kernel never syncs a mask to the host.
 - **Finalization**: AVG = SUM/COUNT; grouped keys observed only in
   filtered-out rows (count 0) are dropped.
 - **Distributed**: the accumulators are exactly the per-shard partial
@@ -330,7 +334,7 @@ class AggregateRelation(Relation):
         COUNT(x)) share one cnt slot per distinct argument; COUNT(*)
         rides the per-group row count (slot None).  A cnt slot whose
         argument carries no validity further aliases the row-count
-        reduction at trace time (see _dense_update/_scatter_update)."""
+        reduction at trace time (see _dense_update/_sortmerge_update)."""
         slots: list[_Slot] = []
         index: dict[tuple, int] = {}
 
@@ -442,7 +446,7 @@ class AggregateRelation(Relation):
         group_cap = counts.shape[0]
         if group_cap <= DENSE_GROUP_MAX:
             return self._dense_update(env, capacity, mask, ids, counts, accs, str_aux)
-        return self._scatter_update(env, capacity, mask, ids, counts, accs, str_aux)
+        return self._sortmerge_update(env, capacity, mask, ids, counts, accs, str_aux)
 
     def _slot_inputs(self, env, capacity, mask):
         """(value, ok-mask) per slot, masking padding/filtered/null
@@ -459,62 +463,154 @@ class AggregateRelation(Relation):
             out.append((v, ok))
         return out
 
+    # -- string MIN/MAX rank arithmetic (codes are stable across
+    # batches; ranks are valid only within one dictionary version) --
     @staticmethod
-    def _string_combine(kind, acc, batch_best_rank, str_aux_k):
-        """Merge a per-group best-rank candidate into a best-code
-        accumulator (codes are stable across batches; ranks are valid
-        only within the current dictionary version)."""
-        ranks, order = str_aux_k
+    def _rank_sentinel(kind):
+        """Identity element in rank space: +inf-like for smin (any real
+        rank beats it under minimum), -1 for smax."""
+        return jnp.int32(2**31 - 1) if kind == "smin" else jnp.int32(-1)
+
+    @classmethod
+    def _codes_to_ranks(cls, kind, codes, str_aux_k):
+        """Best-code accumulator -> rank space (-1 = empty -> sentinel)."""
+        ranks, _ = str_aux_k
         cap = ranks.shape[0]
-        sentinel = jnp.int32(2**31 - 1) if kind == "smin" else jnp.int32(-1)
-        old_rank = jnp.where(
-            acc >= 0, ranks[jnp.clip(acc, 0, cap - 1)], sentinel
+        return jnp.where(
+            codes >= 0,
+            ranks[jnp.clip(codes, 0, cap - 1)],
+            cls._rank_sentinel(kind),
         )
+
+    @classmethod
+    def _ranks_to_codes(cls, kind, best, str_aux_k):
+        """Winning rank -> stable code (-1 when the group is empty)."""
+        _, order = str_aux_k
+        cap = order.shape[0]
+        alive = best != cls._rank_sentinel(kind)
+        return jnp.where(alive, order[jnp.clip(best, 0, cap - 1)], -1).astype(
+            jnp.int32
+        )
+
+    @classmethod
+    def _string_combine(cls, kind, acc, batch_best_rank, str_aux_k):
+        """Merge a per-group best-rank candidate into a best-code
+        accumulator."""
+        old_rank = cls._codes_to_ranks(kind, acc, str_aux_k)
         if kind == "smin":
             best = jnp.minimum(batch_best_rank, old_rank)
         else:
             best = jnp.maximum(batch_best_rank, old_rank)
-        alive = best != sentinel
-        return jnp.where(alive, order[jnp.clip(best, 0, cap - 1)], -1).astype(jnp.int32)
+        return cls._ranks_to_codes(kind, best, str_aux_k)
 
-    def _scatter_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
-        """General path (group capacity > DENSE_GROUP_MAX): XLA scatter."""
-        counts_in = counts
-        counts = counts.at[ids].add(mask.astype(jnp.int64))
-        new_accs = []
-        inputs = self._slot_inputs(env, capacity, mask)
+    @staticmethod
+    def _seg_scan(vals, start, combine):
+        """Segmented inclusive scan: `start` marks segment heads; the
+        value at each segment's last row is the segment reduction."""
+
+        def op(a, b):
+            av, af = a
+            bv, bf = b
+            flag = bf if bv.ndim == bf.ndim else bf[..., None]
+            return jnp.where(flag, bv, combine(av, bv)), af | bf
+
+        out, _ = jax.lax.associative_scan(op, (vals, start))
+        return out
+
+    def _sortmerge_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
+        """High-cardinality path (group capacity > DENSE_GROUP_MAX):
+        sort-merge aggregation, the scatter-free XLA shape.
+
+        XLA scatter executes serially on TPU (~50ms per 512k updates),
+        so instead: concatenate the dense state (implicit keys 0..G-1)
+        with the batch rows, `lax.sort` by group id (sorts are fast,
+        ~2.5ms at 1M rows), reduce runs of equal ids with segmented
+        associative scans, and compact segment totals back to the dense
+        layout with a second sort.  Every key in [0, G) appears at
+        least once (the state contributes all of them), so the first G
+        entries of the compaction sort are exactly groups 0..G-1.
+        """
         G = counts.shape[0]
-        for k, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+        SENT = jnp.int64(jnp.iinfo(jnp.int64).max)
+        inputs = self._slot_inputs(env, capacity, mask)
+
+        state_keys = jnp.arange(G, dtype=jnp.int64)
+        batch_keys = jnp.where(mask, ids.astype(jnp.int64), SENT)
+        keys = jnp.concatenate([state_keys, batch_keys])
+
+        # payload columns: row count first, then one per non-aliased slot
+        payloads = [jnp.concatenate([counts, mask.astype(jnp.int64)])]
+        payload_of: dict[int, int] = {}
+        for i, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+            if sl.kind == "cnt" and ok is mask:
+                continue  # aliases the row count payload
             if sl.is_string:
-                ranks, _ = str_aux[k]
+                # merge by lexicographic rank under the current dict
+                # version; state codes convert to ranks on entry
+                ranks, _ = str_aux[i]
                 cap = ranks.shape[0]
+                acc_rank = self._codes_to_ranks(sl.kind, acc, str_aux[i])
                 r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
-                if sl.kind == "smin":
-                    sentinel = jnp.int32(2**31 - 1)
-                    cand = jnp.where(ok, r, sentinel)
-                    batch_best = jnp.full(G, sentinel).at[ids].min(cand)
-                else:
-                    sentinel = jnp.int32(-1)
-                    cand = jnp.where(ok, r, sentinel)
-                    batch_best = jnp.full(G, sentinel).at[ids].max(cand)
-                new_accs.append(self._string_combine(sl.kind, acc, batch_best, str_aux[k]))
+                contrib = jnp.where(ok, r, self._rank_sentinel(sl.kind))
             elif sl.kind == "sum":
+                acc_rank = acc
                 contrib = jnp.where(ok, v, 0).astype(acc.dtype)
-                new_accs.append(acc.at[ids].add(contrib))
             elif sl.kind == "cnt":
-                if ok is mask:
-                    # trace-time alias: this count is the row count —
-                    # reuse its scatter instead of re-running it
-                    new_accs.append(acc + (counts - counts_in))
-                else:
-                    new_accs.append(acc.at[ids].add(ok.astype(jnp.int64)))
-            elif sl.kind == "min":
-                ident = _min_identity(np.dtype(acc.dtype))
-                new_accs.append(acc.at[ids].min(jnp.where(ok, v.astype(acc.dtype), ident)))
+                acc_rank = acc
+                contrib = ok.astype(jnp.int64)
             else:
-                ident = _max_identity(np.dtype(acc.dtype))
-                new_accs.append(acc.at[ids].max(jnp.where(ok, v.astype(acc.dtype), ident)))
-        return counts, tuple(new_accs)
+                ident = (
+                    _min_identity(sl.acc_dtype)
+                    if sl.kind == "min"
+                    else _max_identity(sl.acc_dtype)
+                )
+                acc_rank = acc
+                contrib = jnp.where(ok, v.astype(acc.dtype), ident)
+            payload_of[i] = len(payloads)
+            payloads.append(jnp.concatenate([acc_rank, contrib]))
+
+        sorted_ops = jax.lax.sort([keys] + payloads, num_keys=1)
+        skeys = sorted_ops[0]
+        svals = list(sorted_ops[1:])
+
+        start = jnp.concatenate(
+            [jnp.ones(1, bool), skeys[1:] != skeys[:-1]]
+        )
+        reduced = [None] * len(payloads)
+        reduced[0] = self._seg_scan(svals[0], start, jnp.add)
+        for i, sl in enumerate(self.slots):
+            p = payload_of.get(i)
+            if p is None:
+                continue
+            if sl.kind in ("sum", "cnt"):
+                reduced[p] = self._seg_scan(svals[p], start, jnp.add)
+            elif sl.kind == "min" or sl.kind == "smin":
+                reduced[p] = self._seg_scan(svals[p], start, jnp.minimum)
+            else:
+                reduced[p] = self._seg_scan(svals[p], start, jnp.maximum)
+
+        last = jnp.concatenate([skeys[1:] != skeys[:-1], jnp.ones(1, bool)])
+        dead = (~last) | (skeys == SENT)
+        ckeys = jnp.where(dead, SENT, skeys)
+        comp = jax.lax.sort(
+            [ckeys] + [jnp.where(last, r, jnp.zeros((), r.dtype)) for r in reduced],
+            num_keys=1,
+        )
+        new_counts = comp[1][:G]
+        out = list(comp[2:])
+
+        new_accs = []
+        for i, (sl, acc) in enumerate(zip(self.slots, accs)):
+            p = payload_of.get(i)
+            if p is None:  # cnt aliased to the row count
+                new_accs.append(acc + (new_counts - counts))
+                continue
+            val = out[p - 1][:G]
+            if sl.is_string:
+                new_accs.append(self._ranks_to_codes(sl.kind, val, str_aux[i]))
+            else:
+                new_accs.append(val)
+        return new_counts, tuple(new_accs)
 
     def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """Small-group path: segment reduction via a one-hot [rows, G]
@@ -552,14 +648,13 @@ class AggregateRelation(Relation):
                 ranks, _ = str_aux[i]
                 cap = ranks.shape[0]
                 r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
-                if sl.kind == "smin":
-                    sentinel = jnp.int32(2**31 - 1)
-                    cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
-                    batch_best = jnp.min(cell, axis=0)
-                else:
-                    sentinel = jnp.int32(-1)
-                    cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
-                    batch_best = jnp.max(cell, axis=0)
+                sentinel = self._rank_sentinel(sl.kind)
+                cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
+                batch_best = (
+                    jnp.min(cell, axis=0)
+                    if sl.kind == "smin"
+                    else jnp.max(cell, axis=0)
+                )
                 new_accs.append(self._string_combine(sl.kind, acc, batch_best, str_aux[i]))
             elif sl.kind == "sum":
                 if i in mat_row_of:
@@ -591,6 +686,19 @@ class AggregateRelation(Relation):
                 )
         return new_counts, tuple(new_accs)
 
+    def _pick_capacity(self, current: int) -> int:
+        """Accumulator capacity for the observed group count.  Tight
+        power-of-two steps while the dense matmul path applies (small G
+        keeps the one-hot matrix small); once past DENSE_GROUP_MAX,
+        grow with 4x headroom jumps — each distinct capacity compiles a
+        fresh sort-merge kernel (two large sorts, expensive to build),
+        so the growth ladder must be short."""
+        n = max(self.encoder.num_groups, 1)
+        needed = group_capacity(n)
+        if needed <= max(current, DENSE_GROUP_MAX):
+            return max(needed, current)
+        return group_capacity(4 * n)
+
     def accumulate(self):
         """Run the scan, returning the partial-aggregate device state.
 
@@ -607,7 +715,7 @@ class AggregateRelation(Relation):
                 if batch.dicts[idx] is not None:
                     self._key_dicts[idx] = batch.dicts[idx]
             ids = self._group_ids(batch)
-            needed = group_capacity(max(self.encoder.num_groups, 1))
+            needed = self._pick_capacity(capacity)
             if state is None:
                 capacity = needed
                 state = self._init_state(capacity)

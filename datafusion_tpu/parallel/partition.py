@@ -335,7 +335,7 @@ class PartitionedAggregateRelation(AggregateRelation):
                     ]
                     ids_np[s_i, :bc] = self.encoder.encode(key_cols, key_valids)
 
-            needed = group_capacity(max(self.encoder.num_groups, 1))
+            needed = self._pick_capacity(group_cap)
             if state is None:
                 group_cap = needed
                 state = self._init_stacked_state(group_cap)

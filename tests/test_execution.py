@@ -416,3 +416,77 @@ class TestHighCardinalityGroupBy:
         t = ctx2.sql_collect("SELECT k, SUM(v) FROM ft GROUP BY k")
         got = {r[0]: r[1] for r in t.to_rows()}
         assert got == {1.5: 9, 1.7: 2, 2.5: 4, 0.0: 48}
+
+    def test_string_minmax_many_groups_dict_growth(self):
+        # >DENSE_GROUP_MAX groups with MIN/MAX over Utf8, where batch 2
+        # grows the dictionary (ranks shift between merges)
+        from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        rng = np.random.default_rng(3)
+        schema = Schema(
+            [Field("k", DataType.INT64, False), Field("s", DataType.UTF8, False)]
+        )
+        n_groups = 200
+        d = StringDictionary()
+        all_k, all_s, batches = [], [], []
+        # batch 1 uses words starting m..z; batch 2 adds a..l words that
+        # sort BEFORE every earlier dictionary entry
+        for lo, hi in ((12, 26), (0, 26)):
+            k = rng.integers(0, n_groups, 3000)
+            words = [
+                chr(97 + rng.integers(lo, hi)) + f"{rng.integers(0, 100):02d}"
+                for _ in range(3000)
+            ]
+            codes = d.encode(words)
+            batches.append(
+                make_host_batch(schema, [k, codes], [None, None], [None, d])
+            )
+            all_k.append(k)
+            all_s.extend(words)
+        keys = np.concatenate(all_k)
+        words = np.asarray(all_s, dtype=object)
+        ctx = ExecutionContext(batch_size=4096)
+        ctx.register_datasource("st", MemoryDataSource(schema, batches))
+        t = ctx.sql_collect("SELECT k, MIN(s), MAX(s), COUNT(1) FROM st GROUP BY k")
+        assert t.num_rows == len(np.unique(keys))
+        got = {r[0]: r[1:] for r in t.to_rows()}
+        for g in np.unique(keys):
+            sel = sorted(words[keys == g])
+            mn, mx, c = got[int(g)]
+            assert mn == sel[0] and mx == sel[-1] and c == len(sel)
+
+    def test_nullable_values_many_groups(self):
+        # null handling (cnt slots diverge from row counts) on the
+        # sort-merge path, plus integer sums
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        rng = np.random.default_rng(5)
+        schema = Schema(
+            [Field("k", DataType.INT64, False), Field("v", DataType.INT64, True)]
+        )
+        n, n_groups = 20_000, 300
+        keys = rng.integers(0, n_groups, n)
+        vals = rng.integers(-50, 50, n)
+        valid = rng.random(n) < 0.7
+        batches = [
+            make_host_batch(
+                schema,
+                [keys[i : i + 4096], vals[i : i + 4096]],
+                [None, valid[i : i + 4096]],
+                [None, None],
+            )
+            for i in range(0, n, 4096)
+        ]
+        ctx = ExecutionContext(batch_size=4096)
+        ctx.register_datasource("nt", MemoryDataSource(schema, batches))
+        t = ctx.sql_collect(
+            "SELECT k, SUM(v), COUNT(v), COUNT(1), MAX(v) FROM nt GROUP BY k"
+        )
+        got = {r[0]: r[1:] for r in t.to_rows()}
+        for g in range(0, n_groups, 17):
+            m = (keys == g) & valid
+            s, cv, c1, mx = got[g]
+            assert s == vals[m].sum() and cv == m.sum()
+            assert c1 == (keys == g).sum() and mx == vals[m].max()
