@@ -1,11 +1,22 @@
 """ORDER BY / LIMIT operators.
 
 The reference planned Sort/Limit but left them `unimplemented!()`
-(`context.rs:161`).  TPU design: collect the child's (already filtered/
-projected) batches, compact to a single padded buffer, and run **one
-multi-key `lax.sort` on device** — stable, ascending, with per-key
-transforms:
+(`context.rs:161`).  TPU design, two device paths:
 
+- **Streaming TopK** (`ORDER BY ... LIMIT k`, k <= TOPK_MAX): one
+  fused kernel per batch transforms sort keys *on device* (DESC =
+  negation / bit-complement, NULLs and padding to max sentinels, Utf8
+  via host rank tables passed as aux), sorts the batch together with
+  the carried top-k state, and keeps the best k rows' full column
+  values.  Device state is O(k) — a scan of any length needs one
+  k + capacity sort per batch, never a full materialization.
+- **Run sort + host merge** (full ORDER BY): each batch-bucket-sized
+  run sorts on device (multi-key `lax.sort`, stable), and the sorted
+  runs merge on the host with a vectorized structured-array
+  `searchsorted` merge.  No single all-rows device allocation; the
+  device sort buffer is bounded by the run size.
+
+Key transforms (shared by both paths):
 - DESC numeric keys sort by their negation (unsigned by bitwise
   complement), so every key is ascending for the one fused sort.
 - Utf8 keys sort by host-computed rank tables
@@ -28,36 +39,55 @@ from jax import lax
 
 from datafusion_tpu.datatypes import DataType, Schema
 from datafusion_tpu.errors import NotSupportedError
-from datafusion_tpu.exec.batch import RecordBatch, bucket_capacity, make_host_batch
-from datafusion_tpu.exec.materialize import collect_columns, compact_batch
+from datafusion_tpu.exec.batch import (
+    RecordBatch,
+    bucket_capacity,
+    make_host_batch,
+)
+from datafusion_tpu.exec.materialize import compact_batch
 from datafusion_tpu.exec.relation import Relation, device_scope as _device_scope
 from datafusion_tpu.plan.expr import Column, SortExpr
 from datafusion_tpu.utils.metrics import METRICS
 
+# LIMIT at or below this rides the streaming device TopK; above it the
+# query is effectively a full sort and takes the run-merge path.
+TOPK_MAX = 65536
 
-def _sortable_key(
+
+def _np_sort_key(
     values: np.ndarray,
     validity: Optional[np.ndarray],
-    dtype_kind: str,
+    kind: str,
     asc: bool,
 ) -> np.ndarray:
-    """Transform a key column so ascending sort yields the right order,
-    nulls last."""
-    if dtype_kind == "f":
+    """Host-side transformed key (run-merge path), ascending, nulls
+    last."""
+    if kind == "f":
         k = values.astype(np.float64)
         if not asc:
             k = -k
         if validity is not None:
             k = np.where(validity, k, np.inf)
         return k
-    # ints / bools / dict ranks: widen to int64 (uint64 edge: sort as
-    # float64 would lose precision, so map through int64 carefully)
     k = values.astype(np.int64)
     if not asc:
         k = -k
     if validity is not None:
         k = np.where(validity, k, np.iinfo(np.int64).max)
     return k
+
+
+class _KeyPlan:
+    """How one ORDER BY key lowers onto a column: which column, its
+    transform kind, direction, and (for Utf8) a rank-table aux slot."""
+
+    __slots__ = ("index", "kind", "asc", "rank_slot")
+
+    def __init__(self, index: int, kind: str, asc: bool, rank_slot: Optional[int]):
+        self.index = index
+        self.kind = kind  # "f" | "i" | "u64" | "str"
+        self.asc = asc
+        self.rank_slot = rank_slot
 
 
 class SortRelation(Relation):
@@ -79,69 +109,385 @@ class SortRelation(Relation):
                 raise NotSupportedError(
                     f"ORDER BY supports column references, got {se.expr!r}"
                 )
+        in_schema = child.schema
+        self._key_plans: list[_KeyPlan] = []
+        rank_slots = 0
+        for se in sort_expr:
+            idx = se.expr.index
+            f = in_schema.field(idx)
+            if f.data_type == DataType.UTF8:
+                self._key_plans.append(_KeyPlan(idx, "str", se.asc, rank_slots))
+                rank_slots += 1
+                continue
+            kind = f.data_type.np_dtype.kind
+            if kind == "O":
+                raise NotSupportedError("struct columns cannot be ORDER BY keys")
+            if kind == "u" and f.data_type.width == 64:
+                kind = "u64"
+            elif kind in ("b", "i", "u"):
+                kind = "i"
+            else:
+                kind = "f"
+            self._key_plans.append(_KeyPlan(idx, kind, se.asc, None))
+        self._topk_jit = jax.jit(self._topk_kernel, static_argnums=(0,))
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
-    def batches(self) -> Iterator[RecordBatch]:
-        # 1. compact child output to host columns
-        columns, validity, dicts, n = collect_columns(self.child)
-        if n == 0:
-            yield make_host_batch(self._schema, columns, validity, dicts)
-            return
+    # -- shared key transform (device, traced) --
+    def _device_keys(self, cols, valids, mask, capacity, rank_tables):
+        """Transformed ascending sort keys; masked-out rows sentinel to
+        the end."""
+        keys = []
+        for kp in self._key_plans:
+            v = cols[kp.index]
+            valid = valids[kp.index]
+            if kp.kind == "str":
+                table = rank_tables[kp.rank_slot]
+                cap = table.shape[0]
+                k = table[jnp.clip(v.astype(jnp.int32), 0, cap - 1)].astype(
+                    jnp.int64
+                )
+                if not kp.asc:
+                    k = -k
+                sent = jnp.int64(jnp.iinfo(jnp.int64).max)
+            elif kp.kind == "f":
+                k = v.astype(jnp.float64)
+                if not kp.asc:
+                    k = -k
+                sent = jnp.float64(jnp.inf)
+            elif kp.kind == "u64":
+                # uint64 doesn't fit int64: flip the sign bit and
+                # reinterpret — order-preserving and lossless
+                k = (v.astype(jnp.uint64) ^ jnp.uint64(1 << 63)).view(jnp.int64)
+                if not kp.asc:
+                    k = ~k
+                sent = jnp.int64(jnp.iinfo(jnp.int64).max)
+            else:
+                k = v.astype(jnp.int64)
+                if not kp.asc:
+                    k = -k
+                sent = jnp.int64(jnp.iinfo(jnp.int64).max)
+            dead = ~mask
+            if valid is not None:
+                dead = dead | ~valid
+            keys.append(jnp.where(dead, sent, k))
+        return keys
 
-        # 2. build transformed sort keys
+    # -- streaming TopK path --
+    def _topk_kernel(self, k, state, cols, valids, mask, num_rows, rank_tables):
+        """Merge one batch into the carried top-k state.
+
+        state = (keys..., col values..., col validity bits) each length
+        k; returns the same structure.  One multi-key sort of
+        [k + capacity] rows per batch.
+        """
+        capacity = cols[0].shape[0]
+        row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if mask is not None:
+            row_mask = row_mask & mask
+        bkeys = self._device_keys(cols, valids, row_mask, capacity, rank_tables)
+        skeys, slive, svals, svalid = state
+
+        ops = []
+        for sk, bk in zip(skeys, bkeys):
+            ops.append(jnp.concatenate([sk, bk.astype(sk.dtype)]))
+        n_keys = len(ops)
+        ops.append(jnp.concatenate([slive, row_mask]))  # live-row bit
+        for sv, c in zip(svals, cols):
+            ops.append(jnp.concatenate([sv, c]))
+        for sb, v in zip(svalid, valids):
+            bv = row_mask if v is None else (v & row_mask)
+            ops.append(jnp.concatenate([sb, bv]))
+        out = lax.sort(tuple(ops), num_keys=n_keys, is_stable=True)
+        new_keys = tuple(o[:k] for o in out[:n_keys])
+        new_live = out[n_keys][:k]
+        new_vals = tuple(
+            o[:k] for o in out[n_keys + 1 : n_keys + 1 + len(svals)]
+        )
+        new_valid = tuple(o[:k] for o in out[n_keys + 1 + len(svals) :])
+        return new_keys, new_live, new_vals, new_valid
+
+    def _topk_init(self, k, in_schema):
+        keys = []
+        for kp in self._key_plans:
+            if kp.kind == "f":
+                keys.append(jnp.full(k, jnp.inf, jnp.float64))
+            else:
+                keys.append(jnp.full(k, jnp.iinfo(jnp.int64).max, jnp.int64))
+        vals = tuple(
+            jnp.zeros(k, in_schema.field(i).data_type.np_dtype)
+            for i in range(len(in_schema))
+        )
+        valid = tuple(jnp.zeros(k, bool) for _ in range(len(in_schema)))
+        return tuple(keys), jnp.zeros(k, bool), vals, valid
+
+    def _topk_batches(self) -> Iterator[RecordBatch]:
+        from datafusion_tpu.exec.batch import device_inputs
+
+        k = self.limit
+        in_schema = self.child.schema
+        state = None
+        dicts = [None] * len(in_schema)
+        rank_cache: dict = {}
+        for batch in self.child.batches():
+            for i, d in enumerate(batch.dicts):
+                if d is not None:
+                    dicts[i] = d
+            rank_tables = []
+            for kp in self._key_plans:
+                if kp.kind != "str":
+                    continue
+                d = batch.dicts[kp.index]
+                ranks = (
+                    self._rank_table(d, rank_cache, kp.index)
+                    if d is not None
+                    else np.zeros(1, np.int32)
+                )
+                rank_tables.append(ranks)
+            if state is None:
+                state = self._topk_init(k, in_schema)
+            with METRICS.timer("execute.sort"), _device_scope(self.device):
+                data, validity, mask = device_inputs(batch, self.device)
+                state = self._topk_jit(
+                    k,
+                    state,
+                    data,
+                    validity,
+                    mask,
+                    np.int32(batch.num_rows),
+                    tuple(rank_tables),
+                )
+        if state is None:
+            yield self._empty_result(in_schema, dicts)
+            return
+        _, live, vals, valid = state
+        for leaf in jax.tree.leaves((live, vals, valid)):
+            if hasattr(leaf, "copy_to_host_async"):
+                leaf.copy_to_host_async()
+        # the live bit separates real rows from sentinel padding when
+        # the scan produced fewer than k rows
+        take = np.nonzero(np.asarray(live))[0]
+        out_cols = [np.asarray(c)[take] for c in vals]
+        out_valid = []
+        for i in range(len(in_schema)):
+            v = np.asarray(valid[i])[take]
+            out_valid.append(None if bool(v.all()) else v)
+        yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+
+    def _empty_result(self, in_schema, dicts) -> RecordBatch:
+        cols = [
+            np.empty(0, dtype=in_schema.field(i).data_type.np_dtype)
+            for i in range(len(in_schema))
+        ]
+        return make_host_batch(
+            self._schema, cols, [None] * len(cols), dicts
+        )
+
+    @staticmethod
+    def _rank_table(d, cache: dict, idx: int) -> np.ndarray:
+        key = (idx, d.version)
+        hit = cache.get(key)
+        if hit is None:
+            ranks = d.sort_ranks().astype(np.int32)
+            cap = bucket_capacity(max(len(ranks), 1))
+            padded = np.zeros(cap, np.int32)
+            padded[: len(ranks)] = ranks
+            hit = padded
+            cache[key] = hit
+        return hit
+
+    # -- run sort + host merge path --
+    def _host_keys(self, columns, validity, dicts) -> list[np.ndarray]:
         keys = []
         in_schema = self.child.schema
-        for se in self.sort_expr:
-            idx = se.expr.index
-            f = in_schema.field(idx)
+        for kp, se in zip(self._key_plans, self.sort_expr):
+            idx = kp.index
             vals = columns[idx]
-            if f.data_type == DataType.UTF8:
+            if kp.kind == "str":
                 d = dicts[idx]
-                ranks = d.sort_ranks() if d is not None else None
-                vals = ranks[vals] if ranks is not None else vals
+                vals = d.sort_ranks()[vals] if d is not None else vals
+                kind = "i"
+            elif kp.kind == "u64":
+                vals = (
+                    np.ascontiguousarray(vals.astype(np.uint64))
+                    ^ np.uint64(1 << 63)
+                ).view(np.int64)
                 kind = "i"
             else:
-                kind = f.data_type.np_dtype.kind
-                if kind == "O":
-                    raise NotSupportedError(
-                        "struct columns cannot be ORDER BY keys"
-                    )
-                if kind == "u" and f.data_type.width == 64:
-                    # uint64 doesn't fit int64: flip the sign bit and
-                    # reinterpret — order-preserving and lossless
-                    vals = (
-                        np.ascontiguousarray(vals.astype(np.uint64))
-                        ^ np.uint64(1 << 63)
-                    ).view(np.int64)
-                if kind == "b":
-                    kind = "i"
-            keys.append(_sortable_key(vals, validity[idx], "f" if kind == "f" else "i", se.asc))
+                kind = kp.kind
+            keys.append(_np_sort_key(vals, validity[idx], kind, se.asc))
+        return keys
 
-        # 3. pad and sort on device: operands = keys + row-index payload
+    def _sorted_run(self, keys: list[np.ndarray], n: int) -> np.ndarray:
+        """Device-sort one run of n rows; returns the permutation."""
         cap = bucket_capacity(n)
         ops = []
-        for k in keys:
-            pad_val = np.inf if k.dtype.kind == "f" else np.iinfo(np.int64).max
-            padded = np.full(cap, pad_val, dtype=k.dtype)
-            padded[:n] = k
+        for key in keys:
+            pad_val = np.inf if key.dtype.kind == "f" else np.iinfo(np.int64).max
+            padded = np.full(cap, pad_val, dtype=key.dtype)
+            padded[:n] = key[:n]
             ops.append(jnp.asarray(padded))
         iota = jnp.arange(cap, dtype=jnp.int32)
-        with METRICS.timer("execute.sort"), _device_scope(self.device):
-            sorted_ops = lax.sort(
-                tuple(ops) + (iota,), num_keys=len(ops), is_stable=True
-            )
-            perm = np.asarray(sorted_ops[-1])
+        sorted_ops = lax.sort(
+            tuple(ops) + (iota,), num_keys=len(ops), is_stable=True
+        )
+        return np.asarray(sorted_ops[-1])[:n]
 
-        take = n if self.limit is None else min(self.limit, n)
-        perm = perm[:take]
+    @staticmethod
+    def _merge_runs(run_keys: list[np.ndarray], run_perms: list[np.ndarray]):
+        """Merge sorted runs on host: vectorized two-way merges via
+        structured-array searchsorted (lexicographic on all keys)."""
 
-        # 4. gather output columns by the permutation (host: result sizes
-        # are post-limit and user-facing)
-        out_cols = [c[perm] for c in columns]
-        out_valid = [None if v is None else v[perm] for v in validity]
+        def to_struct(keys):
+            arr = np.ascontiguousarray(np.stack(keys, axis=1))
+            return arr.view([("", arr.dtype)] * arr.shape[1]).ravel()
+
+        items = [
+            (to_struct(k), p) for k, p in zip(run_keys, run_perms)
+        ]
+        while len(items) > 1:
+            merged = []
+            for i in range(0, len(items) - 1, 2):
+                (ka, pa), (kb, pb) = items[i], items[i + 1]
+                # position of each b-element among a (stable: a first)
+                posb = np.searchsorted(ka, kb, side="left")
+                out_len = len(ka) + len(kb)
+                idxb = posb + np.arange(len(kb))
+                keys = np.empty(out_len, dtype=ka.dtype)
+                perms = np.empty((out_len,) + pa.shape[1:], dtype=pa.dtype)
+                bmask = np.zeros(out_len, dtype=bool)
+                bmask[idxb] = True
+                keys[bmask] = kb
+                keys[~bmask] = ka
+                perms[bmask] = pb
+                perms[~bmask] = pa
+                merged.append((keys, perms))
+            if len(items) % 2:
+                merged.append(items[-1])
+            items = merged
+        return items[0][1]
+
+    def batches(self) -> Iterator[RecordBatch]:
+        if (
+            self.limit is not None
+            and 0 < self.limit <= TOPK_MAX
+        ):
+            yield from self._topk_batches()
+            return
+
+        # full sort: collect per-run host columns, device-sort each run,
+        # merge the runs' keys on host
+        in_schema = self.child.schema
+        run_cols, run_valids, run_perms = [], [], []
+        dicts = [None] * len(in_schema)
+        total = 0
+        pending_cols = None
+        pending_valids = None
+        pending_n = 0
+        run_rows = None
+
+        def flush_run():
+            nonlocal pending_cols, pending_valids, pending_n
+            if pending_n == 0:
+                return
+            cols = [np.concatenate(c) for c in pending_cols]
+            valids = [
+                None if all(v is None for v in vs) else np.concatenate(
+                    [
+                        np.ones(len(c), bool) if v is None else v
+                        for v, c in zip(vs, cs)
+                    ]
+                )
+                for vs, cs in zip(pending_valids, pending_cols)
+            ]
+            keys = self._host_keys(cols, valids, dicts)
+            with METRICS.timer("execute.sort"), _device_scope(self.device):
+                perm = self._sorted_run(keys, len(cols[0]))
+            run_cols.append(cols)
+            run_valids.append(valids)
+            run_perms.append(perm)
+            pending_cols = None
+            pending_valids = None
+            pending_n = 0
+
+        for batch in self.child.batches():
+            for i, d in enumerate(batch.dicts):
+                if d is not None:
+                    dicts[i] = d
+            cols, valids, _, n = compact_batch(batch)
+            if n == 0:
+                continue
+            if run_rows is None:
+                # run size = one batch bucket: the device sort buffer
+                # never exceeds the scan's batch capacity
+                run_rows = bucket_capacity(batch.capacity)
+            if pending_cols is None:
+                pending_cols = [[] for _ in cols]
+                pending_valids = [[] for _ in cols]
+            for i, c in enumerate(cols):
+                pending_cols[i].append(c[:n])
+                pending_valids[i].append(
+                    None if valids[i] is None else valids[i][:n]
+                )
+            pending_n += n
+            total += n
+            if pending_n >= run_rows:
+                flush_run()
+        flush_run()
+
+        if total == 0:
+            yield self._empty_result(in_schema, dicts)
+            return
+
+        take = total if self.limit is None else min(self.limit, total)
+        if len(run_cols) == 1:
+            perm = run_perms[0][:take]
+            out_cols = [c[perm] for c in run_cols[0]]
+            out_valid = [
+                None if v is None else v[perm] for v in run_valids[0]
+            ]
+            yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+            return
+
+        # multi-run: recompute each run's sorted key arrays under the
+        # FINAL dictionaries (a dictionary that grew mid-scan changes
+        # rank values, but within-run order is rank-version-invariant —
+        # ranks are order-isomorphic to the string values), then merge
+        run_keys = []
+        for ri in range(len(run_cols)):
+            perm = run_perms[ri]
+            sorted_cols = [c[perm] for c in run_cols[ri]]
+            sorted_valids = [
+                None if v is None else v[perm] for v in run_valids[ri]
+            ]
+            run_keys.append(self._host_keys(sorted_cols, sorted_valids, dicts))
+        merged = self._merge_runs(
+            run_keys,
+            [
+                np.stack([np.full(len(p), ri), np.arange(len(p))], axis=1)
+                for ri, p in enumerate(run_perms)
+            ],
+        )[:take]
+        runs = merged[:, 0]
+        rows = merged[:, 1]
+        out_cols = []
+        out_valid = []
+        for i in range(len(in_schema)):
+            parts = np.empty(take, dtype=run_cols[0][i].dtype)
+            vparts = np.ones(take, dtype=bool)
+            any_valid = any(rv[i] is not None for rv in run_valids)
+            for ri in range(len(run_cols)):
+                m = runs == ri
+                if not m.any():
+                    continue
+                sel = run_perms[ri][rows[m]]
+                parts[m] = run_cols[ri][i][sel]
+                if run_valids[ri][i] is not None:
+                    vparts[m] = run_valids[ri][i][sel]
+            out_cols.append(parts)
+            out_valid.append(vparts if any_valid else None)
         yield make_host_batch(self._schema, out_cols, out_valid, dicts)
 
 
@@ -177,5 +523,3 @@ class LimitRelation(Relation):
             if remaining <= 0:
                 # stop before pulling (and parsing) another child batch
                 return
-
-
