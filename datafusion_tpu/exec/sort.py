@@ -71,7 +71,7 @@ def _np_sort_key(
         return k
     k = values.astype(np.int64)
     if not asc:
-        k = -k
+        k = ~k  # complement, not negation: -int64.min overflows
     if validity is not None:
         k = np.where(validity, k, np.iinfo(np.int64).max)
     return k
@@ -167,7 +167,7 @@ class SortRelation(Relation):
             else:
                 k = v.astype(jnp.int64)
                 if not kp.asc:
-                    k = -k
+                    k = ~k  # complement, not negation: -int64.min overflows
                 sent = jnp.int64(jnp.iinfo(jnp.int64).max)
             dead = ~mask
             if valid is not None:
@@ -193,15 +193,20 @@ class SortRelation(Relation):
         ops = []
         for sk, bk in zip(skeys, bkeys):
             ops.append(jnp.concatenate([sk, bk.astype(sk.dtype)]))
+        live_col = jnp.concatenate([slive, row_mask])
+        # tiebreak: among equal (sentinel) keys, real rows beat padding —
+        # NULL-key rows share the sentinel with empty state slots and
+        # must still fill a LIMIT larger than the non-null count
+        ops.append((~live_col).astype(jnp.int32))
         n_keys = len(ops)
-        ops.append(jnp.concatenate([slive, row_mask]))  # live-row bit
+        ops.append(live_col)
         for sv, c in zip(svals, cols):
             ops.append(jnp.concatenate([sv, c]))
         for sb, v in zip(svalid, valids):
             bv = row_mask if v is None else (v & row_mask)
             ops.append(jnp.concatenate([sb, bv]))
         out = lax.sort(tuple(ops), num_keys=n_keys, is_stable=True)
-        new_keys = tuple(o[:k] for o in out[:n_keys])
+        new_keys = tuple(o[:k] for o in out[: len(skeys)])  # drop tiebreak col
         new_live = out[n_keys][:k]
         new_vals = tuple(
             o[:k] for o in out[n_keys + 1 : n_keys + 1 + len(svals)]
