@@ -1,148 +1,67 @@
 #!/usr/bin/env python
-"""Headline benchmark: TPC-H Q1 (scan + filter + 8-way grouped aggregate).
+"""Benchmark driver: runs the five BASELINE.md configs and prints ONE
+JSON line on stdout (diagnostics on stderr).
 
-Protocol (BASELINE.md): the reference publishes no numbers and cannot
-run this query at all (aggregates are `unimplemented!()` there,
-`context.rs:161`), so the baseline is this engine's own single-thread
-CPU path on identical inputs; `vs_baseline` is the TPU speedup over it.
-3 warm-up runs (covers XLA compile), then p50 of N timed runs.
+Headline metric = config 3, TPC-H Q1 over Parquet lineitem: `value` is
+the warm (device-resident steady-state) rows/s, `vs_baseline` the TPU
+speedup over this engine's own single-thread CPU path on identical
+inputs (the reference publishes no numbers and functionally cannot run
+the query — aggregates are `unimplemented!()` there, `context.rs:161`).
+Cold (scan-inclusive: Parquet parse, dictionary encode, H2D, kernel,
+D2H) is reported separately with a per-phase breakdown under
+`configs.tpch_q1_parquet`.
 
-Prints ONE JSON line on stdout; diagnostics go to stderr.
+Env knobs: BENCH_SF (lineitem scale factor, default 1), BENCH_CONFIGS
+(comma list, default "1,2,3,4,5"), BENCH_RUNS / BENCH_COLD_RUNS.
 """
 
 import json
 import os
 import sys
-import time
-
-import numpy as np
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
-
-
-ROWS = int(os.environ.get("BENCH_ROWS", 4_000_000))
-BATCH = int(os.environ.get("BENCH_BATCH", 1 << 19))
-N_RUNS = int(os.environ.get("BENCH_RUNS", 10))
-WARMUP = 3
-
-Q1 = (
-    "SELECT l_returnflag, l_linestatus, "
-    "SUM(l_quantity), SUM(l_extendedprice), "
-    "SUM(l_extendedprice * (1 - l_discount)), "
-    "SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
-    "AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(1) "
-    "FROM lineitem "
-    "WHERE l_shipdate <= '1998-09-02' "
-    "GROUP BY l_returnflag, l_linestatus"
-)
-
-
-def build_lineitem(rows: int, batch_rows: int):
-    """Synthetic TPC-H lineitem columns (the Q1 subset), in-memory."""
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from datafusion_tpu.datatypes import DataType, Field, Schema
-    from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
-    from datafusion_tpu.exec.datasource import MemoryDataSource
-
-    schema = Schema(
-        [
-            Field("l_returnflag", DataType.UTF8, False),
-            Field("l_linestatus", DataType.UTF8, False),
-            Field("l_quantity", DataType.FLOAT64, False),
-            Field("l_extendedprice", DataType.FLOAT64, False),
-            Field("l_discount", DataType.FLOAT64, False),
-            Field("l_tax", DataType.FLOAT64, False),
-            Field("l_shipdate", DataType.UTF8, False),
-        ]
-    )
-    rng = np.random.default_rng(42)
-
-    flag_dict = StringDictionary()
-    for s in ("A", "N", "R"):
-        flag_dict.add(s)
-    status_dict = StringDictionary()
-    for s in ("F", "O"):
-        status_dict.add(s)
-    date_dict = StringDictionary()
-    base = np.datetime64("1992-01-01")
-    for i in range(2557):  # 1992-01-01 .. 1998-12-31
-        date_dict.add(str(base + np.timedelta64(i, "D")))
-
-    batches = []
-    for start in range(0, rows, batch_rows):
-        n = min(batch_rows, rows - start)
-        cols = [
-            rng.integers(0, 3, n).astype(np.int32),
-            rng.integers(0, 2, n).astype(np.int32),
-            np.floor(rng.uniform(1, 51, n)),
-            rng.uniform(900.0, 105000.0, n),
-            np.round(rng.uniform(0.0, 0.10, n), 2),
-            np.round(rng.uniform(0.0, 0.08, n), 2),
-            rng.integers(0, 2557, n).astype(np.int32),
-        ]
-        b = make_host_batch(
-            schema, cols,
-            [None] * 7,
-            [flag_dict, status_dict, None, None, None, None, date_dict],
-        )
-        batches.append(b)
-    return schema, MemoryDataSource(schema, batches)
-
-
-def bench_device(device, src, rows):
-    from datafusion_tpu.exec.context import ExecutionContext
-    from datafusion_tpu.exec.materialize import collect
-
-    ctx = ExecutionContext(device=device)
-    ctx.register_datasource("lineitem", src)
-    rel = ctx.sql(Q1)  # one operator tree -> jit caches persist across runs
-    for _ in range(WARMUP):
-        collect(rel)
-    times = []
-    for _ in range(N_RUNS):
-        t0 = time.perf_counter()
-        table = collect(rel)
-        times.append(time.perf_counter() - t0)
-    p50 = float(np.median(times))
-    log(f"  {device or 'default'}: p50 {p50*1e3:.1f} ms, "
-        f"{rows/p50/1e6:.1f} M rows/s, groups={table.num_rows}")
-    return p50, table
 
 
 def main():
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import jax
 
+    from benchmarks import suite
+
     platforms = {d.platform for d in jax.devices()}
-    log(f"devices: {jax.devices()}")
-    log(f"building {ROWS} rows of lineitem ...")
-    _, src = build_lineitem(ROWS, BATCH)
+    suite.log(f"devices: {jax.devices()}")
+    device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
-    has_tpu = any(p != "cpu" for p in platforms)
-    cpu_p50, cpu_table = bench_device("cpu", src, ROWS)
-    if has_tpu:
-        dev_p50, dev_table = bench_device("tpu", src, ROWS)
-        got = sorted(dev_table.to_rows())
-        want = sorted(cpu_table.to_rows())
-        assert len(got) == len(want), f"group count differs: {len(got)} vs {len(want)}"
-        for g, w in zip(got, want):
-            assert g[:2] == w[:2], f"group keys differ: {g[:2]} vs {w[:2]}"
-            np.testing.assert_allclose(
-                np.asarray(g[2:], float), np.asarray(w[2:], float), rtol=1e-9,
-                err_msg=f"TPU/CPU aggregate mismatch for group {g[:2]}",
-            )
-    else:
-        dev_p50 = cpu_p50
+    wanted = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+    runners = {
+        "1": suite.config1_csv_filter,
+        "2": suite.config2_groupby,
+        "3": suite.config3_tpch_q1,
+        "4": suite.config4_sort_topk,
+        "5": suite.config5_mesh,
+    }
+    configs = {}
+    for key in wanted:
+        key = key.strip()
+        if key not in runners:
+            continue
+        result = runners[key](device_kind)
+        configs[result["name"]] = result
 
-    value = ROWS / dev_p50
-    vs_baseline = cpu_p50 / dev_p50
+    if not configs:
+        print(json.dumps({
+            "error": f"BENCH_CONFIGS={os.environ.get('BENCH_CONFIGS')!r} "
+                     f"selected none of {sorted(runners)}"
+        }))
+        sys.exit(2)
+    headline = configs.get("tpch_q1_parquet")
+    if headline is None:  # driver ran a subset; promote the first config
+        headline = next(iter(configs.values()))
     print(json.dumps({
-        "metric": "tpch_q1_throughput",
-        "value": round(value, 1),
-        "unit": "rows/s",
-        "vs_baseline": round(vs_baseline, 3),
+        "metric": headline["name"] + "_throughput",
+        "value": headline["value"],
+        "unit": headline["unit"],
+        "vs_baseline": headline["vs_baseline"],
+        "device": device_kind,
+        "configs": configs,
     }))
 
 

@@ -1,0 +1,7 @@
+"""The five mandated benchmark configs (BASELINE.md).
+
+The reference's own bench list is commented out and publishes no
+numbers (`/root/reference/Cargo.toml:50-68`, `.travis.yml:30-33`), so
+the baseline for every config is this engine's own single-thread CPU
+path on identical inputs, and `vs_baseline` is the TPU speedup over it.
+"""

@@ -1,0 +1,313 @@
+"""The five BASELINE.md benchmark configs.
+
+Protocol (BASELINE.md "Measurement protocol"): the engine's own
+single-thread CPU path is the baseline (the reference functionally
+cannot run configs 2-5 — aggregates/sort are `unimplemented!()`,
+`context.rs:161`); warm runs report p50 after warm-up (device-resident
+steady state, excludes XLA compile); cold runs rebuild the operator
+tree and re-scan the file each time, so they include parse, dictionary
+encode, H2D, kernel, and D2H — with a per-phase breakdown from the
+engine's METRICS counters.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from benchmarks import data as bdata
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+WARMUP = int(os.environ.get("BENCH_WARMUP", 3))
+WARM_RUNS = int(os.environ.get("BENCH_RUNS", 10))
+COLD_RUNS = int(os.environ.get("BENCH_COLD_RUNS", 3))
+
+Q1 = (
+    "SELECT l_returnflag, l_linestatus, "
+    "SUM(l_quantity), SUM(l_extendedprice), "
+    "SUM(l_extendedprice * (1 - l_discount)), "
+    "SUM(l_extendedprice * (1 - l_discount) * (1 + l_tax)), "
+    "AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount), COUNT(1) "
+    "FROM lineitem "
+    "WHERE l_shipdate <= '1998-09-02' "
+    "GROUP BY l_returnflag, l_linestatus"
+)
+
+
+def _p50(times: list[float]) -> float:
+    return float(np.median(times))
+
+
+def _timed(fn, runs: int, warmup: int = WARMUP) -> tuple[float, object]:
+    out = None
+    for _ in range(warmup):
+        out = fn()
+    times = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        out = fn()
+        times.append(time.perf_counter() - t0)
+    return _p50(times), out
+
+
+def _assert_tables_match(got, want, label: str, rtol=1e-9):
+    got_rows = sorted(got.to_rows())
+    want_rows = sorted(want.to_rows())
+    assert len(got_rows) == len(want_rows), (
+        f"{label}: row count differs: {len(got_rows)} vs {len(want_rows)}"
+    )
+    for g, w in zip(got_rows, want_rows):
+        for gv, wv in zip(g, w):
+            if isinstance(gv, float) or isinstance(wv, float):
+                np.testing.assert_allclose(gv, wv, rtol=rtol, err_msg=label)
+            else:
+                assert gv == wv, f"{label}: {gv!r} != {wv!r} in {g} vs {w}"
+
+
+def _has_tpu() -> bool:
+    import jax
+
+    return any(d.platform != "cpu" for d in jax.devices())
+
+
+def _warm_query(device, src, table, sql, rows, runs=WARM_RUNS):
+    """Steady-state p50 of re-running one operator tree (device-resident
+    inputs after warm-up)."""
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.materialize import collect
+
+    ctx = ExecutionContext(device=device)
+    ctx.register_datasource(table, src)
+    rel = ctx.sql(sql)
+    p50, out = _timed(lambda: collect(rel), runs)
+    log(f"    {device or 'default'} warm: p50 {p50*1e3:.1f} ms, {rows/p50/1e6:.2f} M rows/s")
+    return p50, out
+
+
+# -- config 1: CSV scan + projection + filter (examples/csv_sql.rs) --
+def config1_csv_filter(device_kind: str):
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.utils.metrics import METRICS
+
+    rows = int(os.environ.get("BENCH_CSV_ROWS", 2_000_000))
+    path = bdata.cities_csv(rows)
+    schema = Schema(
+        [
+            Field("city", DataType.UTF8, False),
+            Field("lat", DataType.FLOAT64, False),
+            Field("lng", DataType.FLOAT64, False),
+        ]
+    )
+    sql = "SELECT city, lat, lng, lat + lng FROM cities WHERE lat > 51.0 AND lat < 53.0"
+
+    def cold(device):
+        ctx = ExecutionContext(device=device)
+        ctx.register_csv("cities", path, schema, has_header=True)
+        return collect(ctx.sql(sql))
+
+    log("  config 1: CSV scan+filter (cold, scan-inclusive)")
+    cpu_p50, cpu_out = _timed(lambda: cold("cpu"), COLD_RUNS, warmup=1)
+    log(f"    cpu cold: p50 {cpu_p50*1e3:.1f} ms, {rows/cpu_p50/1e6:.2f} M rows/s")
+    if device_kind == "cpu":
+        dev_p50, dev_out = cpu_p50, cpu_out
+    else:
+        METRICS.reset()
+        dev_p50, dev_out = _timed(lambda: cold(device_kind), COLD_RUNS, warmup=1)
+        snap = METRICS.snapshot()
+        parse = snap["timings_s"].get("scan.parse", 0.0) / (COLD_RUNS + 1)
+        log(
+            f"    {device_kind} cold: p50 {dev_p50*1e3:.1f} ms, "
+            f"{rows/dev_p50/1e6:.2f} M rows/s (parse {parse*1e3:.0f} ms/run)"
+        )
+        _assert_tables_match(dev_out, cpu_out, "config1")
+    return {
+        "name": "csv_scan_filter",
+        "rows": rows,
+        "value": round(rows / dev_p50, 1),
+        "unit": "rows/s",
+        "p50_ms": round(dev_p50 * 1e3, 2),
+        "vs_baseline": round(cpu_p50 / dev_p50, 3),
+        "out_rows": dev_out.num_rows,
+    }
+
+
+# -- config 2: GROUP BY hash-aggregate, low and high cardinality --
+def config2_groupby(device_kind: str):
+    rows = int(os.environ.get("BENCH_GROUPBY_ROWS", 4_000_000))
+    out = {"name": "groupby_aggregate", "rows": rows, "unit": "rows/s"}
+    sql = (
+        "SELECT k, SUM(v1), AVG(v2), MIN(v3), MAX(v3), COUNT(1) "
+        "FROM t GROUP BY k"
+    )
+    for label, groups in (("small_16", 16), ("high_100k", 100_000)):
+        log(f"  config 2: GROUP BY {groups} groups (warm)")
+        _, src = bdata.groupby_batches(rows, groups, 1 << 19)
+        cpu_p50, cpu_out = _warm_query("cpu", src, "t", sql, rows)
+        if device_kind == "cpu":
+            dev_p50 = cpu_p50
+        else:
+            dev_p50, dev_out = _warm_query(device_kind, src, "t", sql, rows)
+            _assert_tables_match(dev_out, cpu_out, f"config2/{label}", rtol=1e-6)
+        out[label] = {
+            "groups": groups,
+            "value": round(rows / dev_p50, 1),
+            "p50_ms": round(dev_p50 * 1e3, 2),
+            "vs_baseline": round(cpu_p50 / dev_p50, 3),
+        }
+    out["value"] = out["high_100k"]["value"]
+    out["vs_baseline"] = out["high_100k"]["vs_baseline"]
+    return out
+
+
+# -- config 3: TPC-H Q1 over Parquet lineitem (the headline) --
+def config3_tpch_q1(device_kind: str):
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import MemoryDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.utils.metrics import METRICS
+
+    sf = float(os.environ.get("BENCH_SF", 1))
+    sf = int(sf) if sf == int(sf) else sf
+    log(f"  config 3: TPC-H Q1, Parquet lineitem SF-{sf}")
+    path = bdata.lineitem_parquet(sf)
+    rows = int(bdata.LINEITEM_ROWS_PER_SF * sf)
+
+    def cold(device):
+        ctx = ExecutionContext(device=device)
+        ctx.register_parquet("lineitem", path)
+        return collect(ctx.sql(Q1))
+
+    # cold: full scan -> encode -> H2D -> kernel each run
+    cold("cpu")  # compile CPU kernels outside the timed region
+    cpu_cold_p50, cpu_out = _timed(lambda: cold("cpu"), COLD_RUNS, warmup=0)
+    log(f"    cpu cold: p50 {cpu_cold_p50*1e3:.0f} ms, {rows/cpu_cold_p50/1e6:.2f} M rows/s")
+    if device_kind != "cpu":
+        cold(device_kind)  # compile device kernels
+        METRICS.reset()
+        dev_cold_p50, dev_out = _timed(lambda: cold(device_kind), COLD_RUNS, warmup=0)
+        snap = METRICS.snapshot()
+        nruns = COLD_RUNS
+        breakdown = {
+            "parse_encode_s": round(snap["timings_s"].get("scan.parse", 0.0) / nruns, 3),
+            "h2d_dispatch_s": round(snap["timings_s"].get("h2d.dispatch", 0.0) / nruns, 3),
+            "h2d_mb": round(snap["counts"].get("h2d.bytes", 0) / nruns / 1e6, 1),
+            "device_and_d2h_s": round(
+                max(
+                    dev_cold_p50
+                    - (
+                        snap["timings_s"].get("scan.parse", 0.0)
+                        + snap["timings_s"].get("h2d.dispatch", 0.0)
+                    )
+                    / nruns,
+                    0.0,
+                ),
+                3,
+            ),
+        }
+        log(f"    {device_kind} cold: p50 {dev_cold_p50*1e3:.0f} ms, "
+            f"{rows/dev_cold_p50/1e6:.2f} M rows/s  breakdown={breakdown}")
+        _assert_tables_match(dev_out, cpu_out, "config3 cold")
+    else:
+        dev_cold_p50 = cpu_cold_p50
+        breakdown = {}
+
+    # warm: the same rows resident in memory (and after warm-up, on
+    # device) — steady-state re-query throughput
+    ctx = ExecutionContext(device="cpu")
+    ctx.register_parquet("lineitem", path)
+    scan_src = ctx.datasources["lineitem"]
+    batches = list(scan_src.batches())
+    mem_src = MemoryDataSource(scan_src.schema, batches)
+    cpu_warm_p50, cpu_warm_out = _warm_query("cpu", mem_src, "lineitem", Q1, rows)
+    if device_kind != "cpu":
+        dev_warm_p50, dev_warm_out = _warm_query(device_kind, mem_src, "lineitem", Q1, rows)
+        _assert_tables_match(dev_warm_out, cpu_warm_out, "config3 warm")
+    else:
+        dev_warm_p50 = cpu_warm_p50
+
+    return {
+        "name": "tpch_q1_parquet",
+        "sf": sf,
+        "rows": rows,
+        "unit": "rows/s",
+        "value": round(rows / dev_warm_p50, 1),
+        "warm_p50_ms": round(dev_warm_p50 * 1e3, 2),
+        "vs_baseline": round(cpu_warm_p50 / dev_warm_p50, 3),
+        "cold_value": round(rows / dev_cold_p50, 1),
+        "cold_p50_ms": round(dev_cold_p50 * 1e3, 2),
+        "cold_vs_baseline": round(cpu_cold_p50 / dev_cold_p50, 3),
+        "cold_breakdown": breakdown,
+    }
+
+
+# -- config 4: ORDER BY + LIMIT TopK on device --
+def config4_sort_topk(device_kind: str):
+    rows = int(os.environ.get("BENCH_SORT_ROWS", 4_000_000))
+    log("  config 4: ORDER BY ... LIMIT 100 TopK (warm)")
+    _, src = bdata.sort_batches(rows, 1 << 19)
+    sql = "SELECT a, b, x FROM t ORDER BY a DESC, b LIMIT 100"
+    cpu_p50, cpu_out = _warm_query("cpu", src, "t", sql, rows)
+    if device_kind == "cpu":
+        dev_p50 = cpu_p50
+    else:
+        dev_p50, dev_out = _warm_query(device_kind, src, "t", sql, rows)
+        _assert_tables_match(dev_out, cpu_out, "config4 topk")
+
+    full_rows = int(os.environ.get("BENCH_FULLSORT_ROWS", 1_000_000))
+    log("  config 4b: full ORDER BY (warm)")
+    _, fsrc = bdata.sort_batches(full_rows, 1 << 19)
+    fsql = "SELECT a, b, x FROM t ORDER BY a, b"
+    fcpu_p50, fcpu_out = _warm_query("cpu", fsrc, "t", fsql, full_rows, runs=5)
+    if device_kind == "cpu":
+        fdev_p50 = fcpu_p50
+    else:
+        fdev_p50, fdev_out = _warm_query(device_kind, fsrc, "t", fsql, full_rows, runs=5)
+        _assert_tables_match(fdev_out, fcpu_out, "config4 fullsort")
+    return {
+        "name": "sort_topk",
+        "rows": rows,
+        "unit": "rows/s",
+        "value": round(rows / dev_p50, 1),
+        "p50_ms": round(dev_p50 * 1e3, 2),
+        "vs_baseline": round(cpu_p50 / dev_p50, 3),
+        "full_sort": {
+            "rows": full_rows,
+            "value": round(full_rows / fdev_p50, 1),
+            "p50_ms": round(fdev_p50 * 1e3, 2),
+            "vs_baseline": round(fcpu_p50 / fdev_p50, 3),
+        },
+    }
+
+
+# -- config 5: partitioned aggregate over an 8-device mesh --
+def config5_mesh(_device_kind: str):
+    """Runs in a subprocess on a CPU-simulated 8-device mesh (one
+    physical TPU chip is attached here; the mesh path is validated and
+    timed on virtual devices, the same trick the tests use)."""
+    import json
+    import subprocess
+
+    log("  config 5: partitioned mesh aggregate (8 virtual CPU devices)")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "benchmarks.mesh_bench"],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=1200,
+    )
+    sys.stderr.write(proc.stderr)
+    if proc.returncode != 0:
+        raise RuntimeError(f"mesh bench failed:\n{proc.stdout}\n{proc.stderr}")
+    return json.loads(proc.stdout.strip().splitlines()[-1])

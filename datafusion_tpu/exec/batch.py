@@ -180,14 +180,24 @@ def device_inputs(batch: RecordBatch, device=None):
     query run (transfer latency dominates on tunneled/remote devices)."""
     import jax
 
+    from datafusion_tpu.utils.metrics import METRICS
+
     key = ("device", None if device is None else repr(device))
     hit = batch.cache.get(key)
     if hit is not None:
+        METRICS.add("h2d.cache_hits")
         return hit
     put = (lambda a: jax.device_put(a, device)) if device is not None else jax.device_put
-    data = tuple(put(c) for c in batch.data)
-    validity = tuple(None if v is None else put(v) for v in batch.validity)
-    mask = None if batch.mask is None else put(batch.mask)
+
+    def put_counted(a):
+        if isinstance(a, np.ndarray):
+            METRICS.add("h2d.bytes", a.nbytes)
+        return put(a)
+
+    with METRICS.timer("h2d.dispatch"):
+        data = tuple(put_counted(c) for c in batch.data)
+        validity = tuple(None if v is None else put_counted(v) for v in batch.validity)
+        mask = None if batch.mask is None else put_counted(batch.mask)
     out = (data, validity, mask)
     batch.cache[key] = out
     return out

@@ -17,12 +17,17 @@ The reference planned Sort/Limit but left them `unimplemented!()`
   device sort buffer is bounded by the run size.
 
 Key transforms (shared by both paths):
-- DESC numeric keys sort by their negation (unsigned by bitwise
-  complement), so every key is ascending for the one fused sort.
+- Every ORDER BY key lowers to a (dead, value) operand pair: `dead`
+  is True for NULL keys and padding (nulls sort last, as a *separate*
+  leading key — a value sentinel would collide with real extremes:
+  ~int64.min == int64.max, -(-inf) == +inf), and dead rows' values are
+  zeroed so they compare equal among themselves.
+- DESC numeric keys sort by their negation (signed ints by bitwise
+  complement: -int64.min overflows), so every key is ascending for the
+  one fused sort.
 - Utf8 keys sort by host-computed rank tables
   (`StringDictionary.sort_ranks`): rank[code] is the value's position
   in sorted order, so code-ranked ascending == lexicographic.
-- Padding and NULL keys map to the dtype's max sentinel: nulls last.
 
 LIMIT over a sort slices the sorted permutation; a bare LIMIT just
 stops pulling batches early (no device work at all).
@@ -59,22 +64,22 @@ def _np_sort_key(
     validity: Optional[np.ndarray],
     kind: str,
     asc: bool,
-) -> np.ndarray:
-    """Host-side transformed key (run-merge path), ascending, nulls
-    last."""
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side transformed key (run-merge path): a (dead, value)
+    operand pair, ascending, nulls last via the dead flag."""
+    n = len(values)
+    dead = np.zeros(n, bool) if validity is None else ~validity
     if kind == "f":
         k = values.astype(np.float64)
         if not asc:
             k = -k
-        if validity is not None:
-            k = np.where(validity, k, np.inf)
-        return k
-    k = values.astype(np.int64)
-    if not asc:
-        k = ~k  # complement, not negation: -int64.min overflows
-    if validity is not None:
-        k = np.where(validity, k, np.iinfo(np.int64).max)
-    return k
+        k = np.where(dead, 0.0, k)
+    else:
+        k = values.astype(np.int64)
+        if not asc:
+            k = ~k  # complement, not negation: -int64.min overflows
+        k = np.where(dead, np.int64(0), k)
+    return dead, k
 
 
 class _KeyPlan:
@@ -137,8 +142,9 @@ class SortRelation(Relation):
 
     # -- shared key transform (device, traced) --
     def _device_keys(self, cols, valids, mask, capacity, rank_tables):
-        """Transformed ascending sort keys; masked-out rows sentinel to
-        the end."""
+        """Transformed ascending sort-key operands: a flat
+        [dead0, key0, dead1, key1, ...] list (dead = NULL/padded rows,
+        sorting last; their values zeroed so they tie)."""
         keys = []
         for kp in self._key_plans:
             v = cols[kp.index]
@@ -151,28 +157,25 @@ class SortRelation(Relation):
                 )
                 if not kp.asc:
                     k = -k
-                sent = jnp.int64(jnp.iinfo(jnp.int64).max)
             elif kp.kind == "f":
                 k = v.astype(jnp.float64)
                 if not kp.asc:
                     k = -k
-                sent = jnp.float64(jnp.inf)
             elif kp.kind == "u64":
                 # uint64 doesn't fit int64: flip the sign bit and
                 # reinterpret — order-preserving and lossless
                 k = (v.astype(jnp.uint64) ^ jnp.uint64(1 << 63)).view(jnp.int64)
                 if not kp.asc:
                     k = ~k
-                sent = jnp.int64(jnp.iinfo(jnp.int64).max)
             else:
                 k = v.astype(jnp.int64)
                 if not kp.asc:
                     k = ~k  # complement, not negation: -int64.min overflows
-                sent = jnp.int64(jnp.iinfo(jnp.int64).max)
             dead = ~mask
             if valid is not None:
                 dead = dead | ~valid
-            keys.append(jnp.where(dead, sent, k))
+            keys.append(dead)
+            keys.append(jnp.where(dead, jnp.zeros((), k.dtype), k))
         return keys
 
     # -- streaming TopK path --
@@ -217,10 +220,10 @@ class SortRelation(Relation):
     def _topk_init(self, k, in_schema):
         keys = []
         for kp in self._key_plans:
-            if kp.kind == "f":
-                keys.append(jnp.full(k, jnp.inf, jnp.float64))
-            else:
-                keys.append(jnp.full(k, jnp.iinfo(jnp.int64).max, jnp.int64))
+            keys.append(jnp.ones(k, bool))  # dead flag: empty slots last
+            keys.append(
+                jnp.zeros(k, jnp.float64 if kp.kind == "f" else jnp.int64)
+            )
         vals = tuple(
             jnp.zeros(k, in_schema.field(i).data_type.np_dtype)
             for i in range(len(in_schema))
@@ -322,7 +325,9 @@ class SortRelation(Relation):
                 kind = "i"
             else:
                 kind = kp.kind
-            keys.append(_np_sort_key(vals, validity[idx], kind, se.asc))
+            dead, k = _np_sort_key(vals, validity[idx], kind, se.asc)
+            keys.append(dead)
+            keys.append(k)
         return keys
 
     def _sorted_run(self, keys: list[np.ndarray], n: int) -> np.ndarray:
@@ -330,7 +335,9 @@ class SortRelation(Relation):
         cap = bucket_capacity(n)
         ops = []
         for key in keys:
-            pad_val = np.inf if key.dtype.kind == "f" else np.iinfo(np.int64).max
+            # padding rows: dead flag True, value 0 — they tie with NULL
+            # rows and stability keeps real rows (indices < n) first
+            pad_val = True if key.dtype.kind == "b" else 0
             padded = np.full(cap, pad_val, dtype=key.dtype)
             padded[:n] = key[:n]
             ops.append(jnp.asarray(padded))
@@ -346,8 +353,13 @@ class SortRelation(Relation):
         structured-array searchsorted (lexicographic on all keys)."""
 
         def to_struct(keys):
-            arr = np.ascontiguousarray(np.stack(keys, axis=1))
-            return arr.view([("", arr.dtype)] * arr.shape[1]).ravel()
+            # heterogeneous fields (bool dead flags, int64/f64 values);
+            # numpy sorts/searches structured dtypes lexicographically
+            dt = np.dtype([(f"f{i}", k.dtype) for i, k in enumerate(keys)])
+            arr = np.empty(len(keys[0]), dt)
+            for i, k in enumerate(keys):
+                arr[f"f{i}"] = k
+            return arr
 
         items = [
             (to_struct(k), p) for k, p in zip(run_keys, run_perms)

@@ -19,6 +19,7 @@ import numpy as np
 from datafusion_tpu.datatypes import DataType, Schema
 from datafusion_tpu.errors import ExecutionError, IoError
 from datafusion_tpu.exec.batch import RecordBatch, StringDictionary, make_host_batch
+from datafusion_tpu.utils.metrics import METRICS
 
 DEFAULT_BATCH_SIZE = 131072
 
@@ -87,6 +88,9 @@ class CsvReader:
         ]
 
     def batches(self) -> Iterator[RecordBatch]:
+        yield from METRICS.timed_iter("scan.parse", self._batches())
+
+    def _batches(self) -> Iterator[RecordBatch]:
         import pyarrow as pa
         import pyarrow.csv as pacsv
 
@@ -134,6 +138,7 @@ class CsvReader:
     def _to_batch(self, tbl) -> RecordBatch:
         cols = [tbl.column(i) for i in range(tbl.num_columns)]
         columns, validity = _arrow_to_columns(cols, self.out_schema, self.dicts)
+        METRICS.add("scan.rows", tbl.num_rows)
         return make_host_batch(self.out_schema, columns, validity, list(self.dicts))
 
 
@@ -165,6 +170,9 @@ class NdJsonReader:
         ]
 
     def batches(self) -> Iterator[RecordBatch]:
+        yield from METRICS.timed_iter("scan.parse", self._batches())
+
+    def _batches(self) -> Iterator[RecordBatch]:
         try:
             f = open(self.path, "r", encoding="utf-8")
         except OSError as e:
@@ -186,6 +194,7 @@ class NdJsonReader:
                 yield self._rows_to_batch(rows)
 
     def _rows_to_batch(self, rows: list[dict]) -> RecordBatch:
+        METRICS.add("scan.rows", len(rows))
         columns: list[np.ndarray] = []
         validity: list[Optional[np.ndarray]] = []
         for i, field in enumerate(self.out_schema.fields):
@@ -225,6 +234,9 @@ class ParquetReader:
         ]
 
     def batches(self) -> Iterator[RecordBatch]:
+        yield from METRICS.timed_iter("scan.parse", self._batches())
+
+    def _batches(self) -> Iterator[RecordBatch]:
         import pyarrow.parquet as pq
 
         try:
@@ -238,6 +250,7 @@ class ParquetReader:
 
             cols = [pa.chunked_array([c]) for c in cols]
             columns, validity = _arrow_to_columns(cols, self.out_schema, self.dicts)
+            METRICS.add("scan.rows", arrow_batch.num_rows)
             yield make_host_batch(self.out_schema, columns, validity, list(self.dicts))
 
 

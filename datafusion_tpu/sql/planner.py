@@ -25,7 +25,7 @@ from __future__ import annotations
 from typing import Optional, Protocol
 
 from datafusion_tpu.datatypes import DataType, Field, Schema, get_supertype
-from datafusion_tpu.errors import NotSupportedError, PlanError
+from datafusion_tpu.errors import InvalidColumnError, NotSupportedError, PlanError
 from datafusion_tpu.plan.expr import (
     AggregateFunction,
     BinaryExpr,
@@ -199,7 +199,37 @@ class SqlToRel:
         if sel.having is not None:
             raise NotSupportedError("HAVING is not implemented yet")
 
-        plan = self._apply_order_by(plan, sel.order_by)
+        if sel.order_by:
+            # resolve each key against the SELECT output first (so
+            # aliases work); a column that is only in the input is
+            # carried as a *hidden* projection column, sorted on, and
+            # stripped by a final projection.  (The reference resolves
+            # only against the projection schema, sqlplanner.rs:139-151,
+            # so `SELECT city ... ORDER BY lat` fails there.)
+            out_schema = plan.schema
+            sort_exprs: list[SortExpr] = []
+            hidden: list[Expr] = []
+            for o in sel.order_by:
+                try:
+                    e = self.sql_to_rex(o.expr, out_schema)
+                except InvalidColumnError:
+                    he = self.sql_to_rex(o.expr, input_schema)
+                    e = Column(len(exprs) + len(hidden))
+                    hidden.append(he)
+                sort_exprs.append(SortExpr(e, o.asc))
+            if hidden:
+                ext_fields = fields + exprlist_to_fields(hidden, input_schema)
+                ext_proj = Projection(
+                    exprs + hidden, projection_input, Schema(ext_fields)
+                )
+                plan = Sort(sort_exprs, ext_proj, ext_proj.schema)
+                # keep Limit adjacent to Sort: the executor's TopK path
+                # matches Limit(Sort(...))
+                plan = self._apply_limit(plan, sel.limit)
+                return Projection(
+                    [Column(i) for i in range(len(exprs))], plan, Schema(fields)
+                )
+            plan = Sort(sort_exprs, plan, out_schema)
         plan = self._apply_limit(plan, sel.limit)
         return plan
 
@@ -251,17 +281,6 @@ class SqlToRel:
             return x
 
         return rewrite(e)
-
-    def _apply_order_by(
-        self, plan: LogicalPlan, order_by: list[ast.SqlOrderByExpr]
-    ) -> LogicalPlan:
-        if not order_by:
-            return plan
-        out_schema = plan.schema
-        sort_exprs = [
-            SortExpr(self.sql_to_rex(o.expr, out_schema), o.asc) for o in order_by
-        ]
-        return Sort(sort_exprs, plan, out_schema)
 
     def _apply_limit(self, plan: LogicalPlan, limit: Optional[ast.SqlNode]) -> LogicalPlan:
         if limit is None:

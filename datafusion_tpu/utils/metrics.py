@@ -35,6 +35,19 @@ class Metrics:
     def add(self, name: str, n: int = 1):
         self.counts[name] += n
 
+    def timed_iter(self, name: str, it):
+        """Wrap a generator so time spent *producing* items (host parse,
+        encode) accrues to `name`, while consumer time doesn't."""
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            finally:
+                self.timings[name] += time.perf_counter() - t0
+            yield item
+
     def snapshot(self) -> dict:
         return {
             "timings_s": dict(self.timings),

@@ -232,3 +232,100 @@ class TestHighCardinalityAggregate:
             a, s = got[g]
             np.testing.assert_allclose(s, sums[g], rtol=1e-9)
             np.testing.assert_allclose(a, sums[g] / cnts[g], rtol=1e-9)
+
+
+class TestSentinelCollisions:
+    """Real extreme values must not collide with the NULL/padding
+    markers: ~int64.min == int64.max and -(-inf) == +inf, so nulls ride
+    a separate dead-flag sort operand instead of value sentinels."""
+
+    def test_int64_min_desc_with_nulls(self):
+        schema = Schema([Field("x", DataType.INT64, True)])
+        vals = np.array([0, np.iinfo(np.int64).min, 5], dtype=np.int64)
+        valid = np.array([False, True, True])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid])
+
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC")
+        assert t.column_values(0) == [5, np.iinfo(np.int64).min, None]
+
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC LIMIT 2")
+        assert t.column_values(0) == [5, np.iinfo(np.int64).min]
+
+    def test_int64_extremes_asc(self):
+        schema = Schema([Field("x", DataType.INT64, True)])
+        vals = np.array(
+            [np.iinfo(np.int64).max, 0, np.iinfo(np.int64).min], dtype=np.int64
+        )
+        valid = np.array([True, False, True])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid])
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x")
+        assert t.column_values(0) == [
+            np.iinfo(np.int64).min, np.iinfo(np.int64).max, None,
+        ]
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x LIMIT 3")
+        assert t.column_values(0) == [
+            np.iinfo(np.int64).min, np.iinfo(np.int64).max, None,
+        ]
+
+    def test_float_inf_desc_with_nulls(self):
+        schema = Schema([Field("x", DataType.FLOAT64, True)])
+        vals = np.array([-np.inf, 1.0, np.inf, 0.0])
+        valid = np.array([True, True, True, False])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid])
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC")
+        assert t.column_values(0) == [np.inf, 1.0, -np.inf, None]
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC LIMIT 3")
+        assert t.column_values(0) == [np.inf, 1.0, -np.inf]
+
+    def test_uint64_max_asc_with_nulls(self):
+        schema = Schema([Field("x", DataType.UINT64, True)])
+        vals = np.array([np.iinfo(np.uint64).max, 1, 0], dtype=np.uint64)
+        valid = np.array([True, True, False])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid])
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x")
+        assert t.column_values(0) == [1, np.iinfo(np.uint64).max, None]
+
+    def test_full_sort_multirun_int64_min(self):
+        # force the run-merge path (no LIMIT, multiple batches)
+        rng = np.random.default_rng(5)
+        n = 3000
+        vals = rng.integers(-1000, 1000, n).astype(np.int64)
+        vals[0] = np.iinfo(np.int64).min
+        vals[n // 2] = np.iinfo(np.int64).max
+        valid = np.ones(n, bool)
+        valid[1::7] = False
+        schema = Schema([Field("x", DataType.INT64, True)])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid], batch_rows=1000)
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC")
+        got = t.column_values(0)
+        want = sorted(vals[valid].tolist(), reverse=True) + [None] * int(
+            (~valid).sum()
+        )
+        assert got == want
+
+
+class TestOrderByHiddenColumn:
+    """ORDER BY a column not in the SELECT list: planned as a hidden
+    projection column + final strip (the reference resolves only
+    against the projection schema, `sqlplanner.rs:139-151`, and fails)."""
+
+    def test_order_by_unselected_column(self):
+        schema = Schema(
+            [Field("name", DataType.UTF8, False), Field("v", DataType.INT64, False)]
+        )
+        d = StringDictionary()
+        names = np.array([d.add(s) for s in ["b", "c", "a"]], dtype=np.int32)
+        v = np.array([2, 3, 1], dtype=np.int64)
+        ctx = _ctx_with("t", schema, [names, v], dicts=[d, None])
+        t = ctx.sql_collect("SELECT name FROM t ORDER BY v DESC")
+        assert t.column_values(0) == ["c", "b", "a"]
+        assert len(t.schema) == 1  # hidden column stripped
+
+        t = ctx.sql_collect("SELECT name FROM t ORDER BY v LIMIT 2")
+        assert t.column_values(0) == ["a", "b"]
+
+    def test_order_by_alias_still_works(self):
+        schema = Schema([Field("v", DataType.INT64, False)])
+        ctx = _ctx_with("t", schema, [np.array([3, 1, 2], dtype=np.int64)])
+        t = ctx.sql_collect("SELECT v AS w FROM t ORDER BY w")
+        assert t.column_values(0) == [1, 2, 3]
