@@ -48,14 +48,29 @@ def _configure(lib) -> None:
     ]
     lib.dtf_csv_close.restype = None
     lib.dtf_csv_close.argtypes = [ctypes.c_void_p]
+    # SQL front-end + plan IR (native/sql_frontend.cpp).  restype is
+    # c_void_p (not c_char_p) so the malloc'd pointer survives for
+    # string_at + dtf_free instead of being auto-converted and leaked.
+    for fn in ("dtf_parse_sql", "dtf_plan_roundtrip", "dtf_plan_repr"):
+        f = getattr(lib, fn)
+        f.restype = ctypes.c_void_p
+        f.argtypes = [ctypes.c_char_p]
+    lib.dtf_free.restype = None
+    lib.dtf_free.argtypes = [ctypes.c_void_p]
 
 
 def build_library() -> bool:
     """Compile the shared library (idempotent); True on success."""
-    src = os.path.join(_NATIVE_DIR, "datafusion_native.cpp")
-    if not os.path.exists(src):
+    srcs = [
+        os.path.join(_NATIVE_DIR, f)
+        for f in ("datafusion_native.cpp", "sql_frontend.cpp")
+        if os.path.exists(os.path.join(_NATIVE_DIR, f))
+    ]
+    if not srcs:
         return False
-    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src):
+    if os.path.exists(_LIB_PATH) and os.path.getmtime(_LIB_PATH) >= max(
+        os.path.getmtime(s) for s in srcs
+    ):
         return True
     try:
         subprocess.run(
@@ -77,7 +92,10 @@ def load_library(build: bool = True):
         return None
     if _lib is not None or _load_failed:
         return _lib
-    if not os.path.exists(_LIB_PATH) and build:
+    if build:
+        # always consult the build (idempotent mtime check): a stale .so
+        # from an older source set would otherwise load but fail symbol
+        # configuration and silently disable every native component
         build_library()
     try:
         lib = ctypes.CDLL(_LIB_PATH)

@@ -64,6 +64,11 @@ class NativeCsvReader:
         )
 
     def batches(self) -> Iterator[RecordBatch]:
+        from datafusion_tpu.utils.metrics import METRICS
+
+        yield from METRICS.timed_iter("scan.parse", self._batches())
+
+    def _batches(self) -> Iterator[RecordBatch]:
         lib = self.lib
         n_all = len(self.schema)
         types = (ctypes.c_int32 * n_all)(

@@ -620,6 +620,12 @@ def expr_to_field(e: Expr, input_schema: Schema) -> Field:
         if st is None:
             raise PlanError(f"No supertype for {lt!r} and {rt!r}")
         return Field("binary_expr", st, True)
+    if isinstance(e, IsNull):
+        # the reference's expr_to_field has no arm for these
+        # (sqlplanner.rs:376-406); a NULL test is a Boolean output
+        return Field("is_null", DataType.BOOLEAN, False)
+    if isinstance(e, IsNotNull):
+        return Field("is_not_null", DataType.BOOLEAN, False)
     if isinstance(e, SortExpr):
         return expr_to_field(e.expr, input_schema)
     raise PlanError(f"Cannot determine schema field for expression {e!r}")

@@ -326,7 +326,19 @@ class Parser:
 
 def parse_sql(sql: str) -> ast.SqlNode:
     """Parse one SQL statement (reference `DFParser::parse_sql`,
-    `dfparser.rs:74`)."""
+    `dfparser.rs:74`).
+
+    The C++ front-end (`native/sql_frontend.cpp`) parses by default —
+    the reference's parser is native too; this Python parser is the
+    fallback when the library is unavailable (or DATAFUSION_TPU_NATIVE=0).
+    Both implement the identical grammar; parity is pinned by
+    tests/test_native_frontend.py.
+    """
+    from datafusion_tpu.native.sqlfront import native_parse_sql
+
+    node = native_parse_sql(sql)
+    if node is not None:
+        return node
     return Parser(sql).parse_statement()
 
 

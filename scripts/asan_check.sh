@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# AddressSanitizer check of the C++ native runtime (SURVEY §5.2: the
+# reference gets memory safety from Rust; the rebuild's equivalent is
+# sanitizer CI for native/).  Builds every native source plus the
+# driver with -fsanitize=address and runs the end-to-end corpus.
+set -euo pipefail
+cd "$(dirname "$0")/../native"
+
+CXX="${CXX:-g++}"
+"$CXX" -O1 -g -std=c++17 -fsanitize=address -fno-omit-frame-pointer \
+  -Wall -Wextra \
+  datafusion_native.cpp sql_frontend.cpp asan_driver.cpp \
+  -o asan_driver
+ASAN_OPTIONS=detect_leaks=1 ./asan_driver
+rm -f asan_driver
+echo "ASan check passed"
