@@ -77,16 +77,20 @@ def _has_tpu() -> bool:
     return any(d.platform != "cpu" for d in jax.devices())
 
 
-def _warm_query(device, src, table, sql, rows, runs=WARM_RUNS):
+def _warm_query(device, src, table, sql, rows, runs=WARM_RUNS, warmup=None):
     """Steady-state p50 of re-running one operator tree (device-resident
-    inputs after warm-up)."""
+    inputs after warm-up).  The CPU baseline gets fewer runs (it is the
+    yardstick, not the metric — and the single-core path is slow)."""
     from datafusion_tpu.exec.context import ExecutionContext
     from datafusion_tpu.exec.materialize import collect
 
+    if device == "cpu":
+        runs = min(3, runs)
+        warmup = 1 if warmup is None else warmup
     ctx = ExecutionContext(device=device)
     ctx.register_datasource(table, src)
     rel = ctx.sql(sql)
-    p50, out = _timed(lambda: collect(rel), runs)
+    p50, out = _timed(lambda: collect(rel), runs, warmup if warmup is not None else WARMUP)
     log(f"    {device or 'default'} warm: p50 {p50*1e3:.1f} ms, {rows/p50/1e6:.2f} M rows/s")
     return p50, out
 
@@ -110,7 +114,9 @@ def config1_csv_filter(device_kind: str):
     sql = "SELECT city, lat, lng, lat + lng FROM cities WHERE lat > 51.0 AND lat < 53.0"
 
     def cold(device):
-        ctx = ExecutionContext(device=device)
+        # 512k-row batches: per-batch link latency dominates on the
+        # tunneled device, so fewer, larger batches
+        ctx = ExecutionContext(device=device, batch_size=1 << 19)
         ctx.register_csv("cities", path, schema, has_header=True)
         return collect(ctx.sql(sql))
 

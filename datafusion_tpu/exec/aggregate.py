@@ -63,6 +63,7 @@ from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
 from datafusion_tpu.exec.relation import Relation
 from datafusion_tpu.plan.expr import AggregateFunction, Column, Expr
 from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
 
 
 DENSE_GROUP_MAX = 64
@@ -726,7 +727,8 @@ class AggregateRelation(Relation):
             str_aux = self._compute_str_aux(batch)
             with METRICS.timer("execute.aggregate"), device_scope(self.device):
                 data, validity, mask = device_inputs(batch, self.device)
-                state = self._jit(
+                state = device_call(
+                    self._jit,
                     data,
                     validity,
                     tuple(aux),

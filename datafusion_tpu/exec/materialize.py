@@ -13,25 +13,104 @@ from typing import Optional
 import numpy as np
 
 from datafusion_tpu.datatypes import DataType, Schema
-from datafusion_tpu.exec.batch import RecordBatch
+from datafusion_tpu.exec.batch import RecordBatch, bucket_capacity
+from datafusion_tpu.utils.metrics import METRICS
+
+# device-side compaction pays off when it at least halves the D2H bytes
+_COMPACT_FACTOR = 2
+
+
+_GATHER_JIT = None
+
+
+def _gather_compact(cols, valids, idxs):
+    """Jitted gather of the live rows to the front (selective filters:
+    transfer count rows over the link instead of the whole capacity —
+    D2H bandwidth is the scarce resource on tunneled devices).  One
+    module-level jit, cached per (shapes, dtypes, validity pattern)."""
+    global _GATHER_JIT
+    if _GATHER_JIT is None:
+        import jax
+
+        def gather(cols, valids, idxs):
+            return (
+                tuple(c[idxs] for c in cols),
+                tuple(None if v is None else v[idxs] for v in valids),
+            )
+
+        _GATHER_JIT = jax.jit(gather)
+    return _GATHER_JIT(cols, valids, idxs)
+
+
+def iter_with_mask_prefetch(batches):
+    """Iterate batches one ahead, starting each batch's mask D2H copy
+    as soon as the batch exists: pulling batch N+1 dispatches its
+    kernel and overlaps its mask transfer with batch N's processing.
+    Callers that feed compact_batch should wrap their scans with this —
+    compact_batch must see the mask before it can decide whether to
+    compact on device, so an unprefetched mask costs one link
+    round-trip per batch."""
+    from collections import deque
+
+    pending: deque = deque()
+    for b in batches:
+        if b.mask is not None and hasattr(b.mask, "copy_to_host_async"):
+            b.mask.copy_to_host_async()
+        pending.append(b)
+        if len(pending) > 1:
+            yield pending.popleft()
+    while pending:
+        yield pending.popleft()
 
 
 def compact_batch(batch: RecordBatch):
     """Bring a batch to host and drop padding/filtered rows.
 
     Returns (columns, validity, dicts, num_live_rows); strings stay
-    dictionary-coded.
+    dictionary-coded.  Selection masks compact *on device* when that
+    meaningfully shrinks the transfer (the reference gathers per column
+    on the host per batch, `filter.rs:80-111`; here the gather is one
+    fused device kernel and only live rows cross the link).
     """
     n = batch.num_rows
-    # overlap D2H latencies: start all copies before the first blocking
-    # np.asarray (matters on tunneled/remote devices)
-    for arr in (*batch.data, *batch.validity, batch.mask):
-        if hasattr(arr, "copy_to_host_async"):
-            arr.copy_to_host_async()
+    on_device = any(hasattr(a, "copy_to_host_async") for a in batch.data)
     live: Optional[np.ndarray] = None
     if batch.mask is not None:
+        if hasattr(batch.mask, "copy_to_host_async"):
+            batch.mask.copy_to_host_async()
         live = np.asarray(batch.mask)[: batch.capacity]
         live = live & (np.arange(batch.capacity) < n)
+
+    if live is not None and on_device:
+        idx = np.nonzero(live)[0]
+        count = len(idx)
+        cap_out = bucket_capacity(max(count, 1))
+        if cap_out * _COMPACT_FACTOR <= batch.capacity:
+            import jax.numpy as jnp
+
+            padded = np.zeros(cap_out, np.int32)
+            padded[:count] = idx
+            with METRICS.timer("d2h.compact"):
+                ccols, cvalids = _gather_compact(
+                    tuple(batch.data),
+                    tuple(batch.validity),
+                    jnp.asarray(padded),
+                )
+                for arr in (*ccols, *cvalids):
+                    if hasattr(arr, "copy_to_host_async"):
+                        arr.copy_to_host_async()
+                cols = [np.asarray(c)[:count] for c in ccols]
+                valids = [
+                    None if v is None else np.asarray(v)[:count] for v in cvalids
+                ]
+            METRICS.add("d2h.compacted_batches")
+            return cols, valids, list(batch.dicts), count
+
+    # overlap D2H latencies: start all copies before the first blocking
+    # np.asarray (matters on tunneled/remote devices)
+    for arr in (*batch.data, *batch.validity):
+        if hasattr(arr, "copy_to_host_async"):
+            arr.copy_to_host_async()
     cols = []
     valids = []
     for i in range(batch.num_columns):
@@ -126,10 +205,12 @@ def collect_columns(relation):
     dicts: list = [None] * ncols
     any_null = [False] * ncols
     total = 0
-    for batch in relation.batches():
+
+    def consume(batch):
+        nonlocal total
         cols, valids, bdicts, n = compact_batch(batch)
         if n == 0:
-            continue
+            return
         total += n
         for i in range(ncols):
             parts[i].append(cols[i])
@@ -138,6 +219,12 @@ def collect_columns(relation):
                 any_null[i] = True
             if bdicts[i] is not None:
                 dicts[i] = bdicts[i]
+
+    # shallow pipeline: overlap batch N+1's kernel dispatch + mask D2H
+    # with batch N's transfers instead of ping-ponging on a
+    # high-latency link
+    for batch in iter_with_mask_prefetch(relation.batches()):
+        consume(batch)
     columns = []
     validity: list[Optional[np.ndarray]] = []
     for i in range(ncols):

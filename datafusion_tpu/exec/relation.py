@@ -23,6 +23,7 @@ from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
 from datafusion_tpu.errors import NotSupportedError
 from datafusion_tpu.plan.expr import Column, Expr
 from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
 
 
 def device_scope(device):
@@ -167,7 +168,8 @@ class PipelineRelation(Relation):
             aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
             with METRICS.timer("execute.pipeline"), device_scope(self.device):
                 data, validity, mask_in = device_inputs(batch, self.device)
-                cols, valids, mask = self._jit(
+                cols, valids, mask = device_call(
+                    self._jit,
                     data,
                     validity,
                     tuple(aux),

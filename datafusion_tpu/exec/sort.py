@@ -49,10 +49,11 @@ from datafusion_tpu.exec.batch import (
     bucket_capacity,
     make_host_batch,
 )
-from datafusion_tpu.exec.materialize import compact_batch
+from datafusion_tpu.exec.materialize import compact_batch, iter_with_mask_prefetch
 from datafusion_tpu.exec.relation import Relation, device_scope as _device_scope
 from datafusion_tpu.plan.expr import Column, SortExpr
 from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
 
 # LIMIT at or below this rides the streaming device TopK; above it the
 # query is effectively a full sort and takes the run-merge path.
@@ -258,7 +259,8 @@ class SortRelation(Relation):
                 state = self._topk_init(k, in_schema)
             with METRICS.timer("execute.sort"), _device_scope(self.device):
                 data, validity, mask = device_inputs(batch, self.device)
-                state = self._topk_jit(
+                state = device_call(
+                    self._topk_jit,
                     k,
                     state,
                     data,
@@ -429,7 +431,7 @@ class SortRelation(Relation):
             pending_valids = None
             pending_n = 0
 
-        for batch in self.child.batches():
+        for batch in iter_with_mask_prefetch(self.child.batches()):
             for i, d in enumerate(batch.dicts):
                 if d is not None:
                     dicts[i] = d
@@ -525,7 +527,7 @@ class LimitRelation(Relation):
         remaining = self.limit
         if remaining <= 0:
             return
-        for batch in self.child.batches():
+        for batch in iter_with_mask_prefetch(self.child.batches()):
             cols, valids, dicts, n = compact_batch(batch)
             if n == 0:
                 continue

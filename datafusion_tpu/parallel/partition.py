@@ -73,6 +73,7 @@ from datafusion_tpu.parallel.physical import PlanFragment
 from datafusion_tpu.plan.expr import Expr
 from datafusion_tpu.plan.logical import Aggregate, LogicalPlan, Selection, TableScan
 from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
 
 
 def _share_dictionaries(partitions: Sequence[DataSource]) -> None:
@@ -351,7 +352,8 @@ class PartitionedAggregateRelation(AggregateRelation):
                 else []
             )
             with METRICS.timer("execute.partitioned_aggregate"):
-                state = self._stacked_jit(
+                state = device_call(
+                    self._stacked_jit,
                     tuple(jnp.asarray(c) for c in cols_np),
                     tuple(jnp.asarray(v) for v in valids_np),
                     tuple(aux),
@@ -364,7 +366,7 @@ class PartitionedAggregateRelation(AggregateRelation):
         if state is None:
             state = self._init_stacked_state(group_capacity(1))
         with METRICS.timer("execute.collective_combine"):
-            return self._combine_jit(state)
+            return device_call(self._combine_jit, state)
 
 
 class PartitionedContext(ExecutionContext):

@@ -1,0 +1,74 @@
+"""Coordinator<->worker wire protocol.
+
+The reference planned HTTP + Arrow IPC between console and worker nodes
+(`README.md:33`, worker image EXPOSE 8080 in
+`scripts/docker/worker/Dockerfile`); here the transport is a
+length-prefixed JSON frame over TCP — the payloads that matter (plan
+fragments) already have a JSON wire format (`logicalplan.rs:609-648`'s
+contract), and accumulator/result arrays travel as raw little-endian
+buffers in base64.
+
+Frame: 8-byte big-endian length, then UTF-8 JSON.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+from typing import Optional
+
+import numpy as np
+
+from datafusion_tpu.errors import ExecutionError
+
+_LEN = struct.Struct(">Q")
+MAX_FRAME = 1 << 32
+
+
+def send_msg(sock: socket.socket, obj: dict) -> None:
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    """One frame, or None on clean EOF."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ExecutionError(f"frame of {n} bytes exceeds protocol limit")
+    data = _recv_exact(sock, n)
+    if data is None:
+        raise ExecutionError("connection closed mid-frame")
+    return json.loads(data.decode("utf-8"))
+
+
+def enc_array(a: np.ndarray) -> dict:
+    a = np.ascontiguousarray(a)
+    return {
+        "dtype": a.dtype.str,  # byte-order explicit ('<i8', '|b1', ...)
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def dec_array(o: dict) -> np.ndarray:
+    raw = base64.b64decode(o["data"])
+    return (
+        np.frombuffer(raw, dtype=np.dtype(o["dtype"]))
+        .reshape(o["shape"])
+        .copy()  # frombuffer is read-only; combiners mutate
+    )

@@ -1,0 +1,215 @@
+"""Worker node: executes shipped plan fragments.
+
+The reference scaffolds worker nodes that never got built — the binary
+is commented out of `Cargo.toml:25-27`, the docker image expects
+`/opt/datafusion/bin/worker` (`scripts/docker/worker/Dockerfile`), and
+etcd membership wiring is commented in `scripts/smoketest.sh:41-66`.
+This is the real thing, TPU-native: a worker receives a `PlanFragment`
+(JSON wire format), scans its partition, runs the fused device
+aggregation kernel, and returns the *partial aggregate state* —
+accumulator arrays plus the group-key table — for the coordinator to
+merge.  Arbitrary Projection/Selection fragments return materialized
+rows instead.
+
+Requests:  {"type": "ping"}
+           {"type": "execute_fragment", "fragment": <PlanFragment str>}
+           {"type": "execute_plan", "fragment": <PlanFragment str>}
+Responses: {"type": "pong", ...} / {"type": "partial_state", ...} /
+           {"type": "rows", ...} / {"type": "error", "message": ...}
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import threading
+
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType
+from datafusion_tpu.errors import DataFusionError, ExecutionError
+from datafusion_tpu.exec.aggregate import AggregateRelation
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.materialize import collect_columns
+from datafusion_tpu.parallel.physical import PlanFragment
+from datafusion_tpu.parallel.wire import enc_array, recv_msg, send_msg
+from datafusion_tpu.plan.logical import TableScan
+
+
+def _find_scan(plan) -> TableScan:
+    node = plan
+    while node is not None:
+        if isinstance(node, TableScan):
+            return node
+        kids = node.children()
+        node = kids[0] if kids else None
+    raise ExecutionError("fragment plan has no TableScan leaf")
+
+
+class WorkerState:
+    def __init__(self, device=None, batch_size: int = 131072):
+        self.device = device
+        self.batch_size = batch_size
+        self.queries = 0
+
+    def _relation(self, frag: PlanFragment):
+        plan = frag.logical_plan()
+        scan = _find_scan(plan)
+        ds = frag.build_datasource(self.batch_size)
+        ctx = ExecutionContext(device=self.device, batch_size=self.batch_size)
+        ctx.register_datasource(scan.table_name, ds)
+        return ctx.execute(plan), plan
+
+    def execute_fragment(self, fragment_str: str) -> dict:
+        """Partial-aggregate path: returns accumulator state + key table."""
+        rel, _plan = self._relation(PlanFragment.from_json_str(fragment_str))
+        if not isinstance(rel, AggregateRelation):
+            raise ExecutionError(
+                "execute_fragment needs an Aggregate fragment; "
+                f"got {type(rel).__name__} (use execute_plan)"
+            )
+        counts, accs = rel.accumulate()
+        self.queries += 1
+        if rel.key_cols:
+            n_groups = rel.encoder.num_groups
+        else:
+            n_groups = 1  # global aggregate: one implicit group
+        counts = np.asarray(counts)[:n_groups]
+        slots = [np.asarray(a)[:n_groups] for a in accs]
+
+        # the worker's dense group ids are meaningless to the
+        # coordinator — ship the key tuples (and the dictionaries the
+        # string codes refer to) so it can re-encode into ITS id space
+        key_dicts = {}
+        for k, idx in enumerate(rel.key_cols):
+            d = rel._key_dicts.get(idx)
+            key_dicts[str(k)] = None if d is None else d.values
+        slot_dicts = {}
+        for slot_idx, sl in enumerate(rel.slots):
+            if sl.is_string:
+                d = rel._str_dicts.get(slot_idx)
+                slot_dicts[str(slot_idx)] = [] if d is None else d.values
+        return {
+            "type": "partial_state",
+            "num_groups": n_groups,
+            "counts": enc_array(counts),
+            "slots": [enc_array(s) for s in slots],
+            "key_rows": enc_array(
+                rel.encoder._arr[:n_groups]
+                if rel.key_cols
+                else np.empty((0, 0), np.int64)
+            ),
+            "key_dicts": key_dicts,
+            "slot_dicts": slot_dicts,
+        }
+
+    def execute_plan(self, fragment_str: str) -> dict:
+        """Row-returning path (Projection/Selection fragments): scan,
+        filter, project on-device, materialize and ship the rows."""
+        rel, plan = self._relation(PlanFragment.from_json_str(fragment_str))
+        columns, validity, dicts, total = collect_columns(rel)
+        self.queries += 1
+        out_cols = []
+        for i, f in enumerate(plan.schema.fields):
+            c = columns[i]
+            if f.data_type == DataType.UTF8:
+                # decode: dictionaries are worker-local
+                if dicts[i] is not None:
+                    c = dicts[i].decode(c)
+                out_cols.append({"strings": [str(s) for s in c]})
+            else:
+                out_cols.append(enc_array(c))
+        return {
+            "type": "rows",
+            "num_rows": total,
+            "columns": out_cols,
+            "validity": [None if v is None else enc_array(v) for v in validity],
+        }
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        state: WorkerState = self.server.worker_state  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = recv_msg(self.request)
+            except (ConnectionError, OSError, ExecutionError):
+                return
+            if msg is None:
+                return
+            try:
+                kind = msg.get("type")
+                if kind == "ping":
+                    out = {"type": "pong", "queries": state.queries}
+                elif kind == "execute_fragment":
+                    out = state.execute_fragment(msg["fragment"])
+                elif kind == "execute_plan":
+                    out = state.execute_plan(msg["fragment"])
+                elif kind == "shutdown":
+                    send_msg(self.request, {"type": "bye"})
+                    threading.Thread(
+                        target=self.server.shutdown, daemon=True
+                    ).start()
+                    return
+                else:
+                    out = {"type": "error", "message": f"unknown request {kind!r}"}
+            except DataFusionError as e:
+                out = {"type": "error", "message": str(e)}
+            except Exception as e:  # noqa: BLE001 — workers must not die on a bad query
+                out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
+            try:
+                send_msg(self.request, out)
+            except (ConnectionError, OSError):
+                return
+
+
+class WorkerServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072):
+    """Run a worker; returns (server, thread) for embedding, or call
+    serve_forever via the CLI entry (python -m datafusion_tpu.worker)."""
+    host, _, port = bind.partition(":")
+    server = WorkerServer((host, int(port or 0)), _Handler)
+    server.worker_state = WorkerState(device=device, batch_size=batch_size)  # type: ignore[attr-defined]
+    return server
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="datafusion-tpu-worker",
+        description="datafusion-tpu worker node (executes plan fragments)",
+    )
+    ap.add_argument("--bind", default="127.0.0.1:8462",
+                    help="host:port to listen on (default 127.0.0.1:8462)")
+    ap.add_argument("--device", default=None,
+                    help="execution device: cpu | tpu (default: jax default)")
+    ap.add_argument("--batch-size", type=int, default=131072)
+    args = ap.parse_args(argv)
+    # honor JAX_PLATFORMS even on hosts whose sitecustomize registers an
+    # accelerator backend and overrides the env var at interpreter boot
+    # (same re-pin as tests/conftest.py)
+    platforms = __import__("os").environ.get("JAX_PLATFORMS")
+    if platforms:
+        import jax
+
+        jax.config.update("jax_platforms", platforms)
+    server = serve(args.bind, device=args.device, batch_size=args.batch_size)
+    host, port = server.server_address[:2]
+    print(f"worker listening on {host}:{port}", flush=True)
+    from datafusion_tpu.native import native_available
+
+    print(
+        f"worker info: native={native_available()} device={args.device} "
+        f"batch_size={args.batch_size}",
+        flush=True,
+    )
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0

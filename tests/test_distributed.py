@@ -1,0 +1,186 @@
+"""Multi-process distributed execution: coordinator + worker nodes.
+
+Spawns real `python -m datafusion_tpu.worker` OS processes (the worker
+entry point the reference planned but never built, `Cargo.toml:25-27`)
+and runs partitioned queries across >= 2 of them over the TCP
+fragment-shipping protocol, asserting exact agreement with the
+single-process engine on identical inputs.  Also exercises the
+failure path: a killed worker's fragments reassign to the survivors.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.materialize import collect
+from datafusion_tpu.parallel.coordinator import DistributedContext
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = Schema(
+    [
+        Field("region", DataType.UTF8, False),
+        Field("city", DataType.UTF8, True),
+        Field("v", DataType.INT64, False),
+        Field("x", DataType.FLOAT64, True),
+    ]
+)
+
+
+def _write_partitions(tmp_path, n_parts=4, rows_per=500):
+    rng = np.random.default_rng(17)
+    regions = ["north", "south", "east", "west", "über"]  # unicode too
+    cities = [f"city{i}" for i in range(40)]
+    paths = []
+    for p in range(n_parts):
+        path = tmp_path / f"part{p}.csv"
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("region,city,v,x\n")
+            for _ in range(rows_per):
+                r = regions[rng.integers(0, len(regions))]
+                c = cities[rng.integers(0, len(cities))] if rng.random() > 0.05 else ""
+                v = int(rng.integers(-1000, 1000))
+                x = "" if rng.random() < 0.1 else f"{rng.uniform(-5, 5):.6f}"
+                f.write(f"{r},{c},{v},{x}\n")
+        paths.append(str(path))
+    return paths
+
+
+@pytest.fixture(scope="module")
+def workers():
+    """Two worker OS processes on ephemeral ports."""
+    procs = []
+    addrs = []
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    for _ in range(2):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "datafusion_tpu.worker",
+             "--bind", "127.0.0.1:0", "--device", "cpu"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        line = proc.stdout.readline()  # "worker listening on host:port"
+        assert "listening on" in line, line
+        host_port = line.strip().rsplit(" ", 1)[1]
+        host, port = host_port.rsplit(":", 1)
+        procs.append(proc)
+        addrs.append((host, int(port)))
+    yield procs, addrs
+    for p in procs:
+        p.terminate()
+    for p in procs:
+        p.wait(timeout=10)
+
+
+def _contexts(addrs, paths):
+    dctx = DistributedContext(addrs)
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+    pds = PartitionedDataSource(
+        [CsvDataSource(p, SCHEMA, True, 131072) for p in paths]
+    )
+    dctx.register_datasource("t", pds)
+
+    lctx = ExecutionContext(device="cpu")
+    lctx.register_datasource(
+        "t",
+        PartitionedDataSource([CsvDataSource(p, SCHEMA, True, 131072) for p in paths]),
+    )
+    return dctx, lctx
+
+
+def _rows(ctx, sql):
+    def key(row):
+        return tuple((v is None, 0 if v is None else v) for v in row)
+
+    return sorted(collect(ctx.sql(sql)).to_rows(), key=key)
+
+
+class TestDistributedAggregate:
+    def test_grouped_aggregate_matches_local(self, tmp_path, workers):
+        _, addrs = workers
+        paths = _write_partitions(tmp_path)
+        dctx, lctx = _contexts(addrs, paths)
+        sql = (
+            "SELECT region, SUM(v), COUNT(1), AVG(x), MIN(v), MAX(v), "
+            "MIN(city), MAX(city) FROM t GROUP BY region"
+        )
+        got = _rows(dctx, sql)
+        want = _rows(lctx, sql)
+        assert len(got) == len(want)
+        for g, w in zip(got, want):
+            assert g[:3] == w[:3]
+            np.testing.assert_allclose(float(g[3]), float(w[3]), rtol=1e-12)
+            assert g[4:] == w[4:]
+
+    def test_filtered_global_aggregate(self, tmp_path, workers):
+        _, addrs = workers
+        paths = _write_partitions(tmp_path, n_parts=3)
+        dctx, lctx = _contexts(addrs, paths)
+        sql = "SELECT COUNT(1), SUM(v), MIN(x) FROM t WHERE v > 0"
+        assert _rows(dctx, sql) == _rows(lctx, sql)
+
+    def test_distributed_filter_projection_rows(self, tmp_path, workers):
+        _, addrs = workers
+        paths = _write_partitions(tmp_path)
+        dctx, lctx = _contexts(addrs, paths)
+        sql = "SELECT region, v + 1, x FROM t WHERE v > 900"
+        assert _rows(dctx, sql) == _rows(lctx, sql)
+
+    def test_ping_and_failover(self, tmp_path, workers):
+        procs, addrs = workers
+        paths = _write_partitions(tmp_path, n_parts=2)
+        # one dead endpoint + two live workers: fragments reassign
+        dead = ("127.0.0.1", 1)  # port 1: connection refused
+        dctx = DistributedContext([dead, *addrs])
+        from datafusion_tpu.exec.datasource import CsvDataSource
+        from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+        dctx.register_datasource(
+            "t",
+            PartitionedDataSource(
+                [CsvDataSource(p, SCHEMA, True, 131072) for p in paths]
+            ),
+        )
+        health = dctx.ping_workers()
+        assert health[f"{dead[0]}:{dead[1]}"] is False
+        assert sum(health.values()) == 2
+
+        _, lctx = _contexts(addrs, paths)
+        sql = "SELECT region, SUM(v) FROM t GROUP BY region"
+        assert _rows(dctx, sql) == _rows(lctx, sql)
+
+    def test_all_workers_down(self, tmp_path):
+        from datafusion_tpu.errors import ExecutionError
+        from datafusion_tpu.exec.datasource import CsvDataSource
+        from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+        paths = _write_partitions(tmp_path, n_parts=1, rows_per=10)
+        dctx = DistributedContext([("127.0.0.1", 1)])
+        dctx.register_datasource(
+            "t",
+            PartitionedDataSource(
+                [CsvDataSource(p, SCHEMA, True, 131072) for p in paths]
+            ),
+        )
+        with pytest.raises(ExecutionError, match="workers"):
+            collect(dctx.sql("SELECT region, SUM(v) FROM t GROUP BY region"))
+
+    def test_global_string_minmax(self, tmp_path, workers):
+        # ungrouped Utf8 MIN/MAX: the single-group best-string merge
+        _, addrs = workers
+        paths = _write_partitions(tmp_path, n_parts=3)
+        dctx, lctx = _contexts(addrs, paths)
+        sql = "SELECT MIN(region), MAX(region), MIN(city), MAX(city) FROM t"
+        assert _rows(dctx, sql) == _rows(lctx, sql)
