@@ -129,6 +129,8 @@ class GroupKeyEncoder:
         semantics): each key contributes (value-with-nulls-zeroed,
         isnull flag) to the group tuple.
         """
+        if key_cols and len(key_cols[0]) == 0:
+            return np.empty(0, dtype=np.int32)  # _pack can't reduce empty
         rows = []
         for c, v in zip(key_cols, key_valids):
             c = self._to_int64(np.asarray(c))

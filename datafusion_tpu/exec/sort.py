@@ -527,7 +527,10 @@ class LimitRelation(Relation):
         remaining = self.limit
         if remaining <= 0:
             return
-        for batch in iter_with_mask_prefetch(self.child.batches()):
+        # NO mask prefetch here: the early return below exists to avoid
+        # pulling (parsing, dispatching) any batch past the limit, and a
+        # one-ahead prefetch would defeat exactly that
+        for batch in self.child.batches():
             cols, valids, dicts, n = compact_batch(batch)
             if n == 0:
                 continue

@@ -84,7 +84,9 @@ class WorkerHandle:
     def ping(self) -> bool:
         try:
             self.alive = self.request({"type": "ping"}, timeout=5.0)["type"] == "pong"
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, ExecutionError):
+            # unreachable, wedged past the probe deadline, or erroring:
+            # all report as not-healthy rather than crashing the probe
             self.alive = False
         return self.alive
 
@@ -221,6 +223,8 @@ class DistributedAggregateRelation(Relation):
 
         for resp in responses:
             g = resp["num_groups"]
+            if g == 0:
+                continue  # empty partition: nothing to merge
             w_counts = dec_array(resp["counts"])
             w_slots = [dec_array(s) for s in resp["slots"]]
             if global_agg:

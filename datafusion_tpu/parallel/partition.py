@@ -205,7 +205,9 @@ class PartitionedAggregateRelation(AggregateRelation):
 
         # per-round update: every input and the state carry a leading
         # shard axis; each device runs the single-device kernel on its
-        # slice.  donate the state buffer (it is strictly carried).
+        # slice.  NOT donated: device_call may replay the dispatch on a
+        # transient failure, and a donated state buffer would already
+        # be consumed by the failed attempt.
         self._stacked_jit = jax.jit(
             shard_map(
                 self._stacked_update,
@@ -213,7 +215,6 @@ class PartitionedAggregateRelation(AggregateRelation):
                 in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh, spec_sh, spec_sh),
                 out_specs=spec_sh,
             ),
-            donate_argnums=(6,),
         )
         self._combine_jit = jax.jit(
             shard_map(

@@ -52,7 +52,10 @@ def recv_msg(sock: socket.socket) -> Optional[dict]:
         raise ExecutionError(f"frame of {n} bytes exceeds protocol limit")
     data = _recv_exact(sock, n)
     if data is None:
-        raise ExecutionError("connection closed mid-frame")
+        # ConnectionError (not ExecutionError): a peer dying mid-frame
+        # is a transport failure, and the coordinator's failover
+        # handler keys on ConnectionError/OSError
+        raise ConnectionError("connection closed mid-frame")
     return json.loads(data.decode("utf-8"))
 
 

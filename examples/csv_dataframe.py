@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""DataFrame-API twin of csv_sql.py (the reference's release script
+expected a `csv_dataframe` example that never existed in its snapshot,
+`scripts/release.sh:17` / `scripts/circle/build-examples.sh:8-9`)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from datafusion_tpu import DataType, ExecutionContext, Field, Schema, lit
+
+DATA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "test", "data"
+)
+
+
+def main():
+    ctx = ExecutionContext()
+    schema = Schema(
+        [
+            Field("city", DataType.UTF8, False),
+            Field("lat", DataType.FLOAT64, False),
+            Field("lng", DataType.FLOAT64, False),
+        ]
+    )
+    ctx.register_csv("cities", os.path.join(DATA, "uk_cities.csv"), schema,
+                     has_header=False)
+
+    cities = ctx.table("cities")
+    lat, lng = cities["lat"], cities["lng"]
+    df = (
+        cities
+        .filter(lat.gt(lit(51.0)).and_(lat.lt(lit(53.0))))
+        .select("city", lat, lng, lat + lng)
+    )
+    table = df.collect()
+    for city, lat, lng, summed in table.to_rows():
+        print(f"City: {city}, Latitude: {lat}, Longitude: {lng}, Sum: {summed}")
+    assert table.num_rows == 18, f"expected 18 rows, got {table.num_rows}"
+
+
+if __name__ == "__main__":
+    main()

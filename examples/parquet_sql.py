@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Parquet + aggregate example (the reference declared Parquet in DDL
+but never implemented a reader, `README.md:22`; its release script
+expected a `parquet_sql` example, `scripts/release.sh:19`)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from datafusion_tpu import ExecutionContext
+
+DATA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "test", "data"
+)
+
+
+def main():
+    ctx = ExecutionContext()
+    # schema inferred from parquet file metadata
+    ctx.register_parquet("cities", os.path.join(DATA, "uk_cities.parquet"))
+    table = ctx.sql_collect(
+        "SELECT COUNT(1), MIN(lat), MAX(lat), AVG(lng) FROM cities WHERE lat > 52"
+    )
+    (count, lo, hi, avg_lng) = table.to_rows()[0]
+    print(f"{count} cities north of 52: lat range [{lo}, {hi}], mean lng {avg_lng}")
+    assert count > 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# One-command smoketest (mirror of the reference's
+# scripts/smoketest.sh:15-23,68-89: tests + example + golden console
+# diff with `diff -bBZ -I seconds`).  Runs hermetically on the CPU
+# backend; pass SMOKETEST_DEVICE=tpu to exercise an attached chip.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+test_dir="$(mktemp -d)"
+trap 'echo "CLEANUP: Removing ${test_dir}"; rm -rf "${test_dir}"' EXIT
+
+export JAX_PLATFORMS="${SMOKETEST_DEVICE:-cpu}"
+if [ "$JAX_PLATFORMS" = "cpu" ]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+echo "== native build =="
+make -C native
+
+echo "== unit tests (8-device CPU mesh) =="
+python -m pytest tests/ -q
+
+echo "== example (reference csv_sql.rs workload) =="
+python examples/csv_sql.py > "${test_dir}/example_output.txt"
+grep -q "City: " "${test_dir}/example_output.txt"
+
+echo "== golden console smoketest =="
+# fixtures were mounted at /test/data in the reference's docker
+# harness; rewrite to this checkout (smoketest.sh:68-83)
+sed "s#'/test/data/#'$(pwd)/test/data/#" test/data/smoketest.sql \
+  > "${test_dir}/smoketest.sql"
+python -m datafusion_tpu.cli --script "${test_dir}/smoketest.sql" \
+  > "${test_dir}/smoketest_output.txt"
+diff -bBZ -I seconds "${test_dir}/smoketest_output.txt" \
+  test/data/smoketest-expected.txt
+
+echo "SMOKETEST PASSED"

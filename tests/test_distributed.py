@@ -184,3 +184,14 @@ class TestDistributedAggregate:
         dctx, lctx = _contexts(addrs, paths)
         sql = "SELECT MIN(region), MAX(region), MIN(city), MAX(city) FROM t"
         assert _rows(dctx, sql) == _rows(lctx, sql)
+
+    def test_empty_partition(self, tmp_path, workers):
+        # a header-only partition returns zero groups; the merge skips it
+        _, addrs = workers
+        paths = _write_partitions(tmp_path, n_parts=2)
+        empty = tmp_path / "empty.csv"
+        empty.write_text("region,city,v,x\n")
+        paths.append(str(empty))
+        dctx, lctx = _contexts(addrs, paths)
+        sql = "SELECT region, SUM(v), MIN(city) FROM t GROUP BY region"
+        assert _rows(dctx, sql) == _rows(lctx, sql)
