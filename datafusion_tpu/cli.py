@@ -60,20 +60,41 @@ def make_context(device: Optional[str] = None, batch_size: int = 131072):
 
 
 class Console:
-    """Statement executor (reference `Console`, main.rs:113-153)."""
+    """Statement executor (reference `Console`, main.rs:113-153).
 
-    def __init__(self, ctx, out=None):
+    `\\timing` toggles a per-query engine-stage breakdown (parse / plan
+    / execute timers plus rows and H2D byte counters from
+    utils/metrics.py) after each result.
+    """
+
+    def __init__(self, ctx, out=None, timing: bool = False):
         self.ctx = ctx
         self.out = out if out is not None else sys.stdout
+        self.timing = timing
 
     def _print(self, *a):
         print(*a, file=self.out)
+
+    def handle_command(self, line: str) -> bool:
+        """Backslash console commands; True when `line` was one."""
+        cmd = line.strip().lower()
+        if cmd == "\\timing":
+            self.timing = not self.timing
+            self._print(f"Timing is {'on' if self.timing else 'off'}.")
+            return True
+        return False
 
     def execute(self, sql: str) -> None:
         sql = sql.strip().rstrip(";").strip()
         if not sql:
             return
+        if self.handle_command(sql):
+            return
         self._print("Executing query ...")
+        from datafusion_tpu.utils.metrics import METRICS
+
+        if self.timing:
+            METRICS.reset()
         t0 = time.perf_counter()
         try:
             result = self.ctx.sql_collect(sql)
@@ -90,6 +111,20 @@ class Console:
                 )
         # "seconds" keeps this line inside the golden diff's -I filter
         self._print(f"Query executed in {elapsed:.3f} seconds")
+        if self.timing:
+            snap = METRICS.snapshot()
+            stages = ", ".join(
+                f"{k}={v * 1e3:.1f}ms"
+                for k, v in sorted(snap["timings_s"].items())
+            )
+            counters = ", ".join(
+                f"{k}={v}" for k, v in sorted(snap["counts"].items())
+            )
+            # "seconds"-free lines would break the golden diff, but
+            # \timing is opt-in and the smoketest never enables it
+            self._print(f"Timing: {stages or 'no stages recorded'}")
+            if counters:
+                self._print(f"Counters: {counters}")
 
 
 def run_script(console: Console, path: str) -> None:
@@ -97,6 +132,8 @@ def run_script(console: Console, path: str) -> None:
     with open(path, "r", encoding="utf-8") as f:
         buf = ""
         for line in f:
+            if not buf.strip() and console.handle_command(line):
+                continue  # line command, outside statement splitting
             buf += line
             stmts, buf = split_statements_partial(buf)
             for stmt in stmts:
@@ -119,6 +156,10 @@ def run_interactive(console: Console) -> None:
             return
         if not buf and line.strip().lower() in ("quit", "exit"):
             return
+        if not buf and console.handle_command(line):
+            # backslash commands are line commands (psql convention) —
+            # they never reach the ';'-driven statement splitter
+            continue
         buf += line + "\n"
         stmts, buf = split_statements_partial(buf)
         for stmt in stmts:
@@ -140,10 +181,14 @@ def main(argv=None) -> int:
         "--device", default=None, help="execution device (cpu / tpu; default: auto)"
     )
     parser.add_argument("--batch-size", type=int, default=131072)
+    parser.add_argument(
+        "--timing", action="store_true",
+        help="print per-query engine stage timings (same as \\timing)",
+    )
     args = parser.parse_args(argv)
 
     print("DataFusion Console")
-    console = Console(make_context(args.device, args.batch_size))
+    console = Console(make_context(args.device, args.batch_size), timing=args.timing)
     if args.script:
         run_script(console, args.script)
     else:

@@ -16,6 +16,7 @@ import numpy as np
 
 from datafusion_tpu.datatypes import DataType, Schema
 from datafusion_tpu.errors import IoError
+from datafusion_tpu.utils.metrics import METRICS
 from datafusion_tpu.exec.batch import RecordBatch, StringDictionary, make_host_batch
 from datafusion_tpu.native import load_library
 
@@ -64,8 +65,6 @@ class NativeCsvReader:
         )
 
     def batches(self) -> Iterator[RecordBatch]:
-        from datafusion_tpu.utils.metrics import METRICS
-
         yield from METRICS.timed_iter("scan.parse", self._batches())
 
     def _batches(self) -> Iterator[RecordBatch]:
@@ -131,6 +130,7 @@ class NativeCsvReader:
                             arr[~valid] = 0
                     cols.append(arr)
                     valids.append(valid)
+                METRICS.add("scan.rows", int(n))
                 yield make_host_batch(self.out_schema, cols, valids, list(self.dicts))
         finally:
             lib.dtf_csv_close(handle)

@@ -212,7 +212,8 @@ class PartitionedAggregateRelation(AggregateRelation):
             shard_map(
                 self._stacked_update,
                 mesh=self.mesh,
-                in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh, spec_sh, spec_sh),
+                in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh, spec_sh,
+                          spec_sh, spec_rep),
                 out_specs=spec_sh,
             ),
         )
@@ -220,13 +221,14 @@ class PartitionedAggregateRelation(AggregateRelation):
             shard_map(
                 self._combine,
                 mesh=self.mesh,
-                in_specs=spec_sh,
+                in_specs=(spec_sh, spec_rep),
                 out_specs=spec_rep,
             )
         )
 
     # -- shard_map bodies (block shapes have leading axis 1) --
-    def _stacked_update(self, cols, valids, aux, num_rows, masks, ids, state):
+    def _stacked_update(self, cols, valids, aux, num_rows, masks, ids, state,
+                        str_aux):
         sq = lambda t: t[0]
         counts, accs = state
         local = (sq(counts), jax.tree.map(sq, accs))
@@ -238,26 +240,34 @@ class PartitionedAggregateRelation(AggregateRelation):
             sq(masks),
             sq(ids),
             local,
+            str_aux,
         )
         ex = lambda t: t[None]
         oc, oa = out
         return ex(oc), jax.tree.map(ex, oa)
 
-    def _combine(self, state):
+    def _combine(self, state, str_aux):
         counts, accs = state
         fin_counts = lax.psum(counts, MESH_AXIS)[0]
         fin_accs = []
-        for sl, acc in zip(self.slots, accs):
+        for i, (sl, acc) in enumerate(zip(self.slots, accs)):
             if sl.kind in ("sum", "cnt"):
                 fin_accs.append(lax.psum(acc, MESH_AXIS)[0])
             elif sl.kind == "min":
                 fin_accs.append(lax.pmin(acc, MESH_AXIS)[0])
             elif sl.kind == "max":
                 fin_accs.append(lax.pmax(acc, MESH_AXIS)[0])
-            else:  # smin/smax: excluded by _match_partitioned_aggregate
-                raise ExecutionError(
-                    "string min/max is not wired into the mesh combine"
-                )
+            else:
+                # Utf8 MIN/MAX: partitions share dictionaries in mesh
+                # mode (_share_dictionaries), so codes are globally
+                # consistent — meet in lexicographic-rank space, then
+                # map the winning rank back to its code
+                ranks = self._codes_to_ranks(sl.kind, acc[0], str_aux[i])
+                if sl.kind == "smin":
+                    best = lax.pmin(ranks, MESH_AXIS)
+                else:
+                    best = lax.pmax(ranks, MESH_AXIS)
+                fin_accs.append(self._ranks_to_codes(sl.kind, best, str_aux[i]))
         return fin_counts, tuple(fin_accs)
 
     # -- stacked state management --
@@ -345,13 +355,15 @@ class PartitionedAggregateRelation(AggregateRelation):
                 state = self._grow_stacked_state(state, needed)
                 group_cap = needed
 
-            # aux tables derive from the (shared) dictionaries; compute
-            # after all shards' rows are encoded so versions are current
+            # aux / rank tables derive from the (shared) dictionaries;
+            # compute after all shards' rows are encoded so versions are
+            # current
             aux = (
                 compute_aux_values(self._aux_specs, live_batch, self._aux_cache)
                 if self._aux_specs
                 else []
             )
+            str_aux = self._compute_str_aux(live_batch)
             with METRICS.timer("execute.partitioned_aggregate"):
                 state = device_call(
                     self._stacked_jit,
@@ -362,12 +374,21 @@ class PartitionedAggregateRelation(AggregateRelation):
                     jnp.asarray(masks_np),
                     jnp.asarray(ids_np),
                     state,
+                    str_aux,
                 )
 
         if state is None:
             state = self._init_stacked_state(group_capacity(1))
+            # no rounds ran: dummy 1-entry rank tables (every slot is
+            # the -1 empty code, which maps sentinel -> -1 regardless)
+            dummy = (np.zeros(1, np.int32), np.zeros(1, np.int32))
+            str_aux = tuple(
+                dummy if sl.is_string else None for sl in self.slots
+            )
         with METRICS.timer("execute.collective_combine"):
-            return device_call(self._combine_jit, state)
+            # codes are append-only, so the final round's rank tables
+            # cover every code any earlier round accumulated
+            return device_call(self._combine_jit, state, str_aux)
 
 
 class PartitionedContext(ExecutionContext):
@@ -460,18 +481,4 @@ def _match_partitioned_aggregate(plan: LogicalPlan, datasources: dict):
     ds = datasources.get(inner.table_name)
     if not isinstance(ds, PartitionedDataSource):
         return None, None, None
-    from datafusion_tpu.datatypes import DataType
-    from datafusion_tpu.plan.expr import AggregateFunction, Column as _Col
-
-    for a in plan.aggr_expr:
-        # MIN/MAX over Utf8 needs rank-table aux in the collective
-        # combine; not wired into the mesh path yet — run the (still
-        # correct) union-scan single-device aggregate instead
-        if (
-            isinstance(a, AggregateFunction)
-            and a.name.lower() in ("min", "max")
-            and isinstance(a.args[0], _Col)
-            and inner.schema.field(a.args[0].index).data_type == DataType.UTF8
-        ):
-            return None, None, None
     return plan, pred, inner

@@ -161,3 +161,52 @@ class TestStatementSplitting:
         lines = _run_sql_text("SELECT 1 + 1;\n-- trailing comment\n", tmp_path)
         assert lines.count("Executing query ...") == 1
         assert not any(l.startswith("Error") for l in lines)
+
+
+class TestTimingMode:
+    def test_timing_toggle_and_output(self, tmp_path):
+        import io
+
+        from datafusion_tpu.cli import Console, make_context
+
+        out = io.StringIO()
+        csv = tmp_path / "t.csv"
+        csv.write_text("a,b\n1,2.5\n3,4.5\n")
+        c = Console(make_context("cpu"), out=out)
+        c.execute("\\timing")
+        c.execute(
+            f"CREATE EXTERNAL TABLE t (a INT, b DOUBLE) STORED AS CSV "
+            f"WITH HEADER ROW LOCATION '{csv}'"
+        )
+        c.execute("SELECT a, b FROM t WHERE a > 0")
+        text = out.getvalue()
+        assert "Timing is on." in text
+        assert "Timing: " in text
+        assert "parse=" in text
+        assert "Counters: " in text and "scan.rows=2" in text
+        c.execute("\\timing")
+        assert "Timing is off." in out.getvalue()
+
+    def test_timing_as_bare_script_line(self, tmp_path):
+        # psql convention: a backslash command is a LINE, no semicolon —
+        # it must not fall into the statement splitter
+        import io
+
+        from datafusion_tpu.cli import Console, make_context, run_script
+
+        csv = tmp_path / "t.csv"
+        csv.write_text("a\n1\n")
+        script = tmp_path / "s.sql"
+        script.write_text(
+            "\\timing\n"
+            f"CREATE EXTERNAL TABLE t (a INT) STORED AS CSV WITH HEADER ROW "
+            f"LOCATION '{csv}';\n"
+            "SELECT a FROM t;\n"
+        )
+        out = io.StringIO()
+        c = Console(make_context("cpu"), out=out)
+        run_script(c, str(script))
+        text = out.getvalue()
+        assert "Timing is on." in text
+        assert "Error" not in text
+        assert "Timing: " in text
