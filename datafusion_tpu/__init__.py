@@ -21,6 +21,27 @@ from jax import config as _jax_config
 
 _jax_config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: a query engine re-creates identical
+# kernels (same plan shape, schema, bucketed batch size) across
+# processes and sessions; caching compiled executables on disk makes
+# every kernel a one-time cost.  Especially material on tunneled
+# devices whose remote compile service charges seconds per kernel.
+# Opt out with DATAFUSION_TPU_COMPILE_CACHE=0 or point it elsewhere.
+import os as _os
+
+_cache_dir = _os.environ.get("DATAFUSION_TPU_COMPILE_CACHE")
+if _cache_dir != "0":
+    if not _cache_dir:
+        _cache_dir = _os.path.join(
+            _os.path.expanduser("~"), ".cache", "datafusion_tpu", "xla"
+        )
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax_config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax_config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except (OSError, AttributeError):  # pragma: no cover - config drift
+        pass
+
 from datafusion_tpu.errors import (
     DataFusionError,
     ExecutionError,

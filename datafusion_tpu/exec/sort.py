@@ -135,6 +135,12 @@ class SortRelation(Relation):
             else:
                 kind = "f"
             self._key_plans.append(_KeyPlan(idx, kind, se.asc, None))
+        # TopK state capacity bucketed to a power of two (floor 128):
+        # every LIMIT in a bucket shares one compiled kernel per batch
+        # shape — compiles are the expensive resource on remote devices
+        self._kb = 128
+        while limit is not None and self._kb < min(limit, TOPK_MAX):
+            self._kb <<= 1
         self._topk_jit = jax.jit(self._topk_kernel, static_argnums=(0,))
 
     @property
@@ -184,8 +190,11 @@ class SortRelation(Relation):
         """Merge one batch into the carried top-k state.
 
         state = (keys..., col values..., col validity bits) each length
-        k; returns the same structure.  One multi-key sort of
-        [k + capacity] rows per batch.
+        k; returns the same structure.  The sort carries ONLY the key
+        operands plus a permutation iota — value columns are gathered
+        by the winning indices afterwards.  (Sorting every payload
+        column along, as an n-operand `lax.sort`, made XLA:TPU build a
+        monstrous sort computation: compile times in the minutes.)
         """
         capacity = cols[0].shape[0]
         row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
@@ -198,24 +207,24 @@ class SortRelation(Relation):
         for sk, bk in zip(skeys, bkeys):
             ops.append(jnp.concatenate([sk, bk.astype(sk.dtype)]))
         live_col = jnp.concatenate([slive, row_mask])
-        # tiebreak: among equal (sentinel) keys, real rows beat padding —
-        # NULL-key rows share the sentinel with empty state slots and
-        # must still fill a LIMIT larger than the non-null count
-        ops.append((~live_col).astype(jnp.int32))
+        # tiebreak: among equal (dead) keys, real rows beat padding —
+        # NULL-key rows tie with empty state slots and must still fill
+        # a LIMIT larger than the non-null count
+        ops.append(~live_col)
         n_keys = len(ops)
-        ops.append(live_col)
-        for sv, c in zip(svals, cols):
-            ops.append(jnp.concatenate([sv, c]))
-        for sb, v in zip(svalid, valids):
-            bv = row_mask if v is None else (v & row_mask)
-            ops.append(jnp.concatenate([sb, bv]))
+        ops.append(jnp.arange(k + capacity, dtype=jnp.int32))  # permutation
         out = lax.sort(tuple(ops), num_keys=n_keys, is_stable=True)
-        new_keys = tuple(o[:k] for o in out[: len(skeys)])  # drop tiebreak col
-        new_live = out[n_keys][:k]
+        perm = out[n_keys][:k]
+
+        new_keys = tuple(o[:k] for o in out[:n_keys - 1])  # drop tiebreak
+        new_live = live_col[perm]
         new_vals = tuple(
-            o[:k] for o in out[n_keys + 1 : n_keys + 1 + len(svals)]
+            jnp.concatenate([sv, c])[perm] for sv, c in zip(svals, cols)
         )
-        new_valid = tuple(o[:k] for o in out[n_keys + 1 + len(svals) :])
+        new_valid = tuple(
+            jnp.concatenate([sb, row_mask if v is None else (v & row_mask)])[perm]
+            for sb, v in zip(svalid, valids)
+        )
         return new_keys, new_live, new_vals, new_valid
 
     def _topk_init(self, k, in_schema):
@@ -235,7 +244,7 @@ class SortRelation(Relation):
     def _topk_batches(self) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.batch import device_inputs
 
-        k = self.limit
+        k = self._kb  # bucketed state size; self.limit rows come out
         in_schema = self.child.schema
         state = None
         dicts = [None] * len(in_schema)
@@ -276,9 +285,10 @@ class SortRelation(Relation):
         for leaf in jax.tree.leaves((live, vals, valid)):
             if hasattr(leaf, "copy_to_host_async"):
                 leaf.copy_to_host_async()
-        # the live bit separates real rows from sentinel padding when
-        # the scan produced fewer than k rows
-        take = np.nonzero(np.asarray(live))[0]
+        # the live bit separates real rows from dead-key padding when
+        # the scan produced fewer than k rows; the state is bucket-sized,
+        # so slice down to the actual LIMIT
+        take = np.nonzero(np.asarray(live))[0][: self.limit]
         out_cols = [np.asarray(c)[take] for c in vals]
         out_valid = []
         for i in range(len(in_schema)):
