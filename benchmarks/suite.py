@@ -188,7 +188,9 @@ def config3_tpch_q1(device_kind: str):
     rows = int(bdata.LINEITEM_ROWS_PER_SF * sf)
 
     def cold(device):
-        ctx = ExecutionContext(device=device)
+        # 512k-row batches: fewer, larger dispatches amortize per-batch
+        # link overhead (same setting for the CPU baseline)
+        ctx = ExecutionContext(device=device, batch_size=1 << 19)
         ctx.register_parquet("lineitem", path)
         return collect(ctx.sql(Q1))
 
@@ -228,7 +230,7 @@ def config3_tpch_q1(device_kind: str):
 
     # warm: the same rows resident in memory (and after warm-up, on
     # device) — steady-state re-query throughput
-    ctx = ExecutionContext(device="cpu")
+    ctx = ExecutionContext(device="cpu", batch_size=1 << 19)
     ctx.register_parquet("lineitem", path)
     scan_src = ctx.datasources["lineitem"]
     batches = list(scan_src.batches())

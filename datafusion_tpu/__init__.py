@@ -30,7 +30,10 @@ _jax_config.update("jax_enable_x64", True)
 import os as _os
 
 _cache_dir = _os.environ.get("DATAFUSION_TPU_COMPILE_CACHE")
-if _cache_dir != "0":
+if _cache_dir != "0" and not _os.environ.get("JAX_COMPILATION_CACHE_DIR") and (
+    getattr(_jax_config, "jax_compilation_cache_dir", None) in (None, "")
+):
+    # only when the user hasn't configured a cache themselves
     if not _cache_dir:
         _cache_dir = _os.path.join(
             _os.path.expanduser("~"), ".cache", "datafusion_tpu", "xla"
@@ -38,7 +41,8 @@ if _cache_dir != "0":
     try:
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax_config.update("jax_compilation_cache_dir", _cache_dir)
-        _jax_config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        if not _os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
+            _jax_config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except (OSError, AttributeError):  # pragma: no cover - config drift
         pass
 

@@ -73,14 +73,42 @@ class AuxSpec:
 
 
 class Env:
-    """Runtime environment a compiled node reads from (all jax values)."""
+    """Runtime environment a compiled node reads from (all jax values).
 
-    __slots__ = ("cols", "valids", "aux")
+    `col_map` optionally translates schema column indices to positions
+    in `cols`/`valids`, so callers can ship only the columns a kernel
+    actually reads (H2D bytes are the scarce resource on remote links).
+    """
 
-    def __init__(self, cols, valids, aux):
-        self.cols = cols
-        self.valids = valids
+    __slots__ = ("_cols", "_valids", "aux", "_map")
+
+    def __init__(self, cols, valids, aux, col_map=None):
+        self._cols = cols
+        self._valids = valids
         self.aux = aux
+        self._map = col_map
+
+    @property
+    def cols(self):
+        return self if self._map is not None else self._cols
+
+    @property
+    def valids(self):
+        return _Indexer(self._valids, self._map) if self._map is not None else self._valids
+
+    def __getitem__(self, i):  # self.cols[i] with a col_map active
+        return self._cols[self._map[i]]
+
+
+class _Indexer:
+    __slots__ = ("_seq", "_map")
+
+    def __init__(self, seq, col_map):
+        self._seq = seq
+        self._map = col_map
+
+    def __getitem__(self, i):
+        return self._seq[self._map[i]]
 
 
 def _and_valid(a, b):

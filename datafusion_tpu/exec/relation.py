@@ -96,8 +96,14 @@ class PipelineRelation(Relation):
             )
         self._pred_fn = compiler.compile(predicate) if predicate is not None else None
         # projections containing host-only functions (string/struct
-        # producers) are evaluated post-kernel against the input batch
+        # producers) are evaluated post-kernel against the input batch;
+        # bare column references bypass the kernel entirely — the host
+        # array passes through untouched.  That keeps Float64 columns
+        # EXACT on TPU (f64 is emulated there: even an identity kernel
+        # round-trip perturbs values by ~1e-14) and removes their D2H
+        # transfer — only computed columns and the mask cross the link.
         self._host_proj: dict[int, Expr] = {}
+        self._identity_proj: dict[int, int] = {}
         self._host_dicts: dict[int, "StringDictionary"] = {}
         self._proj_fns = None
         if projections is not None:
@@ -105,6 +111,9 @@ class PipelineRelation(Relation):
             for j, e in enumerate(projections):
                 if contains_host_fn(e, self._metas):
                     self._host_proj[j] = e
+                    self._proj_fns.append(None)
+                elif isinstance(e, Column):
+                    self._identity_proj[j] = e.index
                     self._proj_fns.append(None)
                 else:
                     self._proj_fns.append(compiler.compile(e))
@@ -122,6 +131,29 @@ class PipelineRelation(Relation):
                 else:
                     self._out_dict_sources.append(None)
 
+        # no predicate and nothing to compute on device => the batch
+        # never touches the device at all (pure column selection)
+        self._needs_kernel = self._pred_fn is not None or (
+            self._proj_fns is not None
+            and any(f is not None for f in self._proj_fns)
+        )
+        # ship only the columns the kernel actually reads (jit transfers
+        # every argument, used or not — H2D bytes are the scarce
+        # resource on remote links); Env's col_map translates schema
+        # indices to subset positions
+        used: set[int] = set()
+        if predicate is not None:
+            predicate.collect_columns(used)
+        if projections is not None:
+            for j, e in enumerate(projections):
+                if j in self._identity_proj or j in self._host_proj:
+                    continue
+                e.collect_columns(used)
+        if self._needs_kernel and not used and len(in_schema):
+            used.add(0)  # constant predicate: one column carries capacity
+        self._used_cols = sorted(used)
+        self._col_map = {c: i for i, c in enumerate(self._used_cols)}
+        self._sub_schema = in_schema.select(self._used_cols)
         self._jit = jax.jit(self._kernel)
 
     @property
@@ -129,7 +161,7 @@ class PipelineRelation(Relation):
         return self._schema
 
     def _kernel(self, cols, valids, aux, num_rows, base_mask):
-        env = Env(cols, valids, aux)
+        env = Env(cols, valids, aux, self._col_map)
         if cols:
             capacity = cols[0].shape[0]
         elif base_mask is not None:
@@ -149,10 +181,12 @@ class PipelineRelation(Relation):
                 pv = pv & jnp.broadcast_to(pvalid, (capacity,))
             mask = mask & pv
         if self._proj_fns is None:
-            return list(cols), list(valids), mask
+            # filter-only: columns pass through on the host; the kernel
+            # produces just the selection mask
+            return [], [], mask
         out_cols, out_valids = [], []
         for f in self._proj_fns:
-            if f is None:  # host-evaluated projection: filled in post-kernel
+            if f is None:  # host-evaluated or identity: filled in later
                 continue
             v, valid = f(env)
             out_cols.append(jnp.broadcast_to(v, (capacity,)))
@@ -165,26 +199,31 @@ class PipelineRelation(Relation):
         from datafusion_tpu.exec.batch import device_inputs
 
         for batch in self.child.batches():
-            aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
-            with METRICS.timer("execute.pipeline"), device_scope(self.device):
-                data, validity, mask_in = device_inputs(batch, self.device)
-                cols, valids, mask = device_call(
-                    self._jit,
-                    data,
-                    validity,
-                    tuple(aux),
-                    np.int32(batch.num_rows),
-                    mask_in,
-                )
+            if not self._needs_kernel:
+                cols, valids, mask = [], [], batch.mask
+            else:
+                aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
+                with METRICS.timer("execute.pipeline"), device_scope(self.device):
+                    data, validity, mask_in = device_inputs(
+                        self._subset_view(batch), self.device
+                    )
+                    cols, valids, mask = device_call(
+                        self._jit,
+                        data,
+                        validity,
+                        tuple(aux),
+                        np.int32(batch.num_rows),
+                        mask_in,
+                    )
             if self._proj_fns is None:
-                dicts = batch.dicts
+                # filter-only: the input columns, untouched
+                cols, valids, dicts = batch.data, batch.validity, batch.dicts
             else:
                 dicts = [
                     batch.dicts[src] if src is not None else None
                     for src in self._out_dict_sources
                 ]
-            if self._host_proj:
-                cols, valids, dicts = self._merge_host_projections(
+                cols, valids, dicts = self._assemble_outputs(
                     batch, list(cols), list(valids), list(dicts)
                 )
             yield RecordBatch(
@@ -196,15 +235,41 @@ class PipelineRelation(Relation):
                 mask=mask,
             )
 
-    def _merge_host_projections(self, batch, dev_cols, dev_valids, dicts):
-        """Interleave post-kernel host-evaluated projections (string /
-        struct producers) with the device kernel's outputs."""
+    def _subset_view(self, batch) -> RecordBatch:
+        """A view batch holding only the kernel's input columns, cached
+        on the parent so device copies survive re-scans of in-memory
+        sources (device_inputs caches on the view)."""
+        if len(self._used_cols) == batch.num_columns:
+            return batch
+        key = ("subset_view", tuple(self._used_cols))
+        view = batch.cache.get(key)
+        if view is None:
+            view = RecordBatch(
+                self._sub_schema,
+                [batch.data[c] for c in self._used_cols],
+                [batch.validity[c] for c in self._used_cols],
+                [batch.dicts[c] for c in self._used_cols],
+                num_rows=batch.num_rows,
+                mask=batch.mask,
+            )
+            batch.cache[key] = view
+        return view
+
+    def _assemble_outputs(self, batch, dev_cols, dev_valids, dicts):
+        """Interleave identity passthroughs (the input arrays, exact)
+        and post-kernel host-evaluated projections (string / struct
+        producers) with the device kernel's computed outputs."""
         from datafusion_tpu.exec.batch import StringDictionary
         from datafusion_tpu.exec.hostfn import eval_host_expr
 
         cols, valids = [], []
         dev_i = 0
         for j in range(len(self.projections)):
+            src = self._identity_proj.get(j)
+            if src is not None:
+                cols.append(batch.data[src])
+                valids.append(batch.validity[src])
+                continue
             host_expr = self._host_proj.get(j)
             if host_expr is None:
                 cols.append(dev_cols[dev_i])

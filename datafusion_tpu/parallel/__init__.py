@@ -14,8 +14,10 @@ The TPU-native equivalent implemented here:
 - partials combine with XLA collectives (`psum`/`pmin`/`pmax`) riding
   ICI — replacing Arrow-IPC-over-HTTP result exchange;
 - plan fragments still travel as the JSON wire format the reference
-  intended (`PlanFragment`), which is what a multi-host deployment
-  ships over DCN after `jax.distributed.initialize`.
+  intended (`PlanFragment`), which is what the multi-host mode ships:
+  `DistributedContext` sends fragments over TCP to worker processes
+  (`python -m datafusion_tpu.worker`) and merges their partial
+  aggregate states by key (coordinator.py).
 """
 
 from datafusion_tpu.parallel.mesh import make_mesh, mesh_axis, initialize_distributed
@@ -25,6 +27,7 @@ from datafusion_tpu.parallel.partition import (
     PartitionedDataSource,
     PartitionedAggregateRelation,
 )
+from datafusion_tpu.parallel.coordinator import DistributedContext, WorkerHandle
 
 __all__ = [
     "make_mesh",
@@ -35,4 +38,6 @@ __all__ = [
     "PartitionedContext",
     "PartitionedDataSource",
     "PartitionedAggregateRelation",
+    "DistributedContext",
+    "WorkerHandle",
 ]

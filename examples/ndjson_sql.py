@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""NDJSON example (the reference declared NDJSON in its DDL,
+`dfparser.rs:33`, never implemented a reader, and its release script
+expected an `ndjson_sql` example, `scripts/release.sh:18`)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+
+DATA = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "test", "data"
+)
+
+
+def main():
+    ctx = ExecutionContext()
+    schema = Schema(
+        [
+            Field("a", DataType.INT64, True),
+            Field("b", DataType.UTF8, True),
+            Field("c", DataType.FLOAT64, True),
+        ]
+    )
+    ctx.register_ndjson("x", os.path.join(DATA, "example1.ndjson"), schema)
+    table = ctx.sql_collect("SELECT a, b, c FROM x WHERE a IS NOT NULL ORDER BY c DESC")
+    for row in table.to_rows():
+        print(row)
+    assert table.num_rows > 0
+
+
+if __name__ == "__main__":
+    main()

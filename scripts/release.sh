@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Release checklist (mirror of the reference's scripts/release.sh:1-34:
+# version from the manifest, clean-tree check, tests, every example —
+# minus crate/docker publishing, which has no equivalent here).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+VERSION=$(python -c "import datafusion_tpu; print(datafusion_tpu.__version__)")
+echo "Version: ${VERSION}"
+
+# make sure there are no uncommitted changes (release.sh:10)
+git diff-index --quiet HEAD --
+
+export JAX_PLATFORMS="${RELEASE_DEVICE:-cpu}"
+if [ "$JAX_PLATFORMS" = "cpu" ]; then
+  export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+fi
+
+make -C native
+./scripts/asan_check.sh
+python -m pytest tests/ -q
+
+# run every example (release.sh:13-20 — four of the five it listed
+# didn't exist in the reference snapshot; all of ours do)
+for ex in examples/*.py; do
+  echo "== ${ex} =="
+  python "${ex}" > /dev/null
+done
+
+echo "RELEASE CHECKS PASSED (tag with: git tag ${VERSION})"

@@ -490,3 +490,49 @@ class TestHighCardinalityGroupBy:
             s, cv, c1, mx = got[g]
             assert s == vals[m].sum() and cv == m.sum()
             assert c1 == (keys == g).sum() and mx == vals[m].max()
+
+
+class TestIdentityPassthrough:
+    """Bare-column projections bypass the device kernel: exact values
+    (f64 is emulated on TPU — an identity round trip perturbs ~1e-14)
+    and no transfer for untouched columns."""
+
+    def test_filtered_select_passes_input_arrays(self):
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.context import ExecutionContext
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema(
+            [Field("a", DataType.FLOAT64, False), Field("b", DataType.INT64, False)]
+        )
+        a = np.array([43.21, 12.34, 0.5])
+        b = np.array([1, -2, 3], dtype=np.int64)
+        batch = make_host_batch(schema, [a, b], [None, None], [None, None])
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("t", MemoryDataSource(schema, [batch]))
+
+        out = next(ctx.sql("SELECT a, b, a * 2 FROM t WHERE b > 0").batches())
+        # identity outputs ARE the input arrays — no kernel round trip
+        assert out.data[0] is batch.data[0]
+        assert out.data[1] is batch.data[1]
+        t = ctx.sql_collect("SELECT a, b FROM t WHERE b > 0")
+        assert t.column_values(0) == [43.21, 0.5]
+
+    def test_pure_selection_no_device_work(self):
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.context import ExecutionContext
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema([Field("a", DataType.FLOAT64, False)])
+        batch = make_host_batch(schema, [np.array([1.5, 2.5])], [None], [None])
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("t", MemoryDataSource(schema, [batch]))
+        out = next(ctx.sql("SELECT a FROM t").batches())
+        assert out.data[0] is batch.data[0]
+        assert out.mask is None  # no kernel ran at all
