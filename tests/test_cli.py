@@ -210,3 +210,30 @@ class TestTimingMode:
         assert "Timing is on." in text
         assert "Error" not in text
         assert "Timing: " in text
+
+
+class TestProfilerTrace:
+    def test_trace_writes_profile(self, tmp_path):
+        import os
+
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.context import ExecutionContext
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.utils.profiling import annotate, trace
+
+        schema = Schema([Field("x", DataType.FLOAT64, False)])
+        batch = make_host_batch(schema, [np.arange(100.0)], [None], [None])
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("t", MemoryDataSource(schema, [batch]))
+        out_dir = str(tmp_path / "prof")
+        with trace(out_dir):
+            with annotate("q1"):
+                ctx.sql_collect("SELECT SUM(x), COUNT(1) FROM t WHERE x > 1")
+        # a plugins/profile/<ts>/ tree with at least one trace artifact
+        found = []
+        for root, _dirs, files in os.walk(out_dir):
+            found.extend(files)
+        assert found, "profiler produced no trace files"
