@@ -286,28 +286,15 @@ def _max_identity(dtype: np.dtype):
     raise ExecutionError(f"MAX unsupported for {dtype}")
 
 
-class AggregateRelation(Relation):
-    """Executes [Selection +] Aggregate over a child relation in one
-    fused kernel; emits a single result batch.
+class _AggregateCore:
+    """The compiled, shareable part of an aggregation: specs, slots
+    (with their compiled argument closures), the predicate closure, and
+    the jitted kernel.  Cached process-wide by plan fingerprint
+    (SURVEY §7 recompilation control): a fresh operator tree for a
+    semantically identical GROUP BY reuses the already-built jit and
+    every executable in its cache."""
 
-    Group expressions must be column references over the child schema
-    (the planner produces exactly that shape today).
-    """
-
-    def __init__(
-        self,
-        child: Relation,
-        group_expr: list[Expr],
-        aggr_expr: list[Expr],
-        out_schema: Schema,
-        predicate: Optional[Expr] = None,
-        functions=None,
-        device=None,
-    ):
-        self.child = child
-        self._schema = out_schema
-        self.device = device
-        in_schema = child.schema
+    def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions):
         for g in group_expr:
             if not isinstance(g, Column):
                 raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
@@ -323,13 +310,29 @@ class AggregateRelation(Relation):
         compiler = ExprCompiler(in_schema, functions)
         self._pred_fn = compiler.compile(predicate) if predicate is not None else None
         self.slots = self._build_slots(compiler)
-        self._aux_specs = compiler.aux_specs
-        self._aux_cache: dict = {}
-        self.encoder = GroupKeyEncoder(len(self.key_cols))
-        self._key_dicts: dict[int, StringDictionary] = {}
-        self._str_dicts: dict[int, StringDictionary] = {}
-        self._str_aux_cache: dict = {}
-        self._jit = jax.jit(self._kernel)
+        self.aux_specs = compiler.aux_specs
+        self.jit = jax.jit(self._kernel)
+
+    @staticmethod
+    def build(in_schema, group_expr, aggr_expr, predicate, functions):
+        from datafusion_tpu.exec.kernels import (
+            cached_kernel,
+            functions_fingerprint,
+            schema_fingerprint,
+        )
+
+        key = (
+            "aggregate",
+            schema_fingerprint(in_schema),
+            tuple(group_expr),
+            tuple(aggr_expr),
+            predicate,
+            functions_fingerprint(functions),
+        )
+        return cached_kernel(
+            key,
+            lambda: _AggregateCore(in_schema, group_expr, aggr_expr, predicate, functions),
+        )
 
     def _build_slots(self, compiler: ExprCompiler) -> list[_Slot]:
         """Deduplicate aggregates into accumulator slots.  SUM(x) and
@@ -367,39 +370,6 @@ class AggregateRelation(Relation):
                     s.name, s.arg, np.dtype(s.arg_type.np_dtype)
                 )
         return slots
-
-    def _compute_str_aux(self, batch: RecordBatch):
-        """(ranks, rank->code) pair per string min/max slot, padded to a
-        bucketed capacity, cached per dictionary version."""
-        out = []
-        for k, sl in enumerate(self.slots):
-            if not sl.is_string:
-                out.append(None)
-                continue
-            d = batch.dicts[sl.arg_index]
-            if d is None:
-                raise ExecutionError(
-                    f"column {sl.arg_index} has no dictionary for {sl.kind}"
-                )
-            self._str_dicts[k] = d
-            key = (k, d.version)
-            hit = self._str_aux_cache.get(key)
-            if hit is None:
-                ranks = d.sort_ranks().astype(np.int32)
-                order = np.argsort(ranks).astype(np.int32)  # rank -> code
-                cap = bucket_capacity(max(len(ranks), 1))
-                pr = np.zeros(cap, np.int32)
-                pr[: len(ranks)] = ranks
-                po = np.zeros(cap, np.int32)
-                po[: len(order)] = order
-                hit = (pr, po)
-                self._str_aux_cache[key] = hit
-            out.append(hit)
-        return tuple(out)
-
-    @property
-    def schema(self) -> Schema:
-        return self._schema
 
     # -- accumulator state: (counts, tuple(per-slot accumulators)) --
     def _slot_identity(self, sl: _Slot):
@@ -688,6 +658,99 @@ class AggregateRelation(Relation):
                     jnp.minimum(acc, red) if sl.kind == "min" else jnp.maximum(acc, red)
                 )
         return new_counts, tuple(new_accs)
+
+
+class AggregateRelation(Relation):
+    """Executes [Selection +] Aggregate over a child relation in one
+    fused kernel; emits a single result batch.
+
+    Group expressions must be column references over the child schema
+    (the planner produces exactly that shape today).  The compiled
+    core — specs, slots, predicate closure, jitted kernel — is shared
+    process-wide across relations with the same plan fingerprint.
+    """
+
+    def __init__(
+        self,
+        child: Relation,
+        group_expr: list[Expr],
+        aggr_expr: list[Expr],
+        out_schema: Schema,
+        predicate: Optional[Expr] = None,
+        functions=None,
+        device=None,
+    ):
+        self.child = child
+        self._schema = out_schema
+        self.device = device
+        self.core = _AggregateCore.build(
+            child.schema, list(group_expr), list(aggr_expr), predicate, functions
+        )
+        self.key_cols = self.core.key_cols
+        self.specs = self.core.specs
+        self.slots = self.core.slots
+        self._aux_specs = self.core.aux_specs
+        self._jit = self.core.jit
+        self._aux_cache: dict = {}
+        self.encoder = GroupKeyEncoder(len(self.key_cols))
+        self._key_dicts: dict[int, StringDictionary] = {}
+        self._str_dicts: dict[int, StringDictionary] = {}
+        self._str_aux_cache: dict = {}
+
+    # -- delegates into the shared core (the partitioned subclass and
+    # the multi-host coordinator call these by name) --
+    def _kernel(self, *args):
+        return self.core._kernel(*args)
+
+    def _slot_identity(self, sl: _Slot):
+        return self.core._slot_identity(sl)
+
+    @staticmethod
+    def _codes_to_ranks(kind, codes, str_aux_k):
+        return _AggregateCore._codes_to_ranks(kind, codes, str_aux_k)
+
+    @staticmethod
+    def _ranks_to_codes(kind, best, str_aux_k):
+        return _AggregateCore._ranks_to_codes(kind, best, str_aux_k)
+
+    def _init_state(self, capacity: int):
+        return self.core._init_state(capacity)
+
+    def _grow_state(self, state, new_capacity: int):
+        return self.core._grow_state(state, new_capacity)
+
+    def _compute_str_aux(self, batch: RecordBatch):
+        """(ranks, rank->code) pair per string min/max slot, padded to a
+        bucketed capacity, cached per dictionary version."""
+        out = []
+        for k, sl in enumerate(self.slots):
+            if not sl.is_string:
+                out.append(None)
+                continue
+            d = batch.dicts[sl.arg_index]
+            if d is None:
+                raise ExecutionError(
+                    f"column {sl.arg_index} has no dictionary for {sl.kind}"
+                )
+            self._str_dicts[k] = d
+            key = (k, d.version)
+            hit = self._str_aux_cache.get(key)
+            if hit is None:
+                ranks = d.sort_ranks().astype(np.int32)
+                order = np.argsort(ranks).astype(np.int32)  # rank -> code
+                cap = bucket_capacity(max(len(ranks), 1))
+                pr = np.zeros(cap, np.int32)
+                pr[: len(ranks)] = ranks
+                po = np.zeros(cap, np.int32)
+                po[: len(order)] = order
+                hit = (pr, po)
+                self._str_aux_cache[key] = hit
+            out.append(hit)
+        return tuple(out)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
 
     def _pick_capacity(self, current: int) -> int:
         """Accumulator capacity for the observed group count.  Tight

@@ -1,0 +1,58 @@
+"""Process-wide prepared-kernel cache.
+
+SURVEY §7 ("Recompilation control"): the compile-cache key must be the
+*plan fingerprint* — (expressions, schema, padded shape) — not the
+operator instance.  `jax.jit` caches per callable object, so a fresh
+operator tree (every new query, every new ExecutionContext) would
+re-trace and re-compile kernels that are semantically identical to ones
+already built.  Operators therefore build their compiled core (expr
+closures + the jitted kernel) through this registry: equal fingerprints
+share one core, so a repeated query — even from a brand-new context —
+dispatches the already-compiled executable.
+
+(The persistent on-disk XLA cache in __init__.py removes the cost
+across processes; this registry removes the re-trace/lookup cost and
+keeps remote-compile services out of the hot path within a process.)
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Callable
+
+# LRU-bounded: fingerprints embed literal values (WHERE x > <literal>
+# compiles a distinct kernel — XLA folds constants), so a long-running
+# process with parameterized queries must not pin every variant forever
+_MAX_CORES = int(os.environ.get("DATAFUSION_TPU_KERNEL_CACHE_SIZE", 256))
+_REGISTRY: OrderedDict = OrderedDict()
+
+
+def cached_kernel(key, build: Callable):
+    """The cached compiled core for `key`, building it on first use;
+    least-recently-used cores evict past the registry bound."""
+    hit = _REGISTRY.get(key)
+    if hit is None:
+        hit = _REGISTRY[key] = build()
+        while len(_REGISTRY) > _MAX_CORES:
+            _REGISTRY.popitem(last=False)
+    else:
+        _REGISTRY.move_to_end(key)
+    return hit
+
+
+def schema_fingerprint(schema) -> tuple:
+    """Hashable image of a schema as kernels see it (positional
+    dtypes + nullability; names ride along for dictionary wiring)."""
+    return tuple(
+        (f.name, repr(f.data_type), f.nullable) for f in schema.fields
+    )
+
+
+def functions_fingerprint(functions) -> tuple:
+    """Hashable image of a UDF registry: jax lowerings are keyed by
+    identity (two contexts registering the same function object share
+    kernels; different lowerings never collide)."""
+    if not functions:
+        return ()
+    return tuple(sorted((name, id(fn)) for name, fn in functions.items()))

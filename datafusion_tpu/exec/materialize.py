@@ -23,23 +23,21 @@ _COMPACT_FACTOR = 2
 _GATHER_JIT = None
 
 
-def _gather_compact(cols, valids, idxs):
+def _gather_compact(arrays, idxs):
     """Jitted gather of the live rows to the front (selective filters:
     transfer count rows over the link instead of the whole capacity —
     D2H bandwidth is the scarce resource on tunneled devices).  One
-    module-level jit, cached per (shapes, dtypes, validity pattern)."""
+    module-level jit, cached per (shapes, dtypes)."""
     global _GATHER_JIT
     if _GATHER_JIT is None:
         import jax
 
-        def gather(cols, valids, idxs):
-            return (
-                tuple(c[idxs] for c in cols),
-                tuple(None if v is None else v[idxs] for v in valids),
-            )
+        _GATHER_JIT = jax.jit(lambda arrs, idx: tuple(a[idx] for a in arrs))
+    return _GATHER_JIT(arrays, idxs)
 
-        _GATHER_JIT = jax.jit(gather)
-    return _GATHER_JIT(cols, valids, idxs)
+
+def _on_device(a) -> bool:
+    return hasattr(a, "copy_to_host_async")
 
 
 def iter_with_mask_prefetch(batches):
@@ -73,15 +71,30 @@ def compact_batch(batch: RecordBatch):
     fused device kernel and only live rows cross the link).
     """
     n = batch.num_rows
-    on_device = any(hasattr(a, "copy_to_host_async") for a in batch.data)
     live: Optional[np.ndarray] = None
     if batch.mask is not None:
-        if hasattr(batch.mask, "copy_to_host_async"):
+        if _on_device(batch.mask):
             batch.mask.copy_to_host_async()
         live = np.asarray(batch.mask)[: batch.capacity]
         live = live & (np.arange(batch.capacity) < n)
 
-    if live is not None and on_device:
+    # arrays already resident on device ((position-kind, index) pairs);
+    # host arrays (identity passthroughs, host-fn outputs) never travel
+    # to the device just to be compacted — they index by `live` directly
+    dev_pos: list[tuple[str, int]] = []
+    dev_arrays: list = []
+    for i, c in enumerate(batch.data):
+        if _on_device(c):
+            dev_pos.append(("col", i))
+            dev_arrays.append(c)
+    for i, v in enumerate(batch.validity):
+        if v is not None and _on_device(v):
+            dev_pos.append(("val", i))
+            dev_arrays.append(v)
+
+    pulled: dict[tuple[str, int], np.ndarray] = {}
+    compacted = False
+    if live is not None and dev_arrays:
         idx = np.nonzero(live)[0]
         count = len(idx)
         cap_out = bucket_capacity(max(count, 1))
@@ -91,40 +104,39 @@ def compact_batch(batch: RecordBatch):
             padded = np.zeros(cap_out, np.int32)
             padded[:count] = idx
             with METRICS.timer("d2h.compact"):
-                ccols, cvalids = _gather_compact(
-                    tuple(batch.data),
-                    tuple(batch.validity),
-                    jnp.asarray(padded),
-                )
-                for arr in (*ccols, *cvalids):
-                    if hasattr(arr, "copy_to_host_async"):
-                        arr.copy_to_host_async()
-                cols = [np.asarray(c)[:count] for c in ccols]
-                valids = [
-                    None if v is None else np.asarray(v)[:count] for v in cvalids
-                ]
+                gathered = _gather_compact(tuple(dev_arrays), jnp.asarray(padded))
+                for g in gathered:
+                    g.copy_to_host_async()
+                for pos, g in zip(dev_pos, gathered):
+                    pulled[pos] = np.asarray(g)[:count]
             METRICS.add("d2h.compacted_batches")
-            return cols, valids, list(batch.dicts), count
+            compacted = True
+    if not compacted and dev_arrays:
+        # overlap D2H latencies: start all copies before the first
+        # blocking np.asarray (matters on tunneled/remote devices)
+        for a in dev_arrays:
+            a.copy_to_host_async()
+        for pos, a in zip(dev_pos, dev_arrays):
+            pulled[pos] = np.asarray(a)
 
-    # overlap D2H latencies: start all copies before the first blocking
-    # np.asarray (matters on tunneled/remote devices)
-    for arr in (*batch.data, *batch.validity):
-        if hasattr(arr, "copy_to_host_async"):
-            arr.copy_to_host_async()
+    def select(kind, i, a):
+        hit = pulled.get((kind, i))
+        if hit is not None:
+            if compacted:
+                return hit  # already gathered to the live rows
+            a = hit
+        else:
+            a = np.asarray(a)
+        if live is not None:
+            return a[live]
+        return a[:n]
+
     cols = []
     valids = []
     for i in range(batch.num_columns):
-        c = np.asarray(batch.data[i])
+        cols.append(select("col", i, batch.data[i]))
         v = batch.validity[i]
-        v = None if v is None else np.asarray(v)
-        if live is not None:
-            c = c[live]
-            v = None if v is None else v[live]
-        else:
-            c = c[:n]
-            v = None if v is None else v[:n]
-        cols.append(c)
-        valids.append(v)
+        valids.append(None if v is None else select("val", i, v))
     count = int(live.sum()) if live is not None else n
     return cols, valids, list(batch.dicts), count
 

@@ -59,14 +59,147 @@ class DataSourceRelation(Relation):
         return self.datasource.batches()
 
 
+class _PipelineCore:
+    """The compiled, shareable part of a pipeline: expression closures
+    and the jitted kernel.  Cached process-wide by plan fingerprint
+    (SURVEY §7 recompilation control) so a fresh operator tree for a
+    semantically identical query reuses the already-built jit — and
+    with it every compiled executable in jit's cache."""
+
+    def __init__(self, in_schema, predicate, projections, functions, metas):
+        from datafusion_tpu.exec.hostfn import contains_host_fn
+
+        compiler = ExprCompiler(in_schema, functions)
+        if predicate is not None and contains_host_fn(predicate, metas):
+            raise NotSupportedError(
+                "host-only functions are not supported in WHERE predicates"
+            )
+        self.pred_fn = compiler.compile(predicate) if predicate is not None else None
+        # projections containing host-only functions (string/struct
+        # producers) are evaluated post-kernel against the input batch;
+        # bare column references bypass the kernel entirely — the host
+        # array passes through untouched.  That keeps Float64 columns
+        # EXACT on TPU (f64 is emulated there: even an identity kernel
+        # round-trip perturbs values by ~1e-14) and removes their D2H
+        # transfer — only computed columns and the mask cross the link.
+        self.host_proj: dict[int, Expr] = {}
+        self.identity_proj: dict[int, int] = {}
+        self.proj_fns = None
+        if projections is not None:
+            self.proj_fns = []
+            for j, e in enumerate(projections):
+                if contains_host_fn(e, metas):
+                    self.host_proj[j] = e
+                    self.proj_fns.append(None)
+                elif isinstance(e, Column):
+                    self.identity_proj[j] = e.index
+                    self.proj_fns.append(None)
+                else:
+                    self.proj_fns.append(compiler.compile(e))
+        self.aux_specs = compiler.aux_specs
+        # map projection outputs to source dictionaries (Utf8 passthrough)
+        self.out_dict_sources: list[Optional[int]] = []
+        if projections is not None:
+            for e in projections:
+                if (
+                    isinstance(e, Column)
+                    and in_schema.field(e.index).data_type == DataType.UTF8
+                ):
+                    self.out_dict_sources.append(e.index)
+                else:
+                    self.out_dict_sources.append(None)
+
+        # no predicate and nothing to compute on device => the batch
+        # never touches the device at all (pure column selection)
+        self.needs_kernel = self.pred_fn is not None or (
+            self.proj_fns is not None
+            and any(f is not None for f in self.proj_fns)
+        )
+        # ship only the columns the kernel actually reads (jit transfers
+        # every argument, used or not — H2D bytes are the scarce
+        # resource on remote links); Env's col_map translates schema
+        # indices to subset positions
+        used: set[int] = set()
+        if predicate is not None:
+            predicate.collect_columns(used)
+        if projections is not None:
+            for j, e in enumerate(projections):
+                if j in self.identity_proj or j in self.host_proj:
+                    continue
+                e.collect_columns(used)
+        if self.needs_kernel and not used and len(in_schema):
+            used.add(0)  # constant predicate: one column carries capacity
+        self.used_cols = sorted(used)
+        self.col_map = {c: i for i, c in enumerate(self.used_cols)}
+        self.sub_schema = in_schema.select(self.used_cols)
+        self.jit = jax.jit(self._kernel)
+
+    @staticmethod
+    def build(in_schema, predicate, projections, functions, metas):
+        from datafusion_tpu.exec.kernels import (
+            cached_kernel,
+            functions_fingerprint,
+            schema_fingerprint,
+        )
+
+        key = (
+            "pipeline",
+            schema_fingerprint(in_schema),
+            predicate,
+            None if projections is None else tuple(projections),
+            functions_fingerprint(functions),
+            tuple(sorted(n for n, m in (metas or {}).items() if m.host_fn)),
+        )
+        return cached_kernel(
+            key,
+            lambda: _PipelineCore(in_schema, predicate, projections, functions, metas),
+        )
+
+    def _kernel(self, cols, valids, aux, num_rows, base_mask):
+        env = Env(cols, valids, aux, self.col_map)
+        if cols:
+            capacity = cols[0].shape[0]
+        elif base_mask is not None:
+            capacity = base_mask.shape[0]  # zero-column EmptyRelation batch
+        else:
+            capacity = 1
+        mask = base_mask
+        if mask is None:
+            mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        else:
+            mask = mask & (jnp.arange(capacity, dtype=jnp.int32) < num_rows)
+        if self.pred_fn is not None:
+            pv, pvalid = self.pred_fn(env)
+            pv = jnp.broadcast_to(pv, (capacity,))
+            if pvalid is not None:
+                # SQL: NULL predicate drops the row
+                pv = pv & jnp.broadcast_to(pvalid, (capacity,))
+            mask = mask & pv
+        if self.proj_fns is None:
+            # filter-only: columns pass through on the host; the kernel
+            # produces just the selection mask
+            return [], [], mask
+        out_cols, out_valids = [], []
+        for f in self.proj_fns:
+            if f is None:  # host-evaluated or identity: filled in later
+                continue
+            v, valid = f(env)
+            out_cols.append(jnp.broadcast_to(v, (capacity,)))
+            out_valids.append(
+                None if valid is None else jnp.broadcast_to(valid, (capacity,))
+            )
+        return out_cols, out_valids, mask
+
+
 class PipelineRelation(Relation):
     """Fused [filter +] [projection] over a child relation.
 
     One `jax.jit`-compiled function evaluates the predicate and all
     projection expressions in a single fused XLA computation per batch.
-    jit's own cache handles per-(capacity, dtypes) specialization; the
-    batch capacity bucketing in exec/batch.py bounds how many variants
-    ever compile.
+    The compiled core is shared process-wide by plan fingerprint
+    (`_PipelineCore.build`); jit's own cache handles per-(capacity,
+    dtypes) specialization and capacity bucketing (exec/batch.py)
+    bounds how many variants ever compile.
     """
 
     def __init__(
@@ -79,149 +212,50 @@ class PipelineRelation(Relation):
         device=None,
         function_metas=None,
     ):
-        from datafusion_tpu.exec.hostfn import contains_host_fn
-
         self.child = child
         self.predicate = predicate
         self.projections = projections
         self._schema = out_schema if out_schema is not None else child.schema
         self.device = device
         self._metas = function_metas or {}
-        in_schema = child.schema
-
-        compiler = ExprCompiler(in_schema, functions)
-        if predicate is not None and contains_host_fn(predicate, self._metas):
-            raise NotSupportedError(
-                "host-only functions are not supported in WHERE predicates"
-            )
-        self._pred_fn = compiler.compile(predicate) if predicate is not None else None
-        # projections containing host-only functions (string/struct
-        # producers) are evaluated post-kernel against the input batch;
-        # bare column references bypass the kernel entirely — the host
-        # array passes through untouched.  That keeps Float64 columns
-        # EXACT on TPU (f64 is emulated there: even an identity kernel
-        # round-trip perturbs values by ~1e-14) and removes their D2H
-        # transfer — only computed columns and the mask cross the link.
-        self._host_proj: dict[int, Expr] = {}
-        self._identity_proj: dict[int, int] = {}
-        self._host_dicts: dict[int, "StringDictionary"] = {}
-        self._proj_fns = None
-        if projections is not None:
-            self._proj_fns = []
-            for j, e in enumerate(projections):
-                if contains_host_fn(e, self._metas):
-                    self._host_proj[j] = e
-                    self._proj_fns.append(None)
-                elif isinstance(e, Column):
-                    self._identity_proj[j] = e.index
-                    self._proj_fns.append(None)
-                else:
-                    self._proj_fns.append(compiler.compile(e))
-        self._aux_specs = compiler.aux_specs
-        self._aux_cache: dict = {}
-        # map projection outputs to source dictionaries (Utf8 passthrough)
-        self._out_dict_sources: list[Optional[int]] = []
-        if projections is not None:
-            for e in projections:
-                if (
-                    isinstance(e, Column)
-                    and in_schema.field(e.index).data_type == DataType.UTF8
-                ):
-                    self._out_dict_sources.append(e.index)
-                else:
-                    self._out_dict_sources.append(None)
-
-        # no predicate and nothing to compute on device => the batch
-        # never touches the device at all (pure column selection)
-        self._needs_kernel = self._pred_fn is not None or (
-            self._proj_fns is not None
-            and any(f is not None for f in self._proj_fns)
+        self.core = _PipelineCore.build(
+            child.schema, predicate, projections, functions, self._metas
         )
-        # ship only the columns the kernel actually reads (jit transfers
-        # every argument, used or not — H2D bytes are the scarce
-        # resource on remote links); Env's col_map translates schema
-        # indices to subset positions
-        used: set[int] = set()
-        if predicate is not None:
-            predicate.collect_columns(used)
-        if projections is not None:
-            for j, e in enumerate(projections):
-                if j in self._identity_proj or j in self._host_proj:
-                    continue
-                e.collect_columns(used)
-        if self._needs_kernel and not used and len(in_schema):
-            used.add(0)  # constant predicate: one column carries capacity
-        self._used_cols = sorted(used)
-        self._col_map = {c: i for i, c in enumerate(self._used_cols)}
-        self._sub_schema = in_schema.select(self._used_cols)
-        self._jit = jax.jit(self._kernel)
+        self._host_dicts: dict[int, "StringDictionary"] = {}
+        self._aux_cache: dict = {}
 
     @property
     def schema(self) -> Schema:
         return self._schema
 
-    def _kernel(self, cols, valids, aux, num_rows, base_mask):
-        env = Env(cols, valids, aux, self._col_map)
-        if cols:
-            capacity = cols[0].shape[0]
-        elif base_mask is not None:
-            capacity = base_mask.shape[0]  # zero-column EmptyRelation batch
-        else:
-            capacity = 1
-        mask = base_mask
-        if mask is None:
-            mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
-        else:
-            mask = mask & (jnp.arange(capacity, dtype=jnp.int32) < num_rows)
-        if self._pred_fn is not None:
-            pv, pvalid = self._pred_fn(env)
-            pv = jnp.broadcast_to(pv, (capacity,))
-            if pvalid is not None:
-                # SQL: NULL predicate drops the row
-                pv = pv & jnp.broadcast_to(pvalid, (capacity,))
-            mask = mask & pv
-        if self._proj_fns is None:
-            # filter-only: columns pass through on the host; the kernel
-            # produces just the selection mask
-            return [], [], mask
-        out_cols, out_valids = [], []
-        for f in self._proj_fns:
-            if f is None:  # host-evaluated or identity: filled in later
-                continue
-            v, valid = f(env)
-            out_cols.append(jnp.broadcast_to(v, (capacity,)))
-            out_valids.append(
-                None if valid is None else jnp.broadcast_to(valid, (capacity,))
-            )
-        return out_cols, out_valids, mask
-
     def batches(self) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.batch import device_inputs
 
+        core = self.core
         for batch in self.child.batches():
-            if not self._needs_kernel:
+            if not core.needs_kernel:
                 cols, valids, mask = [], [], batch.mask
             else:
-                aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
+                aux = compute_aux_values(core.aux_specs, batch, self._aux_cache)
                 with METRICS.timer("execute.pipeline"), device_scope(self.device):
                     data, validity, mask_in = device_inputs(
                         self._subset_view(batch), self.device
                     )
                     cols, valids, mask = device_call(
-                        self._jit,
+                        core.jit,
                         data,
                         validity,
                         tuple(aux),
                         np.int32(batch.num_rows),
                         mask_in,
                     )
-            if self._proj_fns is None:
+            if core.proj_fns is None:
                 # filter-only: the input columns, untouched
                 cols, valids, dicts = batch.data, batch.validity, batch.dicts
             else:
                 dicts = [
                     batch.dicts[src] if src is not None else None
-                    for src in self._out_dict_sources
+                    for src in core.out_dict_sources
                 ]
                 cols, valids, dicts = self._assemble_outputs(
                     batch, list(cols), list(valids), list(dicts)
@@ -239,16 +273,17 @@ class PipelineRelation(Relation):
         """A view batch holding only the kernel's input columns, cached
         on the parent so device copies survive re-scans of in-memory
         sources (device_inputs caches on the view)."""
-        if len(self._used_cols) == batch.num_columns:
+        used = self.core.used_cols
+        if len(used) == batch.num_columns:
             return batch
-        key = ("subset_view", tuple(self._used_cols))
+        key = ("subset_view", tuple(used))
         view = batch.cache.get(key)
         if view is None:
             view = RecordBatch(
-                self._sub_schema,
-                [batch.data[c] for c in self._used_cols],
-                [batch.validity[c] for c in self._used_cols],
-                [batch.dicts[c] for c in self._used_cols],
+                self.core.sub_schema,
+                [batch.data[c] for c in used],
+                [batch.validity[c] for c in used],
+                [batch.dicts[c] for c in used],
                 num_rows=batch.num_rows,
                 mask=batch.mask,
             )
@@ -265,12 +300,12 @@ class PipelineRelation(Relation):
         cols, valids = [], []
         dev_i = 0
         for j in range(len(self.projections)):
-            src = self._identity_proj.get(j)
+            src = self.core.identity_proj.get(j)
             if src is not None:
                 cols.append(batch.data[src])
                 valids.append(batch.validity[src])
                 continue
-            host_expr = self._host_proj.get(j)
+            host_expr = self.core.host_proj.get(j)
             if host_expr is None:
                 cols.append(dev_cols[dev_i])
                 valids.append(dev_valids[dev_i])

@@ -96,56 +96,25 @@ class _KeyPlan:
         self.rank_slot = rank_slot
 
 
-class SortRelation(Relation):
-    def __init__(
-        self,
-        child: Relation,
-        sort_expr: list[SortExpr],
-        out_schema: Schema,
-        limit: Optional[int] = None,
-        device=None,
-    ):
-        self.child = child
-        self.sort_expr = sort_expr
-        self._schema = out_schema
-        self.limit = limit
-        self.device = device
-        for se in sort_expr:
-            if not isinstance(se.expr, Column):
-                raise NotSupportedError(
-                    f"ORDER BY supports column references, got {se.expr!r}"
-                )
-        in_schema = child.schema
-        self._key_plans: list[_KeyPlan] = []
-        rank_slots = 0
-        for se in sort_expr:
-            idx = se.expr.index
-            f = in_schema.field(idx)
-            if f.data_type == DataType.UTF8:
-                self._key_plans.append(_KeyPlan(idx, "str", se.asc, rank_slots))
-                rank_slots += 1
-                continue
-            kind = f.data_type.np_dtype.kind
-            if kind == "O":
-                raise NotSupportedError("struct columns cannot be ORDER BY keys")
-            if kind == "u" and f.data_type.width == 64:
-                kind = "u64"
-            elif kind in ("b", "i", "u"):
-                kind = "i"
-            else:
-                kind = "f"
-            self._key_plans.append(_KeyPlan(idx, kind, se.asc, None))
-        # TopK state capacity bucketed to a power of two (floor 128):
-        # every LIMIT in a bucket shares one compiled kernel per batch
-        # shape — compiles are the expensive resource on remote devices
-        self._kb = 128
-        while limit is not None and self._kb < min(limit, TOPK_MAX):
-            self._kb <<= 1
-        self._topk_jit = jax.jit(self._topk_kernel, static_argnums=(0,))
+class _TopKCore:
+    """The compiled, shareable part of a streaming TopK: the key
+    transform and the jitted merge kernel, cached process-wide by the
+    key-plan fingerprint (SURVEY §7 recompilation control) so repeated
+    ORDER BY ... LIMIT shapes reuse compiled executables."""
 
-    @property
-    def schema(self) -> Schema:
-        return self._schema
+    def __init__(self, key_plans: list[_KeyPlan]):
+        self._key_plans = key_plans
+        self.jit = jax.jit(self._topk_kernel, static_argnums=(0,))
+
+    @staticmethod
+    def build(key_plans: list[_KeyPlan]) -> "_TopKCore":
+        from datafusion_tpu.exec.kernels import cached_kernel
+
+        key = (
+            "topk",
+            tuple((kp.index, kp.kind, kp.asc, kp.rank_slot) for kp in key_plans),
+        )
+        return cached_kernel(key, lambda: _TopKCore(list(key_plans)))
 
     # -- shared key transform (device, traced) --
     def _device_keys(self, cols, valids, mask, capacity, rank_tables):
@@ -226,6 +195,60 @@ class SortRelation(Relation):
             for sb, v in zip(svalid, valids)
         )
         return new_keys, new_live, new_vals, new_valid
+
+
+
+class SortRelation(Relation):
+    def __init__(
+        self,
+        child: Relation,
+        sort_expr: list[SortExpr],
+        out_schema: Schema,
+        limit: Optional[int] = None,
+        device=None,
+    ):
+        self.child = child
+        self.sort_expr = sort_expr
+        self._schema = out_schema
+        self.limit = limit
+        self.device = device
+        for se in sort_expr:
+            if not isinstance(se.expr, Column):
+                raise NotSupportedError(
+                    f"ORDER BY supports column references, got {se.expr!r}"
+                )
+        in_schema = child.schema
+        self._key_plans: list[_KeyPlan] = []
+        rank_slots = 0
+        for se in sort_expr:
+            idx = se.expr.index
+            f = in_schema.field(idx)
+            if f.data_type == DataType.UTF8:
+                self._key_plans.append(_KeyPlan(idx, "str", se.asc, rank_slots))
+                rank_slots += 1
+                continue
+            kind = f.data_type.np_dtype.kind
+            if kind == "O":
+                raise NotSupportedError("struct columns cannot be ORDER BY keys")
+            if kind == "u" and f.data_type.width == 64:
+                kind = "u64"
+            elif kind in ("b", "i", "u"):
+                kind = "i"
+            else:
+                kind = "f"
+            self._key_plans.append(_KeyPlan(idx, kind, se.asc, None))
+        # TopK state capacity bucketed to a power of two (floor 128):
+        # every LIMIT in a bucket shares one compiled kernel per batch
+        # shape — compiles are the expensive resource on remote devices
+        self._kb = 128
+        while limit is not None and self._kb < min(limit, TOPK_MAX):
+            self._kb <<= 1
+        self.core = _TopKCore.build(self._key_plans)
+        self._topk_jit = self.core.jit
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
 
     def _topk_init(self, k, in_schema):
         keys = []
