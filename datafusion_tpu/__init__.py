@@ -30,8 +30,14 @@ _jax_config.update("jax_enable_x64", True)
 import os as _os
 
 _cache_dir = _os.environ.get("DATAFUSION_TPU_COMPILE_CACHE")
-if _cache_dir != "0" and not _os.environ.get("JAX_COMPILATION_CACHE_DIR") and (
-    getattr(_jax_config, "jax_compilation_cache_dir", None) in (None, "")
+if (
+    _cache_dir != "0"
+    and not _os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    and getattr(_jax_config, "jax_compilation_cache_dir", None) in (None, "")
+    # CPU-pinned processes (tests, workers) skip it: CPU compiles are
+    # cheap, and XLA:CPU AOT reloads warn about pseudo-feature
+    # mismatches across processes
+    and _os.environ.get("JAX_PLATFORMS", "").lower() != "cpu"
 ):
     # only when the user hasn't configured a cache themselves
     if not _cache_dir:
@@ -42,7 +48,9 @@ if _cache_dir != "0" and not _os.environ.get("JAX_COMPILATION_CACHE_DIR") and (
         _os.makedirs(_cache_dir, exist_ok=True)
         _jax_config.update("jax_compilation_cache_dir", _cache_dir)
         if not _os.environ.get("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"):
-            _jax_config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+            # accelerator kernels (minutes via remote compile) persist;
+            # quick CPU-baseline compiles stay out of the cache
+            _jax_config.update("jax_persistent_cache_min_compile_time_secs", 10.0)
     except (OSError, AttributeError):  # pragma: no cover - config drift
         pass
 
