@@ -21,9 +21,16 @@ def main():
     sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     import jax
 
+    # honor JAX_PLATFORMS even where sitecustomize re-registers an
+    # accelerator backend at boot (same re-pin as tests/conftest.py) —
+    # without this the "CPU mesh" silently lands on the TPU AOT
+    # compiler, which rejects pmin/pmax collectives
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms:
+        jax.config.update("jax_platforms", platforms)
+
     from benchmarks import data as bdata
     from datafusion_tpu.exec.context import ExecutionContext
-    from datafusion_tpu.exec.datasource import MemoryDataSource, PartitionedDataSource  # noqa: F401
     from datafusion_tpu.exec.materialize import collect
     from datafusion_tpu.parallel.partition import (
         PartitionedContext,
@@ -85,6 +92,11 @@ def main():
         "p50_ms": round(p50_m * 1e3, 2),
         "single_device_p50_ms": round(p50_1 * 1e3, 2),
         "vs_baseline": round(p50_1 / p50_m, 3),
+        "note": (
+            f"{n_dev} VIRTUAL devices share one physical core: this "
+            "validates the shard_map+psum path and bounds its overhead; "
+            "it cannot show scaling (no multi-chip hardware here)"
+        ),
     }))
 
 
