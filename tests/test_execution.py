@@ -536,3 +536,79 @@ class TestIdentityPassthrough:
         out = next(ctx.sql("SELECT a FROM t").batches())
         assert out.data[0] is batch.data[0]
         assert out.mask is None  # no kernel ran at all
+
+
+class TestWireCompression:
+    """H2D wire codecs must be exactly lossless (exec/batch.py)."""
+
+    def test_roundtrip_exact(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from datafusion_tpu.exec.batch import _decode_wire, _encode_wire
+
+        rng = np.random.default_rng(0)
+        cases = [
+            np.array([True, False] * 512),
+            np.arange(1024, dtype=np.int64),                    # narrow
+            (np.arange(1024) * 10**9).astype(np.int64),         # raw
+            np.linspace(0, 50, 1024).round(0),                  # f32-exact
+            np.round(rng.uniform(900, 105000, 1024), 2),        # raw f64
+            rng.integers(0, 11, 1024) / 100.0,                  # dict
+            np.concatenate([[1.5, np.nan, -0.0, np.inf], np.zeros(1020)]),
+            np.arange(1024, dtype=np.uint64) + 2**63,           # raw u64
+            np.array([-129, 127] * 512, dtype=np.int64),        # int16
+        ]
+        for a in cases:
+            spec, wires = _encode_wire(a)
+            dec = np.asarray(
+                _decode_wire(spec, tuple(jnp.asarray(w) for w in wires))
+            )
+            assert dec.dtype == a.dtype
+            assert np.array_equal(dec, a, equal_nan=(a.dtype.kind == "f"))
+            assert sum(w.nbytes for w in wires) <= a.nbytes
+
+    def test_device_inputs_roundtrip(self):
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import device_inputs, make_host_batch
+
+        schema = Schema(
+            [
+                Field("i", DataType.INT64, True),
+                Field("f", DataType.FLOAT64, False),
+                Field("d", DataType.FLOAT64, False),
+            ]
+        )
+        rng = np.random.default_rng(1)
+        cols = [
+            rng.integers(-100, 100, 2048).astype(np.int64),
+            np.round(rng.uniform(900, 105000, 2048), 2),
+            rng.integers(0, 9, 2048) / 100.0,
+        ]
+        valid = rng.random(2048) > 0.1
+        batch = make_host_batch(schema, cols, [valid, None, None], [None] * 3)
+        data, validity, _ = device_inputs(batch)
+        for got, want in zip(data, batch.data):
+            assert np.array_equal(np.asarray(got), want)
+        assert np.array_equal(np.asarray(validity[0]), batch.validity[0])
+        # second call hits the batch cache
+        data2, _, _ = device_inputs(batch)
+        assert data2[0] is data[0]
+
+    def test_dict_wire_is_bit_exact(self):
+        # -0.0 and NaN payloads survive the dictionary encoding
+        # bit-for-bit (np.unique on float VALUES would collapse them)
+        import jax.numpy as jnp
+        import numpy as np
+
+        from datafusion_tpu.exec.batch import _decode_wire, _encode_wire
+
+        a = np.tile(np.array([0.01, 0.07, -0.0, np.nan, 104949.99, -0.03]), 256)
+        spec, wires = _encode_wire(a)
+        assert spec == ("dict",)
+        dec = np.asarray(_decode_wire(spec, tuple(jnp.asarray(w) for w in wires)))
+        assert np.array_equal(dec.view(np.int64), a.view(np.int64))
+        # the values table is fixed-size: one decoder shape per capacity
+        assert wires[1].shape == (256,)
