@@ -149,6 +149,7 @@ def sort_batches(rows: int, batch_rows: int):
             Field("a", DataType.FLOAT64, False),
             Field("b", DataType.INT64, False),
             Field("x", DataType.FLOAT64, False),
+            Field("s", DataType.FLOAT32, False),  # single-key fast path
         ]
     )
     rng = np.random.default_rng(11)
@@ -159,6 +160,7 @@ def sort_batches(rows: int, batch_rows: int):
             rng.uniform(0.0, 1e6, n),
             rng.integers(0, 1 << 40, n).astype(np.int64),
             rng.uniform(0.0, 1.0, n),
+            rng.uniform(0.0, 1e6, n).astype(np.float32),
         ]
-        batches.append(make_host_batch(schema, cols, [None] * 3, [None] * 3))
+        batches.append(make_host_batch(schema, cols, [None] * 4, [None] * 4))
     return schema, MemoryDataSource(schema, batches)

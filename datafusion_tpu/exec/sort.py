@@ -85,15 +85,18 @@ def _np_sort_key(
 
 class _KeyPlan:
     """How one ORDER BY key lowers onto a column: which column, its
-    transform kind, direction, and (for Utf8) a rank-table aux slot."""
+    transform kind, direction, source width, and (for Utf8) a
+    rank-table aux slot."""
 
-    __slots__ = ("index", "kind", "asc", "rank_slot")
+    __slots__ = ("index", "kind", "asc", "rank_slot", "width")
 
-    def __init__(self, index: int, kind: str, asc: bool, rank_slot: Optional[int]):
+    def __init__(self, index: int, kind: str, asc: bool,
+                 rank_slot: Optional[int], width: int = 64):
         self.index = index
         self.kind = kind  # "f" | "i" | "u64" | "str"
         self.asc = asc
         self.rank_slot = rank_slot
+        self.width = width
 
 
 class _TopKCore:
@@ -104,7 +107,27 @@ class _TopKCore:
 
     def __init__(self, key_plans: list[_KeyPlan]):
         self._key_plans = key_plans
-        self.jit = jax.jit(self._topk_kernel, static_argnums=(0,))
+        # single-key fast path: `lax.top_k` on an exact int64 score
+        # image (orders of magnitude faster than a multi-operand sort
+        # on TPU).  Eligible when the whole key order embeds in int64
+        # scores with no collision against the sentinels: float32
+        # (bit-image via s32 bitcast; NaNs clamped to "worst"), ints
+        # <= 32 bits, string ranks.  float64 keys stay on the sort
+        # path — TPU emulates f64 and its bitcast doesn't lower — as do
+        # full-width int64/uint64, whose complement image can collide
+        # with the sentinels at the extremes.
+        kp = key_plans[0] if len(key_plans) == 1 else None
+        self.single = kp is not None and (
+            (kp.kind == "f" and kp.width == 32)
+            or kp.kind == "str"
+            # width 33 admits uint32 (SortRelation budgets unsigned
+            # sources one extra signed bit)
+            or (kp.kind == "i" and kp.width <= 33)
+        )
+        if self.single:
+            self.jit = jax.jit(self._topk1_kernel, static_argnums=(0,))
+        else:
+            self.jit = jax.jit(self._topk_kernel, static_argnums=(0,))
 
     @staticmethod
     def build(key_plans: list[_KeyPlan]) -> "_TopKCore":
@@ -112,9 +135,95 @@ class _TopKCore:
 
         key = (
             "topk",
-            tuple((kp.index, kp.kind, kp.asc, kp.rank_slot) for kp in key_plans),
+            tuple(
+                (kp.index, kp.kind, kp.asc, kp.rank_slot, kp.width)
+                for kp in key_plans
+            ),
         )
         return cached_kernel(key, lambda: _TopKCore(list(key_plans)))
+
+    # -- single-key score image (device, traced) --
+    # base-score ladder, higher = better: real values > NaN values >
+    # live NULL-key rows > padding/empty slots.  Real base scores fit
+    # 34 signed bits (f32 bit-images and <=32-bit int complements fit
+    # 33; string ranks fit 31), so the ladder constants sit safely
+    # below them and the per-batch index tiebreak fits alongside in
+    # int64.
+    _NAN_BASE = -(1 << 34)
+    _NULL_BASE = -(1 << 34) - 1
+    _DEAD_BASE = -(1 << 34) - 2
+
+    def _score(self, v, valid, row_mask, rank_tables):
+        kp = self._key_plans[0]
+        if kp.kind == "f":  # float32 only (see eligibility note)
+            b = jax.lax.bitcast_convert_type(
+                v.astype(jnp.float32), jnp.int32
+            )
+            # monotone unsigned image in [0, 2^32): negatives flip to
+            # [0, 2^31), positives shift ABOVE them (sign-magnitude ->
+            # total order; the naive where(b>=0, b, ~b) overlaps signs)
+            img = jnp.where(
+                b >= 0,
+                b.astype(jnp.int64) + jnp.int64(1 << 31),
+                (~b).astype(jnp.int64),
+            )
+            score = ~img if kp.asc else img
+            score = jnp.where(jnp.isnan(v), jnp.int64(self._NAN_BASE), score)
+        elif kp.kind == "str":
+            table = rank_tables[kp.rank_slot]
+            cap = table.shape[0]
+            rank = table[jnp.clip(v.astype(jnp.int32), 0, cap - 1)].astype(
+                jnp.int64
+            )
+            score = ~rank if kp.asc else rank
+        else:  # "i", width <= 32
+            k64 = v.astype(jnp.int64)
+            score = ~k64 if kp.asc else k64
+        if valid is not None:
+            score = jnp.where(valid, score, jnp.int64(self._NULL_BASE))
+        return jnp.where(row_mask, score, jnp.int64(self._DEAD_BASE))
+
+    def _topk1_kernel(self, k, state, cols, valids, mask, num_rows, rank_tables):
+        """Single-key merge: `lax.top_k` picks the batch's kb best rows,
+        then a tiny 2*kb-row stable sort merges them with the carried
+        state.  `top_k` tie order is backend-defined, so the row index
+        rides in the score's low bits — earlier rows strictly outrank
+        later equal-key rows on every backend; the carried state stores
+        only the base score (index bits are per-batch)."""
+        capacity = cols[0].shape[0]
+        shift = max(capacity - 1, 1).bit_length()
+        assert shift <= 27, "batch capacity too large for the score image"
+        row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if mask is not None:
+            row_mask = row_mask & mask
+        kp = self._key_plans[0]
+        base = self._score(cols[kp.index], valids[kp.index], row_mask,
+                           rank_tables)
+        idx_bits = jnp.int64(capacity - 1) - jnp.arange(capacity, dtype=jnp.int64)
+        full = base * jnp.int64(1 << shift) + idx_bits
+        cs, ci = lax.top_k(full, k)
+        cand_base = cs >> shift  # arithmetic shift recovers the base
+        cand_live = row_mask[ci]
+
+        skeys, slive, svals, svalid = state
+        all_score = jnp.concatenate([skeys[0], cand_base])
+        all_live = jnp.concatenate([slive, cand_live])
+        iota = jnp.arange(2 * k, dtype=jnp.int32)
+        out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
+        perm = out[1][:k]
+
+        new_score = all_score[perm]
+        new_live = all_live[perm]
+        new_vals = tuple(
+            jnp.concatenate([sv, c[ci]])[perm] for sv, c in zip(svals, cols)
+        )
+        new_valid = tuple(
+            jnp.concatenate(
+                [sb, (row_mask if v is None else (v & row_mask))[ci]]
+            )[perm]
+            for sb, v in zip(svalid, valids)
+        )
+        return (new_score,), new_live, new_vals, new_valid
 
     # -- shared key transform (device, traced) --
     def _device_keys(self, cols, valids, mask, capacity, rank_tables):
@@ -230,13 +339,16 @@ class SortRelation(Relation):
             kind = f.data_type.np_dtype.kind
             if kind == "O":
                 raise NotSupportedError("struct columns cannot be ORDER BY keys")
-            if kind == "u" and f.data_type.width == 64:
+            width = f.data_type.width
+            if kind == "u" and width == 64:
                 kind = "u64"
             elif kind in ("b", "i", "u"):
+                # unsigned 32-bit needs 33 bits as a signed image
+                width = width + 1 if kind == "u" else width
                 kind = "i"
             else:
                 kind = "f"
-            self._key_plans.append(_KeyPlan(idx, kind, se.asc, None))
+            self._key_plans.append(_KeyPlan(idx, kind, se.asc, None, width))
         # TopK state capacity bucketed to a power of two (floor 128):
         # every LIMIT in a bucket shares one compiled kernel per batch
         # shape — compiles are the expensive resource on remote devices
@@ -251,6 +363,15 @@ class SortRelation(Relation):
         return self._schema
 
     def _topk_init(self, k, in_schema):
+        if self.core.single:
+            # empty slots carry the dead-sentinel base score (lose always)
+            keys = [jnp.full(k, _TopKCore._DEAD_BASE, jnp.int64)]
+            vals = tuple(
+                jnp.zeros(k, in_schema.field(i).data_type.np_dtype)
+                for i in range(len(in_schema))
+            )
+            valid = tuple(jnp.zeros(k, bool) for _ in range(len(in_schema)))
+            return tuple(keys), jnp.zeros(k, bool), vals, valid
         keys = []
         for kp in self._key_plans:
             keys.append(jnp.ones(k, bool))  # dead flag: empty slots last

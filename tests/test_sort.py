@@ -329,3 +329,58 @@ class TestOrderByHiddenColumn:
         ctx = _ctx_with("t", schema, [np.array([3, 1, 2], dtype=np.int64)])
         t = ctx.sql_collect("SELECT v AS w FROM t ORDER BY w")
         assert t.column_values(0) == [1, 2, 3]
+
+
+class TestSingleKeyFastPath:
+    """Single-key TopK rides lax.top_k with an exact int64 score image
+    (floats, ints <= 32 bits, strings); results must match the general
+    sort path exactly."""
+
+    @pytest.mark.parametrize(
+        "dtype,lo,hi",
+        [(np.int32, -(2**31), 2**31 - 1), (np.int16, -100, 100),
+         (np.uint32, 0, 2**32 - 1)],
+    )
+    def test_small_int_keys(self, dtype, lo, hi):
+        rng = np.random.default_rng(3)
+        v = rng.integers(lo, hi, 5000, dtype=dtype)
+        v[0], v[1] = lo, hi  # extremes must survive
+        valid = np.ones(5000, bool)
+        valid[2::11] = False
+        dt = {np.int32: DataType.INT32, np.int16: DataType.INT16,
+              np.uint32: DataType.UINT32}[dtype]
+        schema = Schema([Field("v", dt, True)])
+        ctx = _ctx_with("t", schema, [v], valids=[valid], batch_rows=1024)
+        for order, rev in (("", False), (" DESC", True)):
+            t = ctx.sql_collect(f"SELECT v FROM t ORDER BY v{order} LIMIT 40")
+            want = sorted(v[valid].tolist(), reverse=rev)[:40]
+            assert t.column_values(0) == want, (dtype, order)
+
+    def test_float_extremes_and_ties(self):
+        # float32: the fast-path-eligible float width
+        rng = np.random.default_rng(4)
+        v = np.round(rng.uniform(-1e6, 1e6, 20000), 2).astype(np.float32)
+        v[5], v[6], v[7] = np.inf, -np.inf, v[8]  # dupes + infinities
+        # small-magnitude mixed signs: the region where a naive
+        # sign-flip bit image breaks monotonicity
+        v[100:120] = np.linspace(-1.5, 1.5, 20, dtype=np.float32)
+        v[120], v[121] = -0.0, 0.0
+        valid = rng.random(20000) > 0.05
+        schema = Schema([Field("v", DataType.FLOAT32, True)])
+        ctx = _ctx_with("t", schema, [v], valids=[valid], batch_rows=4096)
+        for order, rev in (("", False), (" DESC", True)):
+            t = ctx.sql_collect(f"SELECT v FROM t ORDER BY v{order} LIMIT 100")
+            want = sorted(v[valid].tolist(), reverse=rev)[:100]
+            np.testing.assert_array_equal(
+                np.asarray(t.column_values(0)), np.asarray(want), err_msg=order
+            )
+
+    def test_limit_exceeds_live_rows(self):
+        # dead sentinel slots must not displace real NULL-key rows
+        # (FLOAT32: fast-path eligible, so this pins the score ladder)
+        schema = Schema([Field("v", DataType.FLOAT32, True)])
+        vals = np.array([3.5, 1.25, 2.0, 0.0, 9.0])
+        valid = np.array([True, True, True, False, False])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid])
+        t = ctx.sql_collect("SELECT v FROM t ORDER BY v LIMIT 5")
+        assert t.column_values(0) == [1.25, 2.0, 3.5, None, None]
