@@ -237,3 +237,20 @@ class TestProfilerTrace:
         for root, _dirs, files in os.walk(out_dir):
             found.extend(files)
         assert found, "profiler produced no trace files"
+
+
+class TestReferenceBenches:
+    def test_runs_and_reports_all_five_targets(self, capsys):
+        # the reference's commented-out bench list, revived
+        # (/root/reference/Cargo.toml:50-68)
+        import json
+
+        from benchmarks.reference_benches import main
+
+        main()
+        out = json.loads(capsys.readouterr().out.strip())
+        assert set(out) == {
+            "read_csv_ms", "filter_primitive_ms", "sql_ms",
+            "dataframe_ms", "udf_udt_ms",
+        }
+        assert all(v > 0 for v in out.values())
