@@ -50,9 +50,14 @@ def schema_fingerprint(schema) -> tuple:
 
 
 def functions_fingerprint(functions) -> tuple:
-    """Hashable image of a UDF registry: jax lowerings are keyed by
-    identity (two contexts registering the same function object share
-    kernels; different lowerings never collide)."""
+    """Hashable image of a UDF registry: jax lowerings are keyed by the
+    function objects themselves (two contexts registering the same
+    function object share kernels; different lowerings never collide).
+    The objects ride in the registry key — NOT `id(fn)`, whose address
+    can be reused by a new function after the old one is collected,
+    silently dispatching a stale kernel."""
     if not functions:
         return ()
-    return tuple(sorted((name, id(fn)) for name, fn in functions.items()))
+    return tuple(
+        sorted(functions.items(), key=lambda kv: kv[0])
+    )

@@ -201,14 +201,17 @@ class _TopKCore:
                            rank_tables)
         idx_bits = jnp.int64(capacity - 1) - jnp.arange(capacity, dtype=jnp.int64)
         full = base * jnp.int64(1 << shift) + idx_bits
-        cs, ci = lax.top_k(full, k)
+        # top_k requires k <= capacity: small batches contribute only
+        # their kk rows — the merge below works on any k + kk >= k
+        kk = min(k, capacity)
+        cs, ci = lax.top_k(full, kk)
         cand_base = cs >> shift  # arithmetic shift recovers the base
         cand_live = row_mask[ci]
 
         skeys, slive, svals, svalid = state
         all_score = jnp.concatenate([skeys[0], cand_base])
         all_live = jnp.concatenate([slive, cand_live])
-        iota = jnp.arange(2 * k, dtype=jnp.int32)
+        iota = jnp.arange(k + kk, dtype=jnp.int32)
         out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
         perm = out[1][:k]
 

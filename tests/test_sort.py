@@ -375,6 +375,19 @@ class TestSingleKeyFastPath:
                 np.asarray(t.column_values(0)), np.asarray(want), err_msg=order
             )
 
+    def test_limit_exceeds_batch_capacity(self):
+        # LIMIT (bucketed to k=2048) > the 1024-row batch capacity:
+        # lax.top_k(full, k) would demand k <= capacity and crash; the
+        # kernel must clamp its per-batch pick and pad with dead slots
+        rng = np.random.default_rng(9)
+        v = rng.permutation(5000).astype(np.int32)
+        schema = Schema([Field("v", DataType.INT32, False)])
+        ctx = _ctx_with("t", schema, [v], batch_rows=1000)
+        t = ctx.sql_collect("SELECT v FROM t ORDER BY v LIMIT 2000")
+        assert t.column_values(0) == list(range(2000))
+        t = ctx.sql_collect("SELECT v FROM t ORDER BY v DESC LIMIT 2000")
+        assert t.column_values(0) == list(range(4999, 2999, -1))
+
     def test_limit_exceeds_live_rows(self):
         # dead sentinel slots must not displace real NULL-key rows
         # (FLOAT32: fast-path eligible, so this pins the score ladder)
