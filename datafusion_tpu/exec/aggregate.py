@@ -68,6 +68,9 @@ from datafusion_tpu.utils.retry import device_call
 
 DENSE_GROUP_MAX = 64
 
+# widen narrow wire-format group ids back to int32 on device
+_WIDEN_IDS_JIT = jax.jit(lambda w: w.astype(jnp.int32))
+
 
 def group_capacity(n: int) -> int:
     """Accumulator capacity: next power of two, floor 8.  Kept tight
@@ -95,28 +98,42 @@ class GroupKeyEncoder:
     encoding stays numpy-speed at 10^6 groups.
     """
 
+    # radix-LUT fast path bound: product of per-component radices must
+    # keep the id lookup table at most this many entries (16 MB int32)
+    _LUT_MAX = 1 << 22
+
     def __init__(self, num_keys: int):
         self.num_keys = num_keys
         k = max(2 * num_keys, 1)
         self._arr = np.empty((0, k), dtype=np.int64)  # key rows by group id
         self._sorted_rows = _row_bytes_view(self._arr)  # sorted row view
         self._sorted_ids = np.empty(0, dtype=np.int64)
+        # radix-LUT fast path (small non-negative key spaces: dictionary
+        # codes, low-cardinality ints): encode = one gather instead of a
+        # per-batch sort.  Disabled permanently on the first batch whose
+        # key space can't be packed small (negatives / wide ranges).
+        self._fast = True
+        self._radix: Optional[list[int]] = None
+        self._lut: Optional[np.ndarray] = None
 
     @property
     def num_groups(self) -> int:
         return len(self._arr)
 
     @staticmethod
-    def _to_int64(c: np.ndarray) -> np.ndarray:
-        """Lossless int64 image of a key column.  Floats are *bit-cast*
+    def _to_int_image(c: np.ndarray) -> np.ndarray:
+        """Lossless integer image of a key column.  Floats are *bit-cast*
         (a value cast would merge 1.5 and 1.7); -0.0 normalizes to 0.0
-        and NaNs to one canonical NaN so SQL equality groups them."""
+        and NaNs to one canonical NaN so SQL equality groups them.
+        Integer columns keep their native width (packing upcasts)."""
         if c.dtype.kind == "f":
             c = c.astype(np.float64)
             c = np.where(c == 0.0, 0.0, c)  # -0.0 == 0.0
             c = np.where(np.isnan(c), np.float64(np.nan), c)
             return c.view(np.int64)
-        return c.astype(np.int64)
+        if c.dtype.kind == "b":
+            return c.astype(np.int8)
+        return c
 
     def encode(
         self,
@@ -131,16 +148,33 @@ class GroupKeyEncoder:
         """
         if key_cols and len(key_cols[0]) == 0:
             return np.empty(0, dtype=np.int32)  # _pack can't reduce empty
-        rows = []
+        # components: (value, isnull) per key.  None stands for an
+        # all-zero component (no nulls) — the fast path skips it and the
+        # general path materializes zeros.  Values keep their native
+        # integer width here; packing/stacking upcasts as needed.
+        comps: list[Optional[np.ndarray]] = []
+        n = len(key_cols[0]) if key_cols else 0
         for c, v in zip(key_cols, key_valids):
-            c = self._to_int64(np.asarray(c))
+            c = self._to_int_image(np.asarray(c))
             if v is None:
-                rows.append(c)
-                rows.append(np.zeros(len(c), dtype=np.int64))
+                comps.append(c)
+                comps.append(None)
             else:
                 v = np.asarray(v)
-                rows.append(np.where(v, c, np.int64(0)))
-                rows.append((~v).astype(np.int64))
+                comps.append(np.where(v, c, 0))
+                comps.append(~v)
+        if self._fast:
+            ids = self._encode_fast(comps, n)
+            if ids is not None:
+                return ids
+            # the key space just outgrew the LUT: fall through to the
+            # general path for this and every later batch (ids assigned
+            # so far stay valid — _arr is shared between both paths)
+            self._rebuild_sorted()
+        rows = [
+            np.zeros(n, dtype=np.int64) if c is None else c.astype(np.int64)
+            for c in comps
+        ]
         stacked = np.stack(rows, axis=1)  # (n, 2K)
         # Fast path: pack the key tuple into one int64 (mixed radix), so
         # per-batch uniquing is a single 1-D sort; the pack is per-batch
@@ -198,6 +232,103 @@ class GroupKeyEncoder:
         for k in range(stacked.shape[1]):
             packed = packed * np.int64(ranges[k]) + (stacked[:, k] - np.int64(mins[k]))
         return packed
+
+    def _encode_fast(self, comps, n: int) -> Optional[np.ndarray]:
+        """Radix-LUT encode: pack each key tuple into a small int64 with
+        FIXED per-component radices (stable across batches, unlike
+        `_pack`'s per-batch ranges) and look ids up in a dense table —
+        one gather per batch instead of a sort.  Returns None —
+        permanently disabling the path — when the key space has
+        negatives or would need a LUT past _LUT_MAX."""
+        maxs = []
+        for c in comps:
+            if c is None:
+                maxs.append(0)
+                continue
+            if c.dtype.kind == "b":
+                maxs.append(1)
+                continue
+            lo, hi = int(c.min()), int(c.max())
+            if lo < 0:
+                self._fast = False
+                return None
+            maxs.append(hi)
+        if self._radix is None or any(
+            mx >= r for mx, r in zip(maxs, self._radix)
+        ):
+            # (re)choose radices: next power of two above the observed
+            # max, doubled for growth headroom (string dictionaries keep
+            # appending codes); rebuild the LUT from the known groups
+            radix = []
+            for k, mx in enumerate(maxs):
+                seen = mx
+                if len(self._arr):
+                    seen = max(seen, int(self._arr[:, k].max()))
+                if seen == 0:
+                    radix.append(1)
+                    continue
+                r = 1
+                while r <= seen:
+                    r <<= 1
+                radix.append(r * 2)
+            total = 1
+            for r in radix:
+                total *= r
+                if total > self._LUT_MAX:
+                    self._fast = False
+                    return None
+            self._radix = radix
+            self._lut = np.full(total, -1, dtype=np.int32)
+            if len(self._arr):
+                self._lut[self._pack_rows(self._arr)] = np.arange(
+                    len(self._arr), dtype=np.int32
+                )
+        packed = self._pack_comps(comps, n)
+        ids = self._lut[packed]
+        if (ids < 0).any():
+            new_packed = np.unique(packed[ids < 0])
+            self._lut[new_packed] = np.arange(
+                self.num_groups, self.num_groups + len(new_packed), dtype=np.int32
+            )
+            self._arr = np.concatenate([self._arr, self._unpack_fixed(new_packed)])
+            ids = self._lut[packed]
+        return ids.astype(np.int32, copy=False)
+
+    def _pack_comps(self, comps, n: int) -> np.ndarray:
+        """Horner pack of per-component arrays (None = zeros) with the
+        fixed radices; int64 throughout (ranges proven < _LUT_MAX)."""
+        packed = np.zeros(n, dtype=np.int64)
+        for c, r in zip(comps, self._radix):
+            if r == 1:
+                continue  # radix 1 => component is globally all-zero
+            packed *= np.int64(r)
+            if c is not None:
+                if c.dtype != np.int64:
+                    c = c.astype(np.int64)
+                packed += c
+        return packed
+
+    def _pack_rows(self, rows2d: np.ndarray) -> np.ndarray:
+        packed = np.zeros(rows2d.shape[0], dtype=np.int64)
+        for k, r in enumerate(self._radix):
+            packed = packed * np.int64(r) + rows2d[:, k]
+        return packed
+
+    def _unpack_fixed(self, packed: np.ndarray) -> np.ndarray:
+        out = np.empty((len(packed), len(self._radix)), dtype=np.int64)
+        rest = packed.copy()
+        for k in range(len(self._radix) - 1, -1, -1):
+            out[:, k] = rest % self._radix[k]
+            rest //= self._radix[k]
+        return out
+
+    def _rebuild_sorted(self):
+        """Reconstruct the general path's sorted row view from `_arr`
+        after the fast path retires (its inserts never ran)."""
+        view = _row_bytes_view(self._arr)
+        order = np.argsort(view, kind="stable")
+        self._sorted_rows = view[order]
+        self._sorted_ids = order.astype(np.int64)
 
     def key_column(self, k: int):
         """(values, validity) of key position k across all groups, in
@@ -772,11 +903,36 @@ class AggregateRelation(Relation):
         collectives; single-device mode finalizes it directly.
         """
         from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_prefetch
         from datafusion_tpu.exec.relation import device_scope
+
+        batches = self.child.batches()
+        if pipeline_enabled(self.device):
+            # producer thread runs all host prep for batch N+1 (group-id
+            # encode, aux tables, wire encode + H2D dispatch) while the
+            # consumer below dispatches batch N's kernel; results land
+            # in batch.cache / relation caches and are re-read as hits
+            def _stage(b):
+                self._group_ids(b)
+                # pin the aux tables computed NOW on the batch: global
+                # dictionaries keep growing while later batches parse,
+                # so a consumer-side recompute could see a bigger table
+                # (correct, but a fresh padded shape => kernel recompile).
+                # The owning core rides in the entry (like group_ids'
+                # encoder pin) so another relation on the same long-
+                # lived batch can never consume this one's aux.
+                b.cache["staged_aux"] = (
+                    self.core,
+                    tuple(compute_aux_values(self._aux_specs, b, self._aux_cache)),
+                    self._compute_str_aux(b),
+                )
+                device_inputs(b, self.device)
+
+            batches = staged_prefetch(batches, _stage)
 
         state = None
         capacity = 0
-        for batch in self.child.batches():
+        for batch in batches:
             for idx in self.key_cols:
                 if batch.dicts[idx] is not None:
                     self._key_dicts[idx] = batch.dicts[idx]
@@ -788,8 +944,12 @@ class AggregateRelation(Relation):
             elif needed > capacity:
                 state = self._grow_state(state, needed)
                 capacity = needed
-            aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
-            str_aux = self._compute_str_aux(batch)
+            staged = batch.cache.get("staged_aux")
+            if staged is not None and staged[0] is self.core:
+                _, aux, str_aux = staged
+            else:
+                aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
+                str_aux = self._compute_str_aux(batch)
             with METRICS.timer("execute.aggregate"), device_scope(self.device):
                 data, validity, mask = device_inputs(batch, self.device)
                 state = device_call(
@@ -827,10 +987,24 @@ class AggregateRelation(Relation):
             ids_np = self.encoder.encode(key_cols, key_valids)
         else:
             ids_np = np.zeros(batch.capacity, dtype=np.int32)
-        ids = (
-            jax.device_put(ids_np, self.device)
+        # ship ids in the narrowest width that holds the group count and
+        # widen on device (H2D bytes 4x/2x smaller for the common small-
+        # cardinality GROUP BY)
+        wire = ids_np
+        n_groups = self.encoder.num_groups
+        if n_groups <= 127:
+            wire = ids_np.astype(np.int8)
+        elif n_groups <= 32767:
+            wire = ids_np.astype(np.int16)
+        dev_wire = (
+            jax.device_put(wire, self.device)
             if self.device is not None
-            else jnp.asarray(ids_np)
+            else jnp.asarray(wire)
+        )
+        ids = (
+            dev_wire
+            if wire.dtype == np.int32
+            else _WIDEN_IDS_JIT(dev_wire)
         )
         batch.cache["group_ids"] = (self.encoder, ids)
         return ids

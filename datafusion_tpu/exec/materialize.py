@@ -40,6 +40,44 @@ def _on_device(a) -> bool:
     return hasattr(a, "copy_to_host_async")
 
 
+_PACKBITS_JIT = None
+
+
+def _start_mask_pull(batch) -> None:
+    """Begin a device mask's trip to host: pack the bool mask to bits
+    on device (8x fewer bytes over the link) and start the async copy.
+    The packed array is cached on the batch for _fetch_mask."""
+    global _PACKBITS_JIT
+    m = batch.mask
+    if m is None or not _on_device(m) or "packed_mask" in batch.cache:
+        return
+    if m.shape[0] % 8:
+        m.copy_to_host_async()
+        return
+    if _PACKBITS_JIT is None:
+        import jax
+        import jax.numpy as jnp
+
+        def pack(mask):
+            bits = mask.reshape(-1, 8).astype(jnp.uint8)
+            weights = jnp.asarray([128, 64, 32, 16, 8, 4, 2, 1], jnp.uint8)
+            return (bits * weights[None, :]).sum(axis=1, dtype=jnp.uint8)
+
+        _PACKBITS_JIT = jax.jit(pack)
+    packed = _PACKBITS_JIT(m)
+    packed.copy_to_host_async()
+    batch.cache["packed_mask"] = packed
+
+
+def _fetch_mask(batch) -> np.ndarray:
+    """Host bool mask for a batch (blocking), via the packed-bits copy
+    when _start_mask_pull staged one."""
+    packed = batch.cache.get("packed_mask")
+    if packed is not None:
+        return np.unpackbits(np.asarray(packed)).astype(bool)
+    return np.asarray(batch.mask)
+
+
 def iter_with_mask_prefetch(batches):
     """Iterate batches one ahead, starting each batch's mask D2H copy
     as soon as the batch exists: pulling batch N+1 dispatches its
@@ -52,8 +90,8 @@ def iter_with_mask_prefetch(batches):
 
     pending: deque = deque()
     for b in batches:
-        if b.mask is not None and hasattr(b.mask, "copy_to_host_async"):
-            b.mask.copy_to_host_async()
+        if b.mask is not None and _on_device(b.mask):
+            _start_mask_pull(b)
         pending.append(b)
         if len(pending) > 1:
             yield pending.popleft()
@@ -61,21 +99,64 @@ def iter_with_mask_prefetch(batches):
         yield pending.popleft()
 
 
-def compact_batch(batch: RecordBatch):
-    """Bring a batch to host and drop padding/filtered rows.
+class _PendingCompact:
+    """In-flight batch materialization: device->host copies dispatched,
+    not yet awaited.  `resolve()` blocks on the transfers and assembles
+    host columns — callers keep one of these per in-flight batch so the
+    link transfer overlaps the next batch's parse/compute instead of
+    serializing after it."""
 
-    Returns (columns, validity, dicts, num_live_rows); strings stay
-    dictionary-coded.  Selection masks compact *on device* when that
-    meaningfully shrinks the transfer (the reference gathers per column
-    on the host per batch, `filter.rs:80-111`; here the gather is one
-    fused device kernel and only live rows cross the link).
-    """
+    __slots__ = ("batch", "live", "compacted", "dev_pos", "dev_arrays", "count")
+
+    def __init__(self, batch, live, compacted, dev_pos, dev_arrays, count):
+        self.batch = batch
+        self.live = live
+        self.compacted = compacted
+        self.dev_pos = dev_pos
+        self.dev_arrays = dev_arrays
+        self.count = count
+
+    def resolve(self):
+        batch, live, n = self.batch, self.live, self.batch.num_rows
+        pulled: dict[tuple[str, int], np.ndarray] = {}
+        with METRICS.timer("d2h.wait"):
+            for pos, a in zip(self.dev_pos, self.dev_arrays):
+                a = np.asarray(a)
+                pulled[pos] = a[: self.count] if self.compacted else a
+
+        def select(kind, i, a):
+            hit = pulled.get((kind, i))
+            if hit is not None:
+                if self.compacted:
+                    return hit  # already gathered to the live rows
+                a = hit
+            else:
+                a = np.asarray(a)
+            if live is not None:
+                return a[live]
+            return a[:n]
+
+        cols = []
+        valids = []
+        for i in range(batch.num_columns):
+            cols.append(select("col", i, batch.data[i]))
+            v = batch.validity[i]
+            valids.append(None if v is None else select("val", i, v))
+        count = int(live.sum()) if live is not None else n
+        return cols, valids, list(batch.dicts), count
+
+
+def compact_dispatch(batch: RecordBatch) -> _PendingCompact:
+    """Start bringing a batch to host: decide compaction, dispatch the
+    device gather, and begin every D2H copy asynchronously.  Blocks only
+    on the selection mask (one small transfer, usually prefetched by
+    `iter_with_mask_prefetch`)."""
     n = batch.num_rows
     live: Optional[np.ndarray] = None
     if batch.mask is not None:
         if _on_device(batch.mask):
-            batch.mask.copy_to_host_async()
-        live = np.asarray(batch.mask)[: batch.capacity]
+            _start_mask_pull(batch)
+        live = _fetch_mask(batch)[: batch.capacity]
         live = live & (np.arange(batch.capacity) < n)
 
     # arrays already resident on device ((position-kind, index) pairs);
@@ -92,11 +173,10 @@ def compact_batch(batch: RecordBatch):
             dev_pos.append(("val", i))
             dev_arrays.append(v)
 
-    pulled: dict[tuple[str, int], np.ndarray] = {}
     compacted = False
+    count = int(live.sum()) if live is not None else n
     if live is not None and dev_arrays:
         idx = np.nonzero(live)[0]
-        count = len(idx)
         cap_out = bucket_capacity(max(count, 1))
         if cap_out * _COMPACT_FACTOR <= batch.capacity:
             import jax.numpy as jnp
@@ -104,41 +184,28 @@ def compact_batch(batch: RecordBatch):
             padded = np.zeros(cap_out, np.int32)
             padded[:count] = idx
             with METRICS.timer("d2h.compact"):
-                gathered = _gather_compact(tuple(dev_arrays), jnp.asarray(padded))
-                for g in gathered:
-                    g.copy_to_host_async()
-                for pos, g in zip(dev_pos, gathered):
-                    pulled[pos] = np.asarray(g)[:count]
+                dev_arrays = list(
+                    _gather_compact(tuple(dev_arrays), jnp.asarray(padded))
+                )
             METRICS.add("d2h.compacted_batches")
             compacted = True
-    if not compacted and dev_arrays:
-        # overlap D2H latencies: start all copies before the first
-        # blocking np.asarray (matters on tunneled/remote devices)
-        for a in dev_arrays:
-            a.copy_to_host_async()
-        for pos, a in zip(dev_pos, dev_arrays):
-            pulled[pos] = np.asarray(a)
+    # overlap D2H latencies: start all copies now; resolve() blocks later
+    for a in dev_arrays:
+        a.copy_to_host_async()
+    return _PendingCompact(batch, live, compacted, dev_pos, dev_arrays, count)
 
-    def select(kind, i, a):
-        hit = pulled.get((kind, i))
-        if hit is not None:
-            if compacted:
-                return hit  # already gathered to the live rows
-            a = hit
-        else:
-            a = np.asarray(a)
-        if live is not None:
-            return a[live]
-        return a[:n]
 
-    cols = []
-    valids = []
-    for i in range(batch.num_columns):
-        cols.append(select("col", i, batch.data[i]))
-        v = batch.validity[i]
-        valids.append(None if v is None else select("val", i, v))
-    count = int(live.sum()) if live is not None else n
-    return cols, valids, list(batch.dicts), count
+def compact_batch(batch: RecordBatch):
+    """Bring a batch to host and drop padding/filtered rows.
+
+    Returns (columns, validity, dicts, num_live_rows); strings stay
+    dictionary-coded.  Selection masks compact *on device* when that
+    meaningfully shrinks the transfer (the reference gathers per column
+    on the host per batch, `filter.rs:80-111`; here the gather is one
+    fused device kernel and only live rows cross the link).  The
+    synchronous convenience form of compact_dispatch().resolve().
+    """
+    return compact_dispatch(batch).resolve()
 
 
 class ResultTable:
@@ -218,9 +285,9 @@ def collect_columns(relation):
     any_null = [False] * ncols
     total = 0
 
-    def consume(batch):
+    def consume(pending_compact):
         nonlocal total
-        cols, valids, bdicts, n = compact_batch(batch)
+        cols, valids, bdicts, n = pending_compact.resolve()
         if n == 0:
             return
         total += n
@@ -234,9 +301,18 @@ def collect_columns(relation):
 
     # shallow pipeline: overlap batch N+1's kernel dispatch + mask D2H
     # with batch N's transfers instead of ping-ponging on a
-    # high-latency link
+    # high-latency link; resolve (the blocking D2H wait) runs one batch
+    # behind dispatch so the link transfer overlaps the next batch's
+    # parse + compute
+    from collections import deque
+
+    pending: deque = deque()
     for batch in iter_with_mask_prefetch(relation.batches()):
-        consume(batch)
+        pending.append(compact_dispatch(batch))
+        if len(pending) > 1:
+            consume(pending.popleft())
+    while pending:
+        consume(pending.popleft())
     columns = []
     validity: list[Optional[np.ndarray]] = []
     for i in range(ncols):

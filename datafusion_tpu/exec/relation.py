@@ -230,13 +230,38 @@ class PipelineRelation(Relation):
 
     def batches(self) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_prefetch
 
         core = self.core
-        for batch in self.child.batches():
+        batches = self.child.batches()
+        if core.needs_kernel and pipeline_enabled(self.device):
+            # host prep for batch N+1 (aux tables, wire encode, H2D
+            # dispatch) runs on the producer thread while batch N's
+            # kernel dispatches below; aux is pinned on the batch so the
+            # consumer can't see a later (grown) dictionary version
+            def _stage(b):
+                # owning core pinned in the entry so no other relation
+                # on a shared batch can consume this aux (see the
+                # group_ids encoder pin in aggregate.py)
+                b.cache["staged_aux"] = (
+                    core,
+                    tuple(compute_aux_values(core.aux_specs, b, self._aux_cache)),
+                )
+                device_inputs(self._subset_view(b), self.device)
+
+            batches = staged_prefetch(batches, _stage)
+
+        for batch in batches:
             if not core.needs_kernel:
                 cols, valids, mask = [], [], batch.mask
             else:
-                aux = compute_aux_values(core.aux_specs, batch, self._aux_cache)
+                staged = batch.cache.get("staged_aux")
+                if staged is not None and staged[0] is core:
+                    aux = staged[1]
+                else:
+                    aux = tuple(
+                        compute_aux_values(core.aux_specs, batch, self._aux_cache)
+                    )
                 with METRICS.timer("execute.pipeline"), device_scope(self.device):
                     data, validity, mask_in = device_inputs(
                         self._subset_view(batch), self.device
@@ -245,7 +270,7 @@ class PipelineRelation(Relation):
                         core.jit,
                         data,
                         validity,
-                        tuple(aux),
+                        aux,
                         np.int32(batch.num_rows),
                         mask_in,
                     )

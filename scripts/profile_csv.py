@@ -1,0 +1,117 @@
+"""Profile config 1 (CSV scan+filter+project) cold path on the device.
+
+Run: python scripts/profile_csv.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+
+    # D2H latency vs bandwidth curve
+    for nbytes in (4096, 1 << 20, 8 << 20, 32 << 20):
+        a = np.random.default_rng(0).random(nbytes // 8)
+        d = jax.device_put(a, dev)
+        d.block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(3):
+            _ = np.asarray(d)
+        dt = (time.perf_counter() - t0) / 3
+        print(f"D2H {nbytes/1e6:8.3f} MB: {dt*1e3:8.1f} ms  ({nbytes/1e6/dt:6.1f} MB/s)",
+              flush=True)
+
+    from benchmarks import data as bdata
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.utils.metrics import METRICS
+
+    rows = 2_000_000
+    path = bdata.cities_csv(rows)
+    schema = Schema(
+        [
+            Field("city", DataType.UTF8, False),
+            Field("lat", DataType.FLOAT64, False),
+            Field("lng", DataType.FLOAT64, False),
+        ]
+    )
+    sql = "SELECT city, lat, lng, lat + lng FROM cities WHERE lat > 51.0 AND lat < 53.0"
+
+    def cold(device=None):
+        ctx = ExecutionContext(device=device, batch_size=1 << 19)
+        ctx.register_csv("cities", path, schema, has_header=True)
+        return collect(ctx.sql(sql))
+
+    t0 = time.perf_counter()
+    out = cold()
+    print(f"first cold (incl compile): {time.perf_counter()-t0:.2f}s "
+          f"{out.num_rows} rows", flush=True)
+
+    # instrumented second run
+    import datafusion_tpu.exec.batch as batch_mod
+    import datafusion_tpu.exec.materialize as mat
+    import datafusion_tpu.io.readers as readers
+
+    events = []
+
+    def wrap(name, fn):
+        def inner(*a, **kw):
+            t = time.perf_counter()
+            out = fn(*a, **kw)
+            events.append((name, t, time.perf_counter()))
+            return out
+        return inner
+
+    batch_mod.device_inputs = wrap("device_inputs", batch_mod.device_inputs)
+    import datafusion_tpu.exec.relation as rel_mod
+    rel_mod.__dict__  # ensure imported
+    mat.compact_dispatch = wrap("compact_dispatch", mat.compact_dispatch)
+    real_resolve = mat._PendingCompact.resolve
+    mat._PendingCompact.resolve = wrap("compact_resolve", real_resolve)
+
+    real_batches = readers.CsvReader._batches
+
+    def timed_batches(self):
+        it = real_batches(self)
+        while True:
+            t = time.perf_counter()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            events.append(("parse", t, time.perf_counter()))
+            yield b
+
+    readers.CsvReader._batches = timed_batches
+
+    METRICS.reset()
+    t_start = time.perf_counter()
+    out = cold()
+    t_end = time.perf_counter()
+    print(f"\ninstrumented cold run: {t_end-t_start:.2f}s, {out.num_rows} rows",
+          flush=True)
+    base = t_start
+    for name, t0, t1 in sorted(events, key=lambda e: e[1]):
+        print(f"  {t0-base:7.3f}s +{(t1-t0)*1e3:8.1f}ms  {name}", flush=True)
+    sums = {}
+    for name, t0, t1 in events:
+        sums[name] = sums.get(name, 0.0) + (t1 - t0)
+    print("\nphase sums:", {k: round(v, 3) for k, v in sums.items()}, flush=True)
+    snap = METRICS.snapshot()
+    print("metrics timings:", {k: round(v, 3) for k, v in snap["timings_s"].items()},
+          flush=True)
+    print("metrics counts:", snap["counts"], flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -597,6 +597,168 @@ class TestWireCompression:
         data2, _, _ = device_inputs(batch)
         assert data2[0] is data[0]
 
+    def test_decimal_wire(self):
+        # fixed-point f64 (prices with 2 decimals) travels as int32 +
+        # static scale, halving the bytes of the biggest TPC-H column
+        import jax.numpy as jnp
+        import numpy as np
+
+        from datafusion_tpu.exec.batch import _decode_wire, _encode_wire
+
+        rng = np.random.default_rng(3)
+        a = np.round(rng.uniform(900.0, 104950.0, 4096), 2)
+        spec, wires = _encode_wire(a)
+        assert spec == ("decimal", 100)
+        assert wires[0].dtype == np.int32
+        dec = np.asarray(_decode_wire(spec, tuple(jnp.asarray(w) for w in wires)))
+        assert np.array_equal(dec.view(np.int64), a.view(np.int64))
+        # 3 decimals
+        b = np.round(rng.uniform(-1000.0, 1000.0, 4096), 3)
+        spec_b, _ = _encode_wire(b)
+        assert spec_b == ("decimal", 1000)
+        # not fixed-point: falls through to raw
+        c = rng.standard_normal(4096)
+        spec_c, _ = _encode_wire(c)
+        assert spec_c == ("raw",)
+
+    def test_decimal_wire_rejects_overflow_and_negzero(self):
+        # values >= 2^31/scale in rows the strided sample skips must NOT
+        # silently wrap through int32; -0.0 has no int32 image at all
+        import numpy as np
+
+        from datafusion_tpu.exec.batch import _encode_wire
+
+        a = np.round(np.linspace(900.0, 104950.0, 8192), 2)
+        a[1] = 50_000_000.00  # odd index: stride-2 sample misses it
+        spec, wires = _encode_wire(a)
+        if spec[0] == "decimal":
+            codes, scale = wires
+            got = codes.astype(np.float64) / scale[0]
+            assert np.array_equal(got, a)
+        else:
+            assert spec == ("raw",)
+
+        b = np.round(np.linspace(-10.0, 10.0, 4096), 2)
+        b[7] = -0.0
+        spec_b, wires_b = _encode_wire(b)
+        if spec_b[0] == "decimal":
+            codes, scale = wires_b
+            got = codes.astype(np.float64) / scale[0]
+            assert np.array_equal(got.view(np.int64), b.view(np.int64))
+        # dict codec legitimately captures -0.0 bit-exactly; decimal
+        # would have lost the sign
+
+    def test_dict_preferred_over_decimal(self):
+        # low-cardinality fixed-point (l_discount shape) must take the
+        # 1-byte dict wire, not the 4-byte decimal wire
+        import numpy as np
+
+        from datafusion_tpu.exec.batch import _encode_wire
+
+        rng = np.random.default_rng(11)
+        a = rng.integers(0, 11, 8192) / 100.0
+        spec, wires = _encode_wire(a)
+        assert spec == ("dict",)
+
+    def test_staged_aux_not_consumed_cross_relation(self, monkeypatch):
+        # two different queries over the same long-lived batches: the
+        # second must not consume the first's staged aux entries
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+        from datafusion_tpu.exec.context import ExecutionContext
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema([Field("s", DataType.UTF8, False),
+                         Field("v", DataType.FLOAT64, False)])
+        d = StringDictionary()
+        rng = np.random.default_rng(2)
+        strs = [f"k{i:03d}" for i in rng.integers(0, 40, 4096)]
+        batch = make_host_batch(
+            schema,
+            [d.encode(strs), rng.uniform(0, 1, 4096)],
+            [None, None],
+            [d, None],
+        )
+        src = MemoryDataSource(schema, [batch])
+        monkeypatch.setenv("DATAFUSION_TPU_PREFETCH", "1")
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("t", src)
+        r1 = ctx.sql_collect("SELECT s, SUM(v) FROM t WHERE s > 'k010' GROUP BY s")
+        # a different aggregate over the same batches (different core,
+        # different aux specs) — must recompute, not reuse r1's aux
+        r2 = ctx.sql_collect("SELECT s, COUNT(1) FROM t WHERE s < 'k030' GROUP BY s")
+        want = {}
+        for s in strs:
+            if s < "k030":
+                want[s] = want.get(s, 0) + 1
+        got = dict(r2.to_rows())
+        assert got == want
+        assert all(row[0] > "k010" for row in r1.to_rows())
+
+    def test_blob_vs_per_wire_parity(self, monkeypatch):
+        # the single-buffer wire format must decode identically to
+        # per-wire device_put (DATAFUSION_TPU_H2D_BLOB=0)
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import device_inputs, make_host_batch
+
+        schema = Schema(
+            [
+                Field("i", DataType.INT64, True),
+                Field("p", DataType.FLOAT64, False),
+                Field("d", DataType.FLOAT64, False),
+                Field("r", DataType.FLOAT64, False),
+            ]
+        )
+        rng = np.random.default_rng(7)
+        cols = [
+            rng.integers(-100, 100, 2048).astype(np.int64),
+            np.round(rng.uniform(900, 105000, 2048), 2),
+            rng.integers(0, 9, 2048) / 100.0,
+            rng.standard_normal(2048),
+        ]
+        valid = rng.random(2048) > 0.5
+
+        def build():
+            return make_host_batch(schema, cols, [valid, None, None, None], [None] * 4)
+
+        monkeypatch.setenv("DATAFUSION_TPU_H2D_BLOB", "1")
+        blob_data, blob_valid, _ = device_inputs(build())
+        monkeypatch.setenv("DATAFUSION_TPU_H2D_BLOB", "0")
+        per_data, per_valid, _ = device_inputs(build())
+        for g, w in zip(blob_data, per_data):
+            assert np.array_equal(
+                np.asarray(g).view(np.int64), np.asarray(w).view(np.int64)
+            )
+        assert np.array_equal(np.asarray(blob_valid[0]), np.asarray(per_valid[0]))
+
+    def test_packed_mask_pull(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import RecordBatch
+        from datafusion_tpu.exec.materialize import _fetch_mask, _start_mask_pull
+
+        rng = np.random.default_rng(9)
+        mask = rng.random(1024) > 0.4
+        schema = Schema([Field("x", DataType.INT64, False)])
+        b = RecordBatch(
+            schema,
+            [jnp.arange(1024, dtype=jnp.int64)],
+            [None],
+            [None],
+            num_rows=1000,
+            mask=jnp.asarray(mask),
+        )
+        _start_mask_pull(b)
+        assert "packed_mask" in b.cache
+        got = _fetch_mask(b)
+        assert np.array_equal(got, mask)
+
     def test_dict_wire_is_bit_exact(self):
         # -0.0 and NaN payloads survive the dictionary encoding
         # bit-for-bit (np.unique on float VALUES would collapse them)
