@@ -57,6 +57,7 @@ from datafusion_tpu.exec.batch import (
     RecordBatch,
     StringDictionary,
     bucket_capacity,
+    device_pull,
     make_host_batch,
 )
 from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
@@ -443,6 +444,18 @@ class _AggregateCore:
         self.slots = self._build_slots(compiler)
         self.aux_specs = compiler.aux_specs
         self.jit = jax.jit(self._kernel)
+        self.fused_jit = jax.jit(self._fused_kernel)
+
+    def _fused_kernel(self, chunk, state):
+        """Fold `_kernel` over a chunk of prepared batches in ONE device
+        launch.  Tunneled/remote devices charge a round trip per
+        executable launch (often 15-500 ms here), so a warm in-memory
+        scan collapses from one launch per batch to one per chunk."""
+        for cols, valids, aux, num_rows, mask, ids, str_aux in chunk:
+            state = self._kernel(
+                cols, valids, aux, num_rows, mask, ids, state, str_aux
+            )
+        return state
 
     @staticmethod
     def build(in_schema, group_expr, aggr_expr, predicate, functions):
@@ -513,11 +526,21 @@ class _AggregateCore:
         return _max_identity(sl.acc_dtype)
 
     def _init_state(self, capacity: int):
-        accs = tuple(
-            jnp.full(capacity, jnp.asarray(self._slot_identity(sl)))
-            for sl in self.slots
-        )
-        return jnp.zeros(capacity, jnp.int64), accs
+        # cached per capacity: creating the state costs one tiny device
+        # launch per slot, which a repeated query would otherwise pay
+        # every run (round trips dominate on tunneled links); states are
+        # functionally consumed, never mutated, so sharing is safe
+        cache = getattr(self, "_init_states", None)
+        if cache is None:
+            cache = self._init_states = {}
+        hit = cache.get(capacity)
+        if hit is None:
+            accs = tuple(
+                jnp.full(capacity, jnp.asarray(self._slot_identity(sl)))
+                for sl in self.slots
+            )
+            hit = cache[capacity] = (jnp.zeros(capacity, jnp.int64), accs)
+        return hit
 
     def _grow_state(self, state, new_capacity: int):
         """Dense group ids are stable: growth is identity padding."""
@@ -827,6 +850,13 @@ class AggregateRelation(Relation):
         self._key_dicts: dict[int, StringDictionary] = {}
         self._str_dicts: dict[int, StringDictionary] = {}
         self._str_aux_cache: dict = {}
+        # serializes GroupKeyEncoder mutation: normally only the staging
+        # producer encodes, but a cache-pin miss (another relation
+        # scanning the same batches overwrote the group_ids slot) makes
+        # the consumer re-encode concurrently with the producer
+        import threading
+
+        self._ids_lock = threading.Lock()
 
     # -- delegates into the shared core (the partitioned subclass and
     # the multi-host coordinator call these by name) --
@@ -930,13 +960,23 @@ class AggregateRelation(Relation):
 
             batches = staged_prefetch(batches, _stage)
 
+        from datafusion_tpu.exec.kernels import fuse_batch_count
+
+        # batches per device launch: prepared inputs accumulate host-
+        # side and dispatch as ONE fused kernel (launch round trips are
+        # the warm-path bottleneck on tunneled devices)
+        fuse = fuse_batch_count()
+
         state = None
         capacity = 0
-        for batch in batches:
-            for idx in self.key_cols:
-                if batch.dicts[idx] is not None:
-                    self._key_dicts[idx] = batch.dicts[idx]
-            ids = self._group_ids(batch)
+        chunk: list = []
+
+        def flush():
+            nonlocal state, capacity
+            if not chunk:
+                return
+            # capacity picked AFTER the whole chunk's keys are encoded,
+            # so every id in the chunk fits the accumulator
             needed = self._pick_capacity(capacity)
             if state is None:
                 capacity = needed
@@ -944,25 +984,38 @@ class AggregateRelation(Relation):
             elif needed > capacity:
                 state = self._grow_state(state, needed)
                 capacity = needed
+            with METRICS.timer("execute.aggregate"), device_scope(self.device):
+                if len(chunk) == 1:
+                    c = chunk[0]
+                    state = device_call(
+                        self._jit, c[0], c[1], c[2], c[3], c[4], c[5], state, c[6]
+                    )
+                else:
+                    state = device_call(
+                        self.core.fused_jit, tuple(chunk), state
+                    )
+            chunk.clear()
+
+        for batch in batches:
+            for idx in self.key_cols:
+                if batch.dicts[idx] is not None:
+                    self._key_dicts[idx] = batch.dicts[idx]
+            ids = self._group_ids(batch)
             staged = batch.cache.get("staged_aux")
             if staged is not None and staged[0] is self.core:
                 _, aux, str_aux = staged
             else:
                 aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
                 str_aux = self._compute_str_aux(batch)
-            with METRICS.timer("execute.aggregate"), device_scope(self.device):
+            with device_scope(self.device):
                 data, validity, mask = device_inputs(batch, self.device)
-                state = device_call(
-                    self._jit,
-                    data,
-                    validity,
-                    tuple(aux),
-                    np.int32(batch.num_rows),
-                    mask,
-                    ids,
-                    state,
-                    str_aux,
-                )
+            chunk.append(
+                (data, validity, tuple(aux), np.int32(batch.num_rows), mask,
+                 ids, str_aux)
+            )
+            if len(chunk) >= fuse:
+                flush()
+        flush()
         if state is None:
             state = self._init_state(group_capacity(1))
         return state
@@ -970,11 +1023,23 @@ class AggregateRelation(Relation):
     def _group_ids(self, batch: RecordBatch):
         """Device array of dense group ids for one batch; cached on the
         batch (keyed by this relation's encoder) so re-scanned in-memory
-        batches skip both the host encode and the H2D transfer."""
+        batches skip both the host encode and the H2D transfer.
+
+        Serialized by `_ids_lock`: the staging producer thread normally
+        does all encoding, but a pin miss (another relation's encode
+        overwrote the batch's slot) routes the consumer thread here
+        concurrently, and GroupKeyEncoder mutation is not atomic."""
         # single slot per batch (a different query's encoder overwrites
         # it) so long-lived in-memory batches hold at most one ids array,
         # not one per query ever run; the entry pins the encoder so the
         # identity check can't hit a recycled object
+        hit = batch.cache.get("group_ids")
+        if hit is not None and hit[0] is self.encoder:
+            return hit[1]
+        with self._ids_lock:
+            return self._group_ids_locked(batch)
+
+    def _group_ids_locked(self, batch: RecordBatch):
         hit = batch.cache.get("group_ids")
         if hit is not None and hit[0] is self.encoder:
             return hit[1]
@@ -1022,12 +1087,9 @@ class AggregateRelation(Relation):
         if cut < counts.shape[0]:
             counts = counts[:cut]
             accs = tuple(a[:cut] for a in accs)
-        # kick off every D2H copy concurrently before the first blocking
-        # np.asarray: on high-latency links (tunneled/remote devices) the
-        # per-transfer latencies overlap instead of serializing
-        for leaf in jax.tree.leaves((counts, accs)):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+        # ONE blob-packed transfer for the whole result state: each
+        # separate device->host copy costs a full link round trip
+        counts, accs = device_pull((counts, accs))
         counts = np.asarray(counts)
         if self.key_cols:
             live = np.nonzero(counts[:n_groups] > 0)[0]

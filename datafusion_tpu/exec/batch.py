@@ -379,7 +379,16 @@ def _blob_decode_jit(specs, layout):
     per-wire puts cost more in latency than in bytes).  `layout` is the
     static (dtype, length, from_blob) per wire; device wires pass
     through `direct` unchanged.  The device slices + bitcasts each wire
-    back out and runs the normal spec decode."""
+    back out and runs the normal spec decode.
+
+    64-bit notes (verified on the attached TPU): narrow->wide bitcasts
+    (u8 -> i64/f64) lower and execute under the X64-rewriting pass —
+    only the wide->narrow direction fails, which is why the D2H side
+    (device_pull) uses the 'split' strategy.  u8->i64 is bit-exact;
+    u8->f64 keeps only the platform's native f64 fidelity, which on
+    f32-pair-emulated backends is ~49 mantissa bits — the SAME loss a
+    plain device_put of the f64 column suffers there (measured: neither
+    roundtrips bit-exactly), so the blob does not add a loss class."""
     import jax
     from jax import lax
 
@@ -428,6 +437,212 @@ def _blob_decode_jit(specs, layout):
 # wires per spec kind (dict ships codes + value table; decimal ships
 # codes + the runtime scale scalar)
 _WIRE_COUNT = {"dict": 2, "decimal": 2}
+
+
+# ---- blob-packed D2H: one transfer for a whole result pytree ------------
+# The H2D story in reverse: tunneled links charge a round trip per
+# device->host copy, so pulling a small result as N arrays costs N RPCs.
+# Pack every leaf into one uint8 blob on device (one tiny launch), pull
+# the blob once, slice it back apart with numpy.
+
+_D2H_PACK_JITS: dict = {}
+
+# 64-bit handling per platform: XLA:TPU stores x64 values as 32-bit
+# pairs and cannot lower a 64-bit bitcast, so int64/uint64 split into
+# uint32 halves (exact) and float64 into an (f32 hi, f32 lo) pair —
+# which IS the device representation, verified by _f64_pair_exact
+# against direct pulls; platforms where the pair probe fails pull f64
+# leaves directly instead.
+_F64_PAIR_OK: dict = {}
+
+
+def _f64_pair_exact(platform) -> bool:
+    hit = _F64_PAIR_OK.get(platform)
+    if hit is None:
+        import jax
+
+        rng = np.random.default_rng(0xFACE)
+        v = np.concatenate(
+            [
+                rng.standard_normal(2048),
+                rng.standard_normal(512) * 1e300,
+                rng.standard_normal(512) * 1e-300,
+                np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 5e-324]),
+            ]
+        )
+        vd = jax.device_put(v)
+        direct = np.asarray(vd)
+        hi, lo = jax.jit(_f64_split)(vd)
+        back = _f64_join(np.asarray(hi), np.asarray(lo))
+        hit = _F64_PAIR_OK[platform] = bool(
+            np.array_equal(back, direct, equal_nan=True)
+        )
+    return hit
+
+
+def _f64_split(x):
+    import jax.numpy as jnp
+
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(jnp.float64)).astype(jnp.float32)
+    return hi, lo
+
+
+def _f64_join(hi: np.ndarray, lo: np.ndarray) -> np.ndarray:
+    hi64 = hi.astype(np.float64)
+    # inf - inf = nan in the lo half; the hi half alone is the value
+    return np.where(np.isinf(hi64), hi64, hi64 + lo.astype(np.float64))
+
+
+def _d2h_pack_jit(sig, strategy):
+    """sig: per-leaf (dtype_str, shape); strategy: 'bitcast64' (CPU —
+    native 64-bit bitcasts) or 'split' (TPU — 64-bit types travel as
+    32-bit halves)."""
+    import jax
+    from jax import lax
+    import jax.numpy as jnp
+
+    key = (sig, strategy)
+    hit = _D2H_PACK_JITS.get(key)
+    if hit is not None:
+        return hit
+
+    def to_u8(x):
+        if x.dtype == jnp.bool_:
+            return x.astype(jnp.uint8)
+        if x.dtype == jnp.uint8:
+            return x
+        return lax.bitcast_convert_type(x, jnp.uint8).reshape(-1)
+
+    def pack(leaves):
+        parts = []
+        for leaf in leaves:
+            x = leaf.reshape(-1)
+            if strategy == "split" and x.dtype in (jnp.int64, jnp.uint64):
+                u = x.astype(jnp.uint64)
+                parts.append(to_u8((u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)))
+                parts.append(to_u8((u >> jnp.uint64(32)).astype(jnp.uint32)))
+            elif strategy == "split" and x.dtype == jnp.float64:
+                hi, lo = _f64_split(x)
+                parts.append(to_u8(hi))
+                parts.append(to_u8(lo))
+            else:
+                parts.append(to_u8(x))
+        return jnp.concatenate(parts) if parts else jnp.zeros(0, jnp.uint8)
+
+    hit = _D2H_PACK_JITS[key] = jax.jit(pack)
+    return hit
+
+
+class PendingPull:
+    """An in-flight blob-packed device->host transfer.  `finish()`
+    blocks on the copy and rebuilds the original pytree with numpy
+    leaves."""
+
+    __slots__ = ("_leaves", "_treedef", "_dev_idx", "_sig", "_blob",
+                 "_strategy", "_extra_direct")
+
+    def __init__(self, leaves, treedef, dev_idx, sig, blob, strategy,
+                 extra_direct=()):
+        self._leaves = leaves
+        self._treedef = treedef
+        self._dev_idx = dev_idx
+        self._sig = sig
+        self._blob = blob
+        self._strategy = strategy
+        self._extra_direct = extra_direct
+
+    def _take(self, blob, off, np_dtype, n_elems):
+        nbytes = n_elems * np_dtype.itemsize
+        # copy: a fresh allocation is aligned for the wider view
+        return blob[off : off + nbytes].copy().view(np_dtype), off + nbytes
+
+    def finish(self):
+        import jax
+
+        out = list(self._leaves)
+        for i in self._extra_direct:
+            out[i] = np.asarray(out[i])
+        if self._blob is None:
+            for i in self._dev_idx:
+                out[i] = np.asarray(out[i])
+            return jax.tree.unflatten(self._treedef, out)
+        blob = np.asarray(self._blob)
+        off = 0
+        split = self._strategy == "split"
+        for i, (dtype_str, shape) in zip(self._dev_idx, self._sig):
+            n_elems = int(np.prod(shape, dtype=np.int64))
+            if dtype_str == "bool":
+                arr = blob[off : off + n_elems].astype(bool)
+                off += n_elems
+            elif split and dtype_str in ("int64", "uint64"):
+                lo, off = self._take(blob, off, np.dtype(np.uint32), n_elems)
+                hi, off = self._take(blob, off, np.dtype(np.uint32), n_elems)
+                arr = (
+                    (hi.astype(np.uint64) << np.uint64(32))
+                    | lo.astype(np.uint64)
+                ).view(np.dtype(dtype_str))
+            elif split and dtype_str == "float64":
+                hi, off = self._take(blob, off, np.dtype(np.float32), n_elems)
+                lo, off = self._take(blob, off, np.dtype(np.float32), n_elems)
+                arr = _f64_join(hi, lo)
+            else:
+                arr, off = self._take(blob, off, np.dtype(dtype_str), n_elems)
+            out[i] = arr.reshape(shape)
+        return jax.tree.unflatten(self._treedef, out)
+
+
+def device_pull_start(tree) -> PendingPull:
+    """Begin materializing a pytree of device arrays on host in ONE
+    transfer: pack every device leaf into a uint8 blob (one tiny device
+    launch) and start its async copy.  Host (numpy) leaves pass through
+    untouched."""
+    import jax
+
+    leaves, treedef = jax.tree.flatten(tree)
+    dev_idx = [
+        i
+        for i, leaf in enumerate(leaves)
+        if hasattr(leaf, "copy_to_host_async")
+    ]
+    if len(dev_idx) <= 1:
+        for i in dev_idx:
+            leaves[i].copy_to_host_async()
+        return PendingPull(leaves, treedef, dev_idx, None, None, None)
+    dev_leaves = [leaves[i] for i in dev_idx]
+    try:
+        platform = next(iter(dev_leaves[0].devices())).platform
+    except Exception:
+        platform = jax.default_backend()
+    strategy = "bitcast64" if platform == "cpu" else "split"
+    has_f64 = any(str(l.dtype) == "float64" for l in dev_leaves)
+    if strategy == "split" and has_f64 and not _f64_pair_exact(platform):
+        # f64 can't ride the blob exactly on this platform: pull those
+        # leaves directly (async), blob-pack the rest
+        f64_idx = [i for i in dev_idx if str(leaves[i].dtype) == "float64"]
+        for i in f64_idx:
+            leaves[i].copy_to_host_async()
+        rest = [i for i in dev_idx if i not in f64_idx]
+        if len(rest) <= 1:
+            for i in rest:
+                leaves[i].copy_to_host_async()
+            return PendingPull(leaves, treedef, dev_idx, None, None, None)
+        dev_leaves = [leaves[i] for i in rest]
+        sig = tuple((str(l.dtype), l.shape) for l in dev_leaves)
+        blob = _d2h_pack_jit(sig, strategy)(tuple(dev_leaves))
+        blob.copy_to_host_async()
+        return PendingPull(
+            leaves, treedef, rest, sig, blob, strategy, tuple(f64_idx)
+        )
+    sig = tuple((str(l.dtype), l.shape) for l in dev_leaves)
+    blob = _d2h_pack_jit(sig, strategy)(tuple(dev_leaves))
+    blob.copy_to_host_async()
+    return PendingPull(leaves, treedef, dev_idx, sig, blob, strategy)
+
+
+def device_pull(tree):
+    """Synchronous form of device_pull_start().finish()."""
+    return device_pull_start(tree).finish()
 
 
 def device_inputs(batch: RecordBatch, device=None):

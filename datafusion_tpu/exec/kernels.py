@@ -41,6 +41,15 @@ def cached_kernel(key, build: Callable):
     return hit
 
 
+def fuse_batch_count() -> int:
+    """Batches folded into one device launch by the state-carrying
+    operators (aggregate, TopK).  Launch round trips — not compute —
+    dominate warm scans on tunneled devices, so fusing 8 batches turns
+    an 8-launch scan into one; the env knob exists for hosts where the
+    bigger unrolled program compiles too slowly."""
+    return max(1, int(os.environ.get("DATAFUSION_TPU_FUSE_BATCHES", "8")))
+
+
 def schema_fingerprint(schema) -> tuple:
     """Hashable image of a schema as kernels see it (positional
     dtypes + nullability; names ride along for dictionary wiring)."""

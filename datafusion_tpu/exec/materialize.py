@@ -106,22 +106,24 @@ class _PendingCompact:
     link transfer overlaps the next batch's parse/compute instead of
     serializing after it."""
 
-    __slots__ = ("batch", "live", "compacted", "dev_pos", "dev_arrays", "count")
+    __slots__ = ("batch", "live", "compacted", "dev_pos", "pull", "count")
 
-    def __init__(self, batch, live, compacted, dev_pos, dev_arrays, count):
+    def __init__(self, batch, live, compacted, dev_pos, pull, count):
         self.batch = batch
         self.live = live
         self.compacted = compacted
         self.dev_pos = dev_pos
-        self.dev_arrays = dev_arrays
+        self.pull = pull
         self.count = count
 
     def resolve(self):
         batch, live, n = self.batch, self.live, self.batch.num_rows
         pulled: dict[tuple[str, int], np.ndarray] = {}
         with METRICS.timer("d2h.wait"):
-            for pos, a in zip(self.dev_pos, self.dev_arrays):
-                a = np.asarray(a)
+            # the blob-packed transfer began at dispatch; finish() just
+            # blocks on it (one round trip for all device outputs)
+            host_arrays = self.pull.finish()
+            for pos, a in zip(self.dev_pos, host_arrays):
                 pulled[pos] = a[: self.count] if self.compacted else a
 
         def select(kind, i, a):
@@ -189,10 +191,11 @@ def compact_dispatch(batch: RecordBatch) -> _PendingCompact:
                 )
             METRICS.add("d2h.compacted_batches")
             compacted = True
-    # overlap D2H latencies: start all copies now; resolve() blocks later
-    for a in dev_arrays:
-        a.copy_to_host_async()
-    return _PendingCompact(batch, live, compacted, dev_pos, dev_arrays, count)
+    # ONE blob-packed D2H per batch, started now; resolve() blocks later
+    from datafusion_tpu.exec.batch import device_pull_start
+
+    pull = device_pull_start(tuple(dev_arrays))
+    return _PendingCompact(batch, live, compacted, dev_pos, pull, count)
 
 
 def compact_batch(batch: RecordBatch):

@@ -253,6 +253,16 @@ class PipelineRelation(Relation):
 
         for batch in batches:
             if not core.needs_kernel:
+                # pure column selection: yield a STABLE output batch per
+                # child batch (cached, core-pinned like group_ids) so a
+                # re-scan of an in-memory source hands downstream
+                # operators the same RecordBatch objects — their device
+                # copies (device_inputs cache) survive across runs
+                # instead of re-shipping every column per query run
+                hit = batch.cache.get("pipeline_out")
+                if hit is not None and hit[0] is core:
+                    yield hit[1]
+                    continue
                 cols, valids, mask = [], [], batch.mask
             else:
                 staged = batch.cache.get("staged_aux")
@@ -285,7 +295,7 @@ class PipelineRelation(Relation):
                 cols, valids, dicts = self._assemble_outputs(
                     batch, list(cols), list(valids), list(dicts)
                 )
-            yield RecordBatch(
+            out = RecordBatch(
                 self._schema,
                 list(cols),
                 list(valids),
@@ -293,6 +303,9 @@ class PipelineRelation(Relation):
                 num_rows=batch.num_rows,
                 mask=mask,
             )
+            if not core.needs_kernel:
+                batch.cache["pipeline_out"] = (core, out)
+            yield out
 
     def _subset_view(self, batch) -> RecordBatch:
         """A view batch holding only the kernel's input columns, cached

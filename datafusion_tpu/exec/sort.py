@@ -124,23 +124,75 @@ class _TopKCore:
             # sources one extra signed bit)
             or (kp.kind == "i" and kp.width <= 33)
         )
+        # wide single-key fast path: float64 / int64 / uint64 keys — the
+        # default SQL numeric types — take `lax.top_k` on a FULL-WIDTH
+        # int64 score (no index-tiebreak bits: lax.top_k is index-stable
+        # on every XLA backend, ties keep ascending row order).  The
+        # sentinel ladder lives at int64.min..min+2; a real int key CAN
+        # collide there, so the kernel carries a collision flag and the
+        # caller replays the scan through the exact sort path when it
+        # fires (f64 images can't reach the ladder: the NaN payload
+        # bands keep real bit-images > min + 2^51).
+        self.wide = (
+            kp is not None
+            and not self.single
+            and (
+                (kp.kind == "f" and kp.width == 64)
+                or kp.kind == "i"
+                or kp.kind == "u64"
+            )
+        )
         if self.single:
             self.jit = jax.jit(self._topk1_kernel, static_argnums=(0,))
+        elif self.wide:
+            self.jit = jax.jit(self._topk_wide_kernel, static_argnums=(0,))
         else:
             self.jit = jax.jit(self._topk_kernel, static_argnums=(0,))
+        self.fused_jit = jax.jit(self._fused_topk, static_argnums=(0,))
+
+    def _fused_topk(self, k, state, chunk):
+        """Fold the per-batch merge over a chunk of prepared batches in
+        ONE device launch (launch round trips dominate warm scans on
+        tunneled devices)."""
+        for cols, valids, mask, num_rows, rank_tables, img in chunk:
+            if self.single:
+                state = self._topk1_kernel(
+                    k, state, cols, valids, mask, num_rows, rank_tables
+                )
+            elif self.wide:
+                state = self._topk_wide_kernel(
+                    k, state, cols, valids, mask, num_rows, rank_tables, img
+                )
+            else:
+                state = self._topk_kernel(
+                    k, state, cols, valids, mask, num_rows, rank_tables
+                )
+        return state
 
     @staticmethod
-    def build(key_plans: list[_KeyPlan]) -> "_TopKCore":
+    def build(
+        key_plans: list[_KeyPlan], force_general: bool = False
+    ) -> "_TopKCore":
         from datafusion_tpu.exec.kernels import cached_kernel
 
         key = (
             "topk",
+            force_general,
             tuple(
                 (kp.index, kp.kind, kp.asc, kp.rank_slot, kp.width)
                 for kp in key_plans
             ),
         )
-        return cached_kernel(key, lambda: _TopKCore(list(key_plans)))
+
+        def make():
+            core = _TopKCore(list(key_plans))
+            if force_general and (core.single or core.wide):
+                core.single = False
+                core.wide = False
+                core.jit = jax.jit(core._topk_kernel, static_argnums=(0,))
+            return core
+
+        return cached_kernel(key, make)
 
     # -- single-key score image (device, traced) --
     # base-score ladder, higher = better: real values > NaN values >
@@ -227,6 +279,91 @@ class _TopKCore:
             for sb, v in zip(svalid, valids)
         )
         return (new_score,), new_live, new_vals, new_valid
+
+    # -- wide single-key path (f64 / int64 / uint64) --
+    # full-width int64 scores; sentinel ladder at the very bottom:
+    # real values > NaN > live NULL-key rows > padding/empty slots.
+    _W_DEAD = np.int64(-(2**63))
+    _W_NULL = np.int64(-(2**63) + 1)
+    _W_NAN = np.int64(-(2**63) + 2)
+
+    def _topk_wide_kernel(
+        self, k, state, cols, valids, mask, num_rows, rank_tables, img
+    ):
+        """Single wide-key merge.  `img` is the host-computed monotone
+        int64 bit-image of a float64 key (TPU won't lower the f64
+        bitcast; None for integer keys, whose image computes on device).
+        Scores use all 64 bits, so a real integer key can land on the
+        sentinel ladder — `flag` records that and the caller replays
+        the scan through the exact sort path (state threads the flag).
+        """
+        capacity = cols[0].shape[0]
+        row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if mask is not None:
+            row_mask = row_mask & mask
+        kp = self._key_plans[0]
+        v = cols[kp.index]
+        valid = valids[kp.index]
+        if kp.kind == "f":
+            raw = img
+        elif kp.kind == "u64":
+            raw = lax.bitcast_convert_type(
+                v.astype(jnp.uint64) ^ jnp.uint64(1 << 63), jnp.int64
+            )
+        else:
+            raw = v.astype(jnp.int64)
+        score = ~raw if kp.asc else raw
+        live_real = row_mask if valid is None else (row_mask & valid)
+        if kp.kind == "f":
+            isnan = jnp.isnan(v)
+            collide = live_real & ~isnan & (score <= self._W_NAN)
+            score = jnp.where(isnan, self._W_NAN, score)
+        else:
+            collide = live_real & (score <= self._W_NAN)
+        if valid is not None:
+            score = jnp.where(valid, score, self._W_NULL)
+        score = jnp.where(row_mask, score, self._W_DEAD)
+
+        kk = min(k, capacity)
+        cs, ci = lax.top_k(score, kk)  # index-stable ties on all backends
+        cand_live = row_mask[ci]
+
+        skeys, slive, svals, svalid, flag = state
+        all_score = jnp.concatenate([skeys[0], cs])
+        all_live = jnp.concatenate([slive, cand_live])
+        iota = jnp.arange(k + kk, dtype=jnp.int32)
+        out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
+        perm = out[1][:k]
+
+        new_vals = tuple(
+            jnp.concatenate([sv, c[ci]])[perm] for sv, c in zip(svals, cols)
+        )
+        new_valid = tuple(
+            jnp.concatenate(
+                [sb, (row_mask if vv is None else (vv & row_mask))[ci]]
+            )[perm]
+            for sb, vv in zip(svalid, valids)
+        )
+        return (
+            (all_score[perm],),
+            all_live[perm],
+            new_vals,
+            new_valid,
+            flag | collide.any(),
+        )
+
+    @staticmethod
+    def f64_image(values: np.ndarray) -> np.ndarray:
+        """Host-side monotone int64 image of a float64 column: v1 < v2
+        (as floats, NaNs excluded) implies img1 < img2 (as int64).  NaN
+        rows keep their natural extreme images; the kernel substitutes
+        the NaN sentinel via isnan(v) after applying direction."""
+        bits = np.ascontiguousarray(values, dtype=np.float64).view(np.int64)
+        u = bits.view(np.uint64)
+        flip = np.where(
+            bits < 0, ~np.uint64(0), np.uint64(1) << np.uint64(63)
+        )
+        return (u ^ flip ^ (np.uint64(1) << np.uint64(63))).view(np.int64)
 
     # -- shared key transform (device, traced) --
     def _device_keys(self, cols, valids, mask, capacity, rank_tables):
@@ -365,16 +502,38 @@ class SortRelation(Relation):
     def schema(self) -> Schema:
         return self._schema
 
-    def _topk_init(self, k, in_schema):
-        if self.core.single:
+    def _topk_init(self, k, in_schema, core=None):
+        core = core if core is not None else self.core
+        # cached on the core: building the empty state costs one tiny
+        # device launch per column, paid per RUN without the cache
+        # (launch round trips dominate warm scans on tunneled links);
+        # states are functionally consumed, never mutated
+        cache = getattr(core, "_init_states", None)
+        if cache is None:
+            cache = core._init_states = {}
+        sig = (k, tuple(str(in_schema.field(i).data_type.np_dtype)
+                        for i in range(len(in_schema))))
+        hit = cache.get(sig)
+        if hit is not None:
+            return hit
+        hit = self._topk_init_build(k, in_schema, core)
+        cache[sig] = hit
+        return hit
+
+    def _topk_init_build(self, k, in_schema, core):
+        if core.single or core.wide:
             # empty slots carry the dead-sentinel base score (lose always)
-            keys = [jnp.full(k, _TopKCore._DEAD_BASE, jnp.int64)]
+            sentinel = _TopKCore._W_DEAD if core.wide else _TopKCore._DEAD_BASE
+            keys = [jnp.full(k, sentinel, jnp.int64)]
             vals = tuple(
                 jnp.zeros(k, in_schema.field(i).data_type.np_dtype)
                 for i in range(len(in_schema))
             )
             valid = tuple(jnp.zeros(k, bool) for _ in range(len(in_schema)))
-            return tuple(keys), jnp.zeros(k, bool), vals, valid
+            base = (tuple(keys), jnp.zeros(k, bool), vals, valid)
+            if core.wide:
+                return base + (jnp.zeros((), bool),)
+            return base
         keys = []
         for kp in self._key_plans:
             keys.append(jnp.ones(k, bool))  # dead flag: empty slots last
@@ -388,14 +547,58 @@ class SortRelation(Relation):
         valid = tuple(jnp.zeros(k, bool) for _ in range(len(in_schema)))
         return tuple(keys), jnp.zeros(k, bool), vals, valid
 
-    def _topk_batches(self) -> Iterator[RecordBatch]:
+    def _f64_image_input(self, batch, kp):
+        """Device copy of the host-computed f64 key image, cached on the
+        batch (re-scanned in-memory sources transfer it once).  Returns
+        None when the column is device-resident (no host bytes to
+        image) — the caller falls back to the exact sort core."""
+        col = batch.data[kp.index]
+        if not isinstance(col, np.ndarray):
+            return None
+        key = ("sort_img", kp.index, None if self.device is None else repr(self.device))
+        hit = batch.cache.get(key)
+        if hit is None:
+            img = _TopKCore.f64_image(col)
+            hit = (
+                jax.device_put(img, self.device)
+                if self.device is not None
+                else jnp.asarray(img)
+            )
+            batch.cache[key] = hit
+        return hit
+
+    def _topk_batches(self, core=None) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.batch import device_inputs
 
+        from datafusion_tpu.exec.kernels import fuse_batch_count
+
+        if core is None:
+            core = self.core
+        topk_jit = core.jit
         k = self._kb  # bucketed state size; self.limit rows come out
         in_schema = self.child.schema
         state = None
         dicts = [None] * len(in_schema)
         rank_cache: dict = {}
+        wide_f64 = core.wide and self._key_plans[0].kind == "f"
+        fuse = fuse_batch_count()
+        chunk: list = []
+
+        def flush():
+            nonlocal state
+            if not chunk:
+                return
+            with METRICS.timer("execute.sort"), _device_scope(self.device):
+                if len(chunk) == 1:
+                    c = chunk[0]
+                    args = [k, state, c[0], c[1], c[2], c[3], c[4]]
+                    if core.wide:
+                        args.append(c[5])
+                    state = device_call(topk_jit, *args)
+                else:
+                    state = device_call(core.fused_jit, k, state, tuple(chunk))
+            chunk.clear()
+
         for batch in self.child.batches():
             for i, d in enumerate(batch.dicts):
                 if d is not None:
@@ -411,27 +614,48 @@ class SortRelation(Relation):
                     else np.zeros(1, np.int32)
                 )
                 rank_tables.append(ranks)
+            img = None
+            if wide_f64:
+                img = self._f64_image_input(batch, self._key_plans[0])
+                if img is None:
+                    # device-resident f64 key: no host bytes to image —
+                    # replay everything through the exact sort core
+                    yield from self._topk_batches(
+                        _TopKCore.build(self._key_plans, force_general=True)
+                    )
+                    return
             if state is None:
-                state = self._topk_init(k, in_schema)
-            with METRICS.timer("execute.sort"), _device_scope(self.device):
+                state = self._topk_init(k, in_schema, core)
+            with _device_scope(self.device):
                 data, validity, mask = device_inputs(batch, self.device)
-                state = device_call(
-                    self._topk_jit,
-                    k,
-                    state,
-                    data,
-                    validity,
-                    mask,
-                    np.int32(batch.num_rows),
-                    tuple(rank_tables),
-                )
+            chunk.append(
+                (data, validity, mask, np.int32(batch.num_rows),
+                 tuple(rank_tables), img)
+            )
+            if len(chunk) >= fuse:
+                flush()
+        flush()
         if state is None:
             yield self._empty_result(in_schema, dicts)
             return
-        _, live, vals, valid = state
-        for leaf in jax.tree.leaves((live, vals, valid)):
-            if hasattr(leaf, "copy_to_host_async"):
-                leaf.copy_to_host_async()
+        from datafusion_tpu.exec.batch import device_pull
+
+        if core.wide:
+            _, live, vals, valid, flag = state
+            # ONE blob-packed transfer for the whole k-row result
+            live, vals, valid, flag = device_pull((live, vals, valid, flag))
+        else:
+            _, live, vals, valid = state
+            live, vals, valid = device_pull((live, vals, valid))
+        if core.wide and bool(np.asarray(flag)):
+            # an integer key touched the sentinel ladder (values at the
+            # extreme two of the 2^64 range): replay the scan through
+            # the exact sort path — datasources are re-iterable
+            METRICS.add("sort.wide_fallbacks")
+            yield from self._topk_batches(
+                _TopKCore.build(self._key_plans, force_general=True)
+            )
+            return
         # the live bit separates real rows from dead-key padding when
         # the scan produced fewer than k rows; the state is bucket-sized,
         # so slice down to the actual LIMIT

@@ -285,6 +285,63 @@ class TestSentinelCollisions:
         t = ctx.sql_collect("SELECT x FROM t ORDER BY x")
         assert t.column_values(0) == [1, np.iinfo(np.uint64).max, None]
 
+    def test_wide_f64_topk_matches_numpy(self):
+        # float64 single-key TopK rides the wide lax.top_k path (host
+        # bit-image); parity against numpy stable sort incl. ties
+        rng = np.random.default_rng(21)
+        n = 30_000
+        x = np.round(rng.uniform(-1e6, 1e6, n), 1)  # ties likely
+        pay = np.arange(n, dtype=np.int64)
+        schema = Schema(
+            [Field("x", DataType.FLOAT64, False), Field("p", DataType.INT64, False)]
+        )
+        ctx = _ctx_with("t", schema, [x, pay], batch_rows=4096)
+        for sql, order in [
+            ("SELECT x, p FROM t ORDER BY x LIMIT 50", np.argsort(x, kind="stable")[:50]),
+            (
+                "SELECT x, p FROM t ORDER BY x DESC LIMIT 50",
+                np.argsort(-x, kind="stable")[:50],
+            ),
+        ]:
+            t = ctx.sql_collect(sql)
+            assert t.column_values(0) == x[order].tolist()
+            assert t.column_values(1) == pay[order].tolist()
+
+    def test_wide_f64_topk_nan_and_nulls(self):
+        # ladder: real values > NaN > NULL; all must fill a big LIMIT
+        schema = Schema([Field("x", DataType.FLOAT64, True)])
+        vals = np.array([3.0, np.nan, -np.inf, 0.0, np.inf, 1.0])
+        valid = np.array([True, True, True, False, True, True])
+        ctx = _ctx_with("t", schema, [vals], valids=[valid])
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC LIMIT 6")
+        got = t.column_values(0)
+        assert got[:4] == [np.inf, 3.0, 1.0, -np.inf]
+        assert np.isnan(got[4]) and got[5] is None
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x LIMIT 6")
+        got = t.column_values(0)
+        assert got[:4] == [-np.inf, 1.0, 3.0, np.inf]
+        assert np.isnan(got[4]) and got[5] is None
+
+    def test_wide_int64_collision_fallback_fires(self):
+        # int64.min under DESC lands on the sentinel ladder: the wide
+        # path must detect the collision and replay via the exact sort
+        from datafusion_tpu.utils.metrics import METRICS
+
+        schema = Schema([Field("x", DataType.INT64, False)])
+        vals = np.array([7, np.iinfo(np.int64).min, -3, 12], dtype=np.int64)
+        ctx = _ctx_with("t", schema, [vals])
+        METRICS.reset()
+        t = ctx.sql_collect("SELECT x FROM t ORDER BY x DESC LIMIT 4")
+        assert t.column_values(0) == [12, 7, -3, np.iinfo(np.int64).min]
+        assert METRICS.snapshot()["counts"].get("sort.wide_fallbacks", 0) >= 1
+        # and without extremes the fast path serves alone
+        vals2 = np.array([7, -5, -3, 12], dtype=np.int64)
+        ctx2 = _ctx_with("t", schema, [vals2])
+        METRICS.reset()
+        t2 = ctx2.sql_collect("SELECT x FROM t ORDER BY x DESC LIMIT 2")
+        assert t2.column_values(0) == [12, 7]
+        assert METRICS.snapshot()["counts"].get("sort.wide_fallbacks", 0) == 0
+
     def test_full_sort_multirun_int64_min(self):
         # force the run-merge path (no LIMIT, multiple batches)
         rng = np.random.default_rng(5)
