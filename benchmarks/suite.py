@@ -270,6 +270,26 @@ def config4_sort_topk(device_kind: str):
         dev_p50, dev_out = _warm_query(device_kind, src, "t", sql, rows)
         _assert_tables_match(dev_out, cpu_out, "config4 topk", rtol=1e-12)
 
+    # float64 / int64 keys — the default SQL numeric types — ride the
+    # wide full-width-score top_k path
+    singles = {}
+    for label, ssql in (
+        ("single_f64", "SELECT a, b, x FROM t ORDER BY a DESC LIMIT 100"),
+        ("single_i64", "SELECT b, a, x FROM t ORDER BY b LIMIT 100"),
+    ):
+        log(f"  config 4 {label}: wide-path TopK (warm)")
+        scpu_p50, scpu_out = _warm_query("cpu", src, "t", ssql, rows)
+        if device_kind == "cpu":
+            sdev_p50 = scpu_p50
+        else:
+            sdev_p50, sdev_out = _warm_query(device_kind, src, "t", ssql, rows)
+            _assert_tables_match(sdev_out, scpu_out, f"config4 {label}", rtol=1e-12)
+        singles[label] = {
+            "value": round(rows / sdev_p50, 1),
+            "p50_ms": round(sdev_p50 * 1e3, 2),
+            "vs_baseline": round(scpu_p50 / sdev_p50, 3),
+        }
+
     log("  config 4m: multi-key TopK (sort kernel, warm)")
     msql = "SELECT a, b, x FROM t ORDER BY a DESC, b LIMIT 100"
     mcpu_p50, mcpu_out = _warm_query("cpu", src, "t", msql, rows)
@@ -296,6 +316,7 @@ def config4_sort_topk(device_kind: str):
         "value": round(rows / dev_p50, 1),
         "p50_ms": round(dev_p50 * 1e3, 2),
         "vs_baseline": round(cpu_p50 / dev_p50, 3),
+        **singles,
         "multi_key": {
             "value": round(rows / mdev_p50, 1),
             "p50_ms": round(mdev_p50 * 1e3, 2),

@@ -144,14 +144,53 @@ def run_script(console: Console, path: str) -> None:
             console.execute(stmt)
 
 
+def _init_readline() -> None:
+    """Line editing + persistent history for the interactive REPL
+    (the reference console uses a rustyline fork for exactly this,
+    `linereader.rs:47-103`).  `input()` picks readline up automatically
+    once the module is imported; history persists across sessions."""
+    try:
+        import readline
+    except ImportError:  # platform without readline: plain input()
+        return
+    import atexit
+    import os
+
+    histfile = os.path.join(
+        os.path.expanduser("~"), ".datafusion_tpu_history"
+    )
+    try:
+        readline.read_history_file(histfile)
+    except OSError:
+        pass
+    readline.set_history_length(1000)
+
+    def _save():
+        try:
+            readline.write_history_file(histfile)
+        except OSError:
+            pass
+
+    atexit.register(_save)
+
+
 def run_interactive(console: Console) -> None:
-    """REPL with continuation prompts (linereader.rs:47-103)."""
+    """REPL with continuation prompts (linereader.rs:47-103).
+
+    Ctrl-C clears the statement buffer and returns to a fresh prompt
+    (rustyline's ReadlineError::Interrupted behavior); Ctrl-D exits."""
+    _init_readline()
     buf = ""
     while True:
         prompt = "datafusion> " if not buf else "> "
         try:
             line = input(prompt)
-        except (EOFError, KeyboardInterrupt):
+        except KeyboardInterrupt:
+            # abandon the half-typed statement, keep the session
+            print("^C")
+            buf = ""
+            continue
+        except EOFError:
             print()
             return
         if not buf and line.strip().lower() in ("quit", "exit"):

@@ -426,7 +426,8 @@ class _AggregateCore:
     semantically identical GROUP BY reuses the already-built jit and
     every executable in its cache."""
 
-    def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions):
+    def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions,
+                 param_slots=None):
         for g in group_expr:
             if not isinstance(g, Column):
                 raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
@@ -439,43 +440,55 @@ class _AggregateCore:
                 raise ExecutionError(f"non-aggregate expression {a!r} in aggr_expr")
             self.specs.append(AggregateSpec(a, in_schema))
 
-        compiler = ExprCompiler(in_schema, functions)
+        compiler = ExprCompiler(in_schema, functions, param_slots)
         self._pred_fn = compiler.compile(predicate) if predicate is not None else None
         self.slots = self._build_slots(compiler)
         self.aux_specs = compiler.aux_specs
         self.jit = jax.jit(self._kernel)
         self.fused_jit = jax.jit(self._fused_kernel)
 
-    def _fused_kernel(self, chunk, state):
+    def _fused_kernel(self, chunk, state, params):
         """Fold `_kernel` over a chunk of prepared batches in ONE device
         launch.  Tunneled/remote devices charge a round trip per
         executable launch (often 15-500 ms here), so a warm in-memory
         scan collapses from one launch per batch to one per chunk."""
         for cols, valids, aux, num_rows, mask, ids, str_aux in chunk:
             state = self._kernel(
-                cols, valids, aux, num_rows, mask, ids, state, str_aux
+                cols, valids, aux, num_rows, mask, ids, state, str_aux, params
             )
         return state
+
+    @staticmethod
+    def param_exprs(predicate, aggr_expr):
+        """Exprs compiled into the device kernel, in slot order."""
+        return ([] if predicate is None else [predicate]) + list(aggr_expr)
 
     @staticmethod
     def build(in_schema, group_expr, aggr_expr, predicate, functions):
         from datafusion_tpu.exec.kernels import (
             cached_kernel,
             functions_fingerprint,
+            parameterize_exprs,
             schema_fingerprint,
         )
 
+        elig = _AggregateCore.param_exprs(predicate, aggr_expr)
+        fps, slot_by_id, _ = parameterize_exprs(elig)
+        n_pred = 0 if predicate is None else 1
         key = (
             "aggregate",
             schema_fingerprint(in_schema),
             tuple(group_expr),
-            tuple(aggr_expr),
-            predicate,
+            fps[n_pred:],
+            fps[0] if n_pred else None,
             functions_fingerprint(functions),
         )
         return cached_kernel(
             key,
-            lambda: _AggregateCore(in_schema, group_expr, aggr_expr, predicate, functions),
+            lambda: _AggregateCore(
+                in_schema, group_expr, aggr_expr, predicate, functions,
+                slot_by_id,
+            ),
         )
 
     def _build_slots(self, compiler: ExprCompiler) -> list[_Slot]:
@@ -556,8 +569,8 @@ class _AggregateCore:
         return grow(counts, 0), new_accs
 
     def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state,
-                str_aux=()):
-        env = Env(cols, valids, aux)
+                str_aux=(), params=()):
+        env = Env(cols, valids, aux, params=params)
         capacity = cols[0].shape[0] if cols else ids.shape[0]
         mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
         if base_mask is not None:
@@ -840,6 +853,13 @@ class AggregateRelation(Relation):
         self.core = _AggregateCore.build(
             child.schema, list(group_expr), list(aggr_expr), predicate, functions
         )
+        # THIS query's literal values for the shared core's parameter
+        # slots (identical fingerprints guarantee identical slot order)
+        from datafusion_tpu.exec.kernels import parameterize_exprs
+
+        self._params = parameterize_exprs(
+            _AggregateCore.param_exprs(predicate, list(aggr_expr))
+        )[2]
         self.key_cols = self.core.key_cols
         self.specs = self.core.specs
         self.slots = self.core.slots
@@ -988,11 +1008,12 @@ class AggregateRelation(Relation):
                 if len(chunk) == 1:
                     c = chunk[0]
                     state = device_call(
-                        self._jit, c[0], c[1], c[2], c[3], c[4], c[5], state, c[6]
+                        self._jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
+                        c[6], self._params,
                     )
                 else:
                     state = device_call(
-                        self.core.fused_jit, tuple(chunk), state
+                        self.core.fused_jit, tuple(chunk), state, self._params
                     )
             chunk.clear()
 

@@ -80,13 +80,14 @@ class Env:
     actually reads (H2D bytes are the scarce resource on remote links).
     """
 
-    __slots__ = ("_cols", "_valids", "aux", "_map")
+    __slots__ = ("_cols", "_valids", "aux", "_map", "params")
 
-    def __init__(self, cols, valids, aux, col_map=None):
+    def __init__(self, cols, valids, aux, col_map=None, params=()):
         self._cols = cols
         self._valids = valids
         self.aux = aux
         self._map = col_map
+        self.params = params
 
     @property
     def cols(self):
@@ -123,12 +124,22 @@ class ExprCompiler:
     """Compiles Expr trees to (Env) -> (value, validity|None) closures,
     collecting AuxSpecs for string comparisons along the way."""
 
-    def __init__(self, schema: Schema, functions: Optional[dict[str, Callable]] = None):
+    def __init__(
+        self,
+        schema: Schema,
+        functions: Optional[dict[str, Callable]] = None,
+        param_slots: Optional[dict] = None,
+    ):
         self.schema = schema
         self.functions = dict(BUILTIN_FUNCTIONS)
         if functions:
             self.functions.update(functions)
         self.aux_specs: list[AuxSpec] = []
+        # id(Literal node) -> runtime parameter slot (kernels.
+        # parameterize_exprs): such literals compile to env.params
+        # reads instead of baked XLA constants, so one kernel serves
+        # every literal value of the same query shape
+        self.param_slots = param_slots or {}
 
     def _add_aux(self, spec: AuxSpec) -> int:
         self.aux_specs.append(spec)
@@ -156,6 +167,16 @@ class ExprCompiler:
                 raise NotSupportedError(
                     "bare string literals only appear inside comparisons"
                 )
+            slot = self.param_slots.get(id(expr))
+            if slot is not None:
+                np_dtype = dt.np_dtype
+
+                def param_fn(env: Env, j=slot, d=np_dtype):
+                    # runtime scalar argument: the value is NOT an XLA
+                    # constant, so distinct literals share one kernel
+                    return jnp.asarray(env.params[j], d), None
+
+                return param_fn
             v = np.asarray(expr.value.value, dtype=dt.np_dtype)
 
             def lit_fn(env: Env):

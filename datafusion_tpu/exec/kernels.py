@@ -41,6 +41,91 @@ def cached_kernel(key, build: Callable):
     return hit
 
 
+def parameterize_exprs(exprs):
+    """Literal-parameterized fingerprints for a list of Expr trees.
+
+    SURVEY §7 "Recompilation control": with literal values baked into
+    the cache key, `WHERE x > <literal>` compiles a distinct kernel per
+    value — parameterized workloads recompile forever and churn the
+    LRU.  Here numeric literals become runtime scalar kernel arguments:
+    the fingerprint replaces each with a ("param", dtype, slot) marker,
+    so one compiled kernel serves every value of `?`.
+
+    Slots are assigned by VALUE-IDENTITY PATTERN, not position: equal
+    literal values (same dtype) share a slot, in first-occurrence DFS
+    order.  That makes fingerprint equality imply structural kernel
+    compatibility — `SUM(x*0.9), AVG(x*0.9)` (pattern [0,0], args
+    dedup into one accumulator slot) can never collide with
+    `SUM(x*0.8), AVG(x*0.7)` (pattern [0,1], two slots).
+
+    String literals keep their values in the fingerprint: they already
+    reach kernels as runtime aux inputs (dictionary codes / compare
+    tables), but the aux SPECS embed the string, so cores can only be
+    shared between identical string literals.  NULL literals also stay
+    in the fingerprint (they compile to a validity constant).
+
+    Returns (fps, slot_by_id, values): one hashable fingerprint per
+    expr (None passes through), `slot_by_id` mapping id(Literal node)
+    -> slot for the compiler, and the per-slot runtime values as numpy
+    scalars.  Callers recompute `values` from their own expr trees —
+    identical fingerprints guarantee identical slot assignment.
+    """
+    from datafusion_tpu.datatypes import DataType
+    from datafusion_tpu.plan.expr import (
+        AggregateFunction,
+        BinaryExpr,
+        Cast,
+        Column,
+        IsNotNull,
+        IsNull,
+        Literal,
+        ScalarFunction,
+    )
+    import numpy as np
+
+    slot_by_id: dict = {}
+    values: list = []
+    pattern: dict = {}
+
+    def lit_slot(lit) -> int:
+        dt = lit.value.get_datatype()
+        key = (repr(dt), repr(lit.value.value))
+        slot = pattern.get(key)
+        if slot is None:
+            slot = pattern[key] = len(values)
+            values.append(np.asarray(lit.value.value, dtype=dt.np_dtype))
+        slot_by_id[id(lit)] = slot
+        return slot
+
+    def fp(e):
+        if isinstance(e, Column):
+            return ("col", e.index)
+        if isinstance(e, Literal):
+            if e.value.is_null:
+                return ("nulllit", repr(e.value))
+            dt = e.value.get_datatype()
+            if dt == DataType.UTF8:
+                return ("strlit", e.value.value)
+            return ("param", repr(dt), lit_slot(e))
+        if isinstance(e, Cast):
+            return ("cast", repr(e.data_type), fp(e.expr))
+        if isinstance(e, IsNull):
+            return ("isnull", fp(e.expr))
+        if isinstance(e, IsNotNull):
+            return ("isnotnull", fp(e.expr))
+        if isinstance(e, BinaryExpr):
+            return ("bin", e.op, fp(e.left), fp(e.right))
+        if isinstance(e, ScalarFunction):
+            return ("fn", e.name, tuple(fp(a) for a in e.args))
+        if isinstance(e, AggregateFunction):
+            return ("agg", e.name, tuple(fp(a) for a in e.args))
+        # unknown node: keep it verbatim (its literals stay inline)
+        return ("raw", e)
+
+    fps = tuple(None if e is None else fp(e) for e in exprs)
+    return fps, slot_by_id, tuple(values)
+
+
 def fuse_batch_count() -> int:
     """Batches folded into one device launch by the state-carrying
     operators (aggregate, TopK).  Launch round trips — not compute —

@@ -66,10 +66,11 @@ class _PipelineCore:
     semantically identical query reuses the already-built jit — and
     with it every compiled executable in jit's cache."""
 
-    def __init__(self, in_schema, predicate, projections, functions, metas):
+    def __init__(self, in_schema, predicate, projections, functions, metas,
+                 param_slots=None):
         from datafusion_tpu.exec.hostfn import contains_host_fn
 
-        compiler = ExprCompiler(in_schema, functions)
+        compiler = ExprCompiler(in_schema, functions, param_slots)
         if predicate is not None and contains_host_fn(predicate, metas):
             raise NotSupportedError(
                 "host-only functions are not supported in WHERE predicates"
@@ -135,28 +136,57 @@ class _PipelineCore:
         self.jit = jax.jit(self._kernel)
 
     @staticmethod
+    def param_exprs(predicate, projections, metas):
+        """The exprs that compile into the device kernel, in slot-
+        assignment order (host-evaluated projections keep their literal
+        values inline — their exprs live in the shared core and run on
+        the host with the FIRST relation's values)."""
+        from datafusion_tpu.exec.hostfn import contains_host_fn
+
+        elig = [] if predicate is None else [predicate]
+        if projections is not None:
+            elig.extend(
+                e for e in projections if not contains_host_fn(e, metas or {})
+            )
+        return elig
+
+    @staticmethod
     def build(in_schema, predicate, projections, functions, metas):
+        from datafusion_tpu.exec.hostfn import contains_host_fn
         from datafusion_tpu.exec.kernels import (
             cached_kernel,
             functions_fingerprint,
+            parameterize_exprs,
             schema_fingerprint,
         )
 
+        elig = _PipelineCore.param_exprs(predicate, projections, metas)
+        fps, slot_by_id, _ = parameterize_exprs(elig)
+        fp_of = dict(zip((id(e) for e in elig), fps))
+        proj_key = None
+        if projections is not None:
+            proj_key = tuple(
+                ("host", e) if contains_host_fn(e, metas or {})
+                else fp_of[id(e)]
+                for e in projections
+            )
         key = (
             "pipeline",
             schema_fingerprint(in_schema),
-            predicate,
-            None if projections is None else tuple(projections),
+            None if predicate is None else fp_of[id(predicate)],
+            proj_key,
             functions_fingerprint(functions),
             tuple(sorted(n for n, m in (metas or {}).items() if m.host_fn)),
         )
         return cached_kernel(
             key,
-            lambda: _PipelineCore(in_schema, predicate, projections, functions, metas),
+            lambda: _PipelineCore(
+                in_schema, predicate, projections, functions, metas, slot_by_id
+            ),
         )
 
-    def _kernel(self, cols, valids, aux, num_rows, base_mask):
-        env = Env(cols, valids, aux, self.col_map)
+    def _kernel(self, cols, valids, aux, num_rows, base_mask, params=()):
+        env = Env(cols, valids, aux, self.col_map, params)
         if cols:
             capacity = cols[0].shape[0]
         elif base_mask is not None:
@@ -221,6 +251,13 @@ class PipelineRelation(Relation):
         self.core = _PipelineCore.build(
             child.schema, predicate, projections, functions, self._metas
         )
+        # THIS query's literal values for the shared core's parameter
+        # slots (identical fingerprints guarantee identical slot order)
+        from datafusion_tpu.exec.kernels import parameterize_exprs
+
+        self._params = parameterize_exprs(
+            _PipelineCore.param_exprs(predicate, projections, self._metas)
+        )[2]
         self._host_dicts: dict[int, "StringDictionary"] = {}
         self._aux_cache: dict = {}
 
@@ -283,6 +320,7 @@ class PipelineRelation(Relation):
                         aux,
                         np.int32(batch.num_rows),
                         mask_in,
+                        self._params,
                     )
             if core.proj_fns is None:
                 # filter-only: the input columns, untouched

@@ -713,22 +713,63 @@ class SortRelation(Relation):
             keys.append(k)
         return keys
 
+    _SORT_RUN_JIT = None
+
     def _sorted_run(self, keys: list[np.ndarray], n: int) -> np.ndarray:
-        """Device-sort one run of n rows; returns the permutation."""
+        """Device-sort one run of n rows; returns the permutation.
+
+        Key operands travel through the compressed wire (one blob put);
+        all-false dead flags — the no-NULLs common case — drop out of
+        the sort entirely (a constant key never reorders anything).
+        The padding convention keeps the flag droppable: when a run has
+        no nulls, padding rows' VALUE keys are +max sentinels, so they
+        sort last without their flag."""
+        from datafusion_tpu.exec.batch import device_pull, put_compressed
+
         cap = bucket_capacity(n)
-        ops = []
-        for key in keys:
-            # padding rows: dead flag True, value 0 — they tie with NULL
-            # rows and stability keeps real rows (indices < n) first
-            pad_val = True if key.dtype.kind == "b" else 0
-            padded = np.full(cap, pad_val, dtype=key.dtype)
-            padded[:n] = key[:n]
-            ops.append(jnp.asarray(padded))
-        iota = jnp.arange(cap, dtype=jnp.int32)
-        sorted_ops = lax.sort(
-            tuple(ops) + (iota,), num_keys=len(ops), is_stable=True
-        )
-        return np.asarray(sorted_ops[-1])[:n]
+        host_ops: list[np.ndarray] = []
+        # keys come as (dead-flag, value) pairs per ORDER BY key
+        for j in range(0, len(keys), 2):
+            dead, val = keys[j], keys[j + 1]
+            has_dead = bool(dead[:n].any())
+            # NaN values sort ABOVE +inf in XLA's total order, so a
+            # +inf padding sentinel cannot sink padding below real NaN
+            # rows — keep the flag in that case
+            nan_risk = val.dtype.kind == "f" and bool(
+                np.isnan(val[:n]).any()
+            )
+            if has_dead or nan_risk:
+                pflag = np.ones(cap, bool)  # padding rows: dead=True
+                pflag[:n] = dead[:n]
+                host_ops.append(pflag)
+                padded = np.zeros(cap, dtype=val.dtype)  # dead tie at 0
+                padded[:n] = val[:n]
+                host_ops.append(padded)
+                continue
+            # no NULLs and no NaNs: the all-false flag is a constant
+            # key — drop it and sink padding via a +max value sentinel
+            # (stability keeps real rows ahead of tying padding)
+            pad = (
+                np.asarray(np.inf, val.dtype)
+                if val.dtype.kind == "f"
+                else np.asarray(np.iinfo(val.dtype).max, val.dtype)
+            )
+            padded = np.full(cap, pad, dtype=val.dtype)
+            padded[:n] = val[:n]
+            host_ops.append(padded)
+        if SortRelation._SORT_RUN_JIT is None:
+            def run_sort(ops):
+                iota = jnp.arange(ops[0].shape[0], dtype=jnp.int32)
+                out = lax.sort(
+                    tuple(ops) + (iota,), num_keys=len(ops), is_stable=True
+                )
+                return out[-1]
+
+            SortRelation._SORT_RUN_JIT = jax.jit(run_sort)
+        with _device_scope(self.device):
+            dev_ops = put_compressed(host_ops, self.device)
+            perm = SortRelation._SORT_RUN_JIT(tuple(dev_ops))
+            return device_pull(perm)[:n]
 
     @staticmethod
     def _merge_runs(run_keys: list[np.ndarray], run_perms: list[np.ndarray]):
@@ -820,9 +861,20 @@ class SortRelation(Relation):
             if n == 0:
                 continue
             if run_rows is None:
-                # run size = one batch bucket: the device sort buffer
-                # never exceeds the scan's batch capacity
-                run_rows = bucket_capacity(batch.capacity)
+                # run size: everything up to SORT_RUN_ROWS sorts in ONE
+                # device launch (a 16M-row 2-key sort buffer is ~350 MB
+                # of HBM — trivial), so the host merge only engages on
+                # scans too large for a single sort; one launch + one
+                # permutation pull beats per-batch-bucket runs on
+                # launch-latency-dominated links
+                import os
+
+                run_rows = max(
+                    bucket_capacity(batch.capacity),
+                    int(os.environ.get(
+                        "DATAFUSION_TPU_SORT_RUN_ROWS", str(1 << 24)
+                    )),
+                )
             if pending_cols is None:
                 pending_cols = [[] for _ in cols]
                 pending_valids = [[] for _ in cols]

@@ -175,6 +175,179 @@ class _ShardFeed:
         return None
 
 
+class PartitionedPipelineRelation(Relation):
+    """[Selection +] [Projection] over partitioned input on a device
+    mesh: each round, every shard's next batch stacks into
+    `[n_shards, cap]` host arrays and ONE `shard_map`-ped kernel runs
+    the same fused filter+project update in parallel across devices —
+    the data-parallel twin of the partitioned aggregate, for the plan
+    shapes that used to fall back to a serial union scan
+    (`parallel/partition.py` round-2 note).
+
+    Outputs materialize host-side once per round (one blob-packed pull
+    for every shard's computed columns + masks); identity projections
+    pass the shard's own host arrays through untouched, so Float64
+    passthroughs stay bit-exact exactly like the single-device pipeline.
+    """
+
+    def __init__(
+        self,
+        children: list[Relation],
+        predicate: Optional[Expr],
+        projections: Optional[list[Expr]],
+        out_schema: Schema,
+        mesh,
+        functions=None,
+        function_metas=None,
+    ):
+        from datafusion_tpu.exec.kernels import parameterize_exprs
+        from datafusion_tpu.exec.relation import _PipelineCore
+
+        self.children = children
+        self.predicate = predicate
+        self.projections = projections
+        self._schema = out_schema
+        self.mesh = mesh
+        self.n_shards = int(np.prod(mesh.devices.shape))
+        self._metas = function_metas or {}
+        self.core = _PipelineCore.build(
+            children[0].schema, predicate, projections, functions, self._metas
+        )
+        if self.core.host_proj:
+            raise PlanError(
+                "host-evaluated projections take the serial union scan"
+            )
+        self._params = parameterize_exprs(
+            _PipelineCore.param_exprs(predicate, projections, self._metas)
+        )[2]
+        self._aux_cache: dict = {}
+
+        spec_sh = P(MESH_AXIS)
+        spec_rep = P()
+        self._stacked_jit = jax.jit(
+            shard_map(
+                self._stacked_kernel,
+                mesh=self.mesh,
+                in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh,
+                          spec_rep),
+                out_specs=spec_sh,
+            )
+        )
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _stacked_kernel(self, cols, valids, aux, num_rows, masks, params):
+        sq = lambda t: t[0]
+        out_cols, out_valids, mask = self.core._kernel(
+            [sq(c) for c in cols],
+            [sq(v) for v in valids],
+            aux,
+            sq(num_rows),
+            sq(masks),
+            params,
+        )
+        capacity = mask.shape[0]
+        ex = lambda t: jnp.broadcast_to(t, (capacity,))[None]
+        # shard_map output pytrees can't carry None: absent validity
+        # broadcasts to all-true
+        out_valids = tuple(
+            ex(jnp.ones((), bool) if v is None else v) for v in out_valids
+        )
+        return tuple(ex(c) for c in out_cols), out_valids, mask[None]
+
+    def batches(self) -> Iterator[RecordBatch]:
+        from datafusion_tpu.exec.batch import device_pull
+        from datafusion_tpu.exec.expression import compute_aux_values as _aux
+
+        core = self.core
+        n = self.n_shards
+        feeds = [_ShardFeed(rels) for rels in _round_robin(self.children, n)]
+        in_schema = self.children[0].schema
+        used = core.used_cols
+
+        while True:
+            round_batches = [f.next_batch() for f in feeds]
+            if all(b is None for b in round_batches):
+                return
+            live = [b for b in round_batches if b is not None]
+            cap = max(bucket_capacity(1), *(b.capacity for b in live))
+
+            if core.needs_kernel:
+                cols_np = [
+                    np.zeros((n, cap), in_schema.field(c).data_type.np_dtype)
+                    for c in used
+                ]
+                valids_np = [np.ones((n, cap), bool) for _ in used]
+                masks_np = np.zeros((n, cap), bool)
+                rows_np = np.zeros((n,), np.int32)
+                for s_i, b in enumerate(round_batches):
+                    if b is None:
+                        continue
+                    bc = b.capacity
+                    rows_np[s_i] = b.num_rows
+                    masks_np[s_i, :bc] = (
+                        np.asarray(b.mask) if b.mask is not None else True
+                    )
+                    for j, c in enumerate(used):
+                        cols_np[j][s_i, :bc] = np.asarray(b.data[c])
+                        if b.validity[c] is not None:
+                            valids_np[j][s_i, :bc] = np.asarray(b.validity[c])
+                aux = tuple(_aux(core.aux_specs, live[0], self._aux_cache))
+                with METRICS.timer("execute.partitioned_pipeline"):
+                    out_cols, out_valids, masks = device_call(
+                        self._stacked_jit,
+                        tuple(jnp.asarray(c) for c in cols_np),
+                        tuple(jnp.asarray(v) for v in valids_np),
+                        aux,
+                        jnp.asarray(rows_np),
+                        jnp.asarray(masks_np),
+                        self._params,
+                    )
+                    # ONE blob-packed pull for the whole round's outputs
+                    out_cols, out_valids, masks = device_pull(
+                        (out_cols, out_valids, masks)
+                    )
+            else:
+                out_cols, out_valids, masks = (), (), None
+
+            for s_i, b in enumerate(round_batches):
+                if b is None:
+                    continue
+                bc = b.capacity
+                if core.proj_fns is None:
+                    # filter-only: input columns untouched
+                    cols, valids, dicts = b.data, b.validity, b.dicts
+                else:
+                    cols, valids, dicts = [], [], []
+                    dev_i = 0
+                    for j in range(len(self.projections)):
+                        src = core.identity_proj.get(j)
+                        if src is not None:
+                            cols.append(b.data[src])
+                            valids.append(b.validity[src])
+                        else:
+                            cols.append(out_cols[dev_i][s_i, :bc])
+                            valids.append(out_valids[dev_i][s_i, :bc])
+                            dev_i += 1
+                        src_d = core.out_dict_sources[j]
+                        dicts.append(b.dicts[src_d] if src_d is not None else None)
+                mask = (
+                    masks[s_i, :bc]
+                    if masks is not None
+                    else b.mask
+                )
+                yield RecordBatch(
+                    self._schema,
+                    list(cols),
+                    list(valids),
+                    list(dicts),
+                    num_rows=b.num_rows,
+                    mask=mask,
+                )
+
+
 class PartitionedAggregateRelation(AggregateRelation):
     """[Selection +] Aggregate over partitioned input on a device mesh.
 
@@ -213,7 +386,7 @@ class PartitionedAggregateRelation(AggregateRelation):
                 self._stacked_update,
                 mesh=self.mesh,
                 in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh, spec_sh,
-                          spec_sh, spec_rep),
+                          spec_sh, spec_rep, spec_rep),
                 out_specs=spec_sh,
             ),
         )
@@ -228,7 +401,7 @@ class PartitionedAggregateRelation(AggregateRelation):
 
     # -- shard_map bodies (block shapes have leading axis 1) --
     def _stacked_update(self, cols, valids, aux, num_rows, masks, ids, state,
-                        str_aux):
+                        str_aux, params):
         sq = lambda t: t[0]
         counts, accs = state
         local = (sq(counts), jax.tree.map(sq, accs))
@@ -241,6 +414,7 @@ class PartitionedAggregateRelation(AggregateRelation):
             sq(ids),
             local,
             str_aux,
+            params,
         )
         ex = lambda t: t[None]
         oc, oa = out
@@ -375,6 +549,7 @@ class PartitionedAggregateRelation(AggregateRelation):
                     jnp.asarray(ids_np),
                     state,
                     str_aux,
+                    self._params,
                 )
 
         if state is None:
@@ -453,6 +628,28 @@ class PartitionedContext(ExecutionContext):
                 predicate=pred,
                 functions=self._jax_functions(),
             )
+        pipe = _match_partitioned_pipeline(plan, self.datasources, self.functions)
+        if pipe is not None:
+            pred, projections, scan, out_schema = pipe
+            ds = self.datasources[scan.table_name]
+            if scan.projection is not None:
+                ds = ds.with_projection(scan.projection)
+            try:
+                self.last_fragments = self._ship_fragments(plan, ds)
+                parts = [f.build_datasource(self.batch_size) for f in self.last_fragments]
+                _share_dictionaries(parts)
+            except PlanError:
+                self.last_fragments = []
+                parts = ds.partitions
+            children = [DataSourceRelation(p) for p in parts]
+            # host-fn plans never get here: _match_partitioned_pipeline
+            # rejects them with the same contains_host_fn check the
+            # pipeline core uses, so construction cannot PlanError
+            return PartitionedPipelineRelation(
+                children, pred, projections, out_schema, self.mesh,
+                functions=self._jax_functions(),
+                function_metas=self.functions,
+            )
         return super().execute(plan)
 
     def _ship_fragments(self, plan: LogicalPlan, ds: PartitionedDataSource) -> list[PlanFragment]:
@@ -464,6 +661,37 @@ class PartitionedContext(ExecutionContext):
             # coordinator->worker hop would perform
             frags.append(PlanFragment.from_json_str(frag.to_json_str()))
         return frags
+
+
+def _match_partitioned_pipeline(plan: LogicalPlan, datasources: dict, metas):
+    """Match [Projection](Selection)(TableScan) over a partitioned
+    table; returns (predicate, projections, scan, out_schema) or None.
+    Plans whose projections need host evaluation (string/struct
+    producers) return None — they take the serial union scan."""
+    from datafusion_tpu.exec.hostfn import contains_host_fn
+    from datafusion_tpu.plan.logical import Projection
+
+    projections = None
+    out_schema = plan.schema
+    node = plan
+    if isinstance(node, Projection):
+        projections = node.expr
+        node = node.input
+    pred = None
+    if isinstance(node, Selection):
+        pred = node.expr
+        node = node.input
+    if not isinstance(node, TableScan):
+        return None
+    if projections is None and pred is None:
+        return None  # bare scan: nothing to parallelize
+    ds = datasources.get(node.table_name)
+    if not isinstance(ds, PartitionedDataSource):
+        return None
+    checked = ([] if pred is None else [pred]) + list(projections or [])
+    if any(contains_host_fn(e, metas or {}) for e in checked):
+        return None
+    return pred, projections, node, out_schema
 
 
 def _match_partitioned_aggregate(plan: LogicalPlan, datasources: dict):

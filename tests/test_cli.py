@@ -105,6 +105,89 @@ class TestCliSubprocess:
         assert proc.returncode == 0, proc.stderr
         assert "Executing query ..." in proc.stdout
 
+    def test_interactive_pty_ctrl_c_clears_ctrl_d_exits(self, tmp_path):
+        """Line-editor behavior under a real terminal (reference
+        linereader.rs:47-103): Ctrl-C abandons a half-typed statement
+        and returns to a fresh prompt; Ctrl-D exits; history persists
+        to the history file."""
+        import pty
+        import select
+        import time as _time
+
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO,
+            HOME=str(tmp_path),  # history file lands here
+        )
+        pid, fd = pty.fork()
+        if pid == 0:  # child: exec the CLI on the pty
+            os.chdir(REPO)
+            os.execvpe(
+                sys.executable,
+                [sys.executable, "-m", "datafusion_tpu.cli"],
+                env,
+            )
+        out = b""
+
+        def read_until(marker: bytes, timeout=60.0):
+            nonlocal out
+            deadline = _time.monotonic() + timeout
+            while marker not in out:
+                rest = deadline - _time.monotonic()
+                assert rest > 0, f"timeout waiting for {marker!r}; got {out!r}"
+                r, _, _ = select.select([fd], [], [], rest)
+                if r:
+                    try:
+                        out += os.read(fd, 4096)
+                    except OSError:
+                        break
+            return out
+
+        try:
+            read_until(b"datafusion> ")
+            os.write(fd, b"SELECT 1 +\n")  # half a statement
+            # the bare continuation prompt only appears after a newline
+            # ("datafusion> " would false-match a plain "> " search)
+            read_until(b"\n> ")
+            # let readline enter its read loop before interrupting (the
+            # prompt prints a beat before the handler is in place)
+            _time.sleep(0.3)
+            os.write(fd, b"\x03")  # Ctrl-C: abandon the buffer
+            try:
+                read_until(b"^C", timeout=10.0)
+            except AssertionError:
+                os.write(fd, b"\x03")  # rare: signal landed pre-loop
+                read_until(b"^C", timeout=30.0)
+            read_until(b"datafusion> ")  # fresh prompt, session alive
+            os.write(fd, b"SELECT 2 + 3;\n")
+            read_until(b"Executing query ...")
+            read_until(b"5")
+            read_until(b"datafusion> ")
+            _time.sleep(0.3)  # same settle as before Ctrl-C
+            os.write(fd, b"\x04")  # Ctrl-D: exit
+            deadline = _time.monotonic() + 60
+            retried = False
+            while True:
+                done, status = os.waitpid(pid, os.WNOHANG)
+                if done:
+                    break
+                if not retried and _time.monotonic() > deadline - 50:
+                    os.write(fd, b"\x04")
+                    retried = True
+                assert _time.monotonic() < deadline, "CLI did not exit on Ctrl-D"
+                _time.sleep(0.05)
+            assert os.waitstatus_to_exitcode(status) == 0
+        finally:
+            os.close(fd)
+            try:
+                os.kill(pid, 9)
+            except ProcessLookupError:
+                pass
+        hist = tmp_path / ".datafusion_tpu_history"
+        assert hist.exists(), "readline history file not written"
+        assert "SELECT 2 + 3;" in hist.read_text()
+
 
 class TestStatementSplitting:
     def test_semicolon_inside_string_literal(self, tmp_path):

@@ -162,8 +162,76 @@ class TestPartitionedAggregate:
             assert rt.logical_plan().schema.names() == f.logical_plan().schema.names()
 
 
+class TestPartitionedPipeline:
+    """Non-aggregate plans (filter / project) run the stacked shard_map
+    kernel across the mesh instead of the round-2 serial union scan."""
+
+    def test_filter_project_matches_single_device(self, parts):
+        from datafusion_tpu.utils.metrics import METRICS
+
+        paths, rows = parts
+        sql = (
+            "SELECT region, price * 2.0, qty FROM sales "
+            "WHERE price > 30.0 AND qty > 100"
+        )
+        METRICS.reset()
+        table = _partitioned_ctx(paths).sql_collect(sql)
+        snap = METRICS.snapshot()
+        assert snap["timings_s"].get("execute.partitioned_pipeline", 0) > 0, (
+            "partitioned filter/project did not take the mesh path"
+        )
+        single = _single_ctx(paths).sql_collect(sql)
+        assert sorted(table.to_rows()) == sorted(single.to_rows())
+        want = [
+            (r[0], r[2] * 2.0, r[1])
+            for r in rows
+            if r[2] > 30.0 and r[1] is not None and r[1] > 100
+        ]
+        assert len(table.to_rows()) == len(want)
+
+    def test_filter_only_parity(self, parts):
+        paths, rows = parts
+        sql = "SELECT region, qty, price FROM sales WHERE qty > 250"
+        table = _partitioned_ctx(paths).sql_collect(sql)
+        want = [r for r in rows if r[1] is not None and r[1] > 250]
+        assert sorted(table.to_rows()) == sorted(want)
+
+    def test_string_predicate_over_mesh(self, parts):
+        paths, rows = parts
+        sql = "SELECT region, price FROM sales WHERE region = 'north'"
+        table = _partitioned_ctx(paths).sql_collect(sql)
+        want = [(r[0], r[2]) for r in rows if r[0] == "north"]
+        assert sorted(table.to_rows()) == sorted(want)
+
+    def test_four_partitions_on_eight_devices(self, tmp_path):
+        paths, rows = _write_partitions(tmp_path, n_parts=4, rows_per_part=333)
+        sql = "SELECT price, qty FROM sales WHERE price < 20.0"
+        table = _partitioned_ctx(paths).sql_collect(sql)
+        want = [(r[2], r[1]) for r in rows if r[2] < 20.0]
+        assert sorted(table.to_rows(), key=repr) == sorted(want, key=repr)
+
+    def test_host_fn_projection_falls_back_to_serial(self, parts):
+        from datafusion_tpu.utils.metrics import METRICS
+
+        paths, rows = parts
+        ctx = _partitioned_ctx(paths)
+        ctx.register_udf(
+            "tagit", [DataType.FLOAT64], DataType.UTF8,
+            host_fn=lambda x: np.asarray([f"p{v:.0f}" for v in x], dtype=object),
+        )
+        METRICS.reset()
+        table = ctx.sql_collect("SELECT region, tagit(price) FROM sales WHERE qty > 400")
+        snap = METRICS.snapshot()
+        assert snap["timings_s"].get("execute.partitioned_pipeline", 0) == 0
+        want = [
+            (r[0], f"p{r[2]:.0f}") for r in rows
+            if r[1] is not None and r[1] > 400
+        ]
+        assert sorted(table.to_rows()) == sorted(want)
+
+
 class TestPartitionedFallback:
-    def test_non_aggregate_union_scan(self, parts):
+    def test_non_aggregate_matches_union_semantics(self, parts):
         paths, rows = parts
         table = _partitioned_ctx(paths).sql_collect(
             "SELECT region, price FROM sales WHERE price > 50.0"
@@ -171,9 +239,7 @@ class TestPartitionedFallback:
         want = [(r[0], r[2]) for r in rows if r[2] > 50.0]
         got = table.to_rows()
         assert len(got) == len(want)
-        assert sorted(got) == sorted(
-            want
-        )  # union scan preserves rows; order across partitions is scan order
+        assert sorted(got) == sorted(want)
 
     def test_sort_limit_over_partitions(self, parts):
         paths, rows = parts

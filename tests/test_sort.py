@@ -109,9 +109,10 @@ class TestStreamingTopK:
 
 
 class TestFullSort:
-    def test_multirun_merge_exact_order(self):
-        # far more rows than one batch bucket -> multiple device-sorted
-        # runs merged on host
+    def test_multirun_merge_exact_order(self, monkeypatch):
+        # force small runs so multiple device-sorted runs merge on host
+        # (the default single-sort threshold is far larger)
+        monkeypatch.setenv("DATAFUSION_TPU_SORT_RUN_ROWS", "16384")
         rng = np.random.default_rng(3)
         n = 120_000
         a = rng.integers(0, 1000, n).astype(np.int64)
@@ -342,8 +343,9 @@ class TestSentinelCollisions:
         assert t2.column_values(0) == [12, 7]
         assert METRICS.snapshot()["counts"].get("sort.wide_fallbacks", 0) == 0
 
-    def test_full_sort_multirun_int64_min(self):
-        # force the run-merge path (no LIMIT, multiple batches)
+    def test_full_sort_multirun_int64_min(self, monkeypatch):
+        # force the run-merge path (no LIMIT, multiple small runs)
+        monkeypatch.setenv("DATAFUSION_TPU_SORT_RUN_ROWS", "1024")
         rng = np.random.default_rng(5)
         n = 3000
         vals = rng.integers(-1000, 1000, n).astype(np.int64)
