@@ -953,7 +953,7 @@ class AggregateRelation(Relation):
         collectives; single-device mode finalizes it directly.
         """
         from datafusion_tpu.exec.batch import device_inputs
-        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_prefetch
+        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
         from datafusion_tpu.exec.relation import device_scope
 
         batches = self.child.batches()
@@ -978,7 +978,7 @@ class AggregateRelation(Relation):
                 )
                 device_inputs(b, self.device)
 
-            batches = staged_prefetch(batches, _stage)
+            batches = staged_pipeline(batches, _stage)
 
         from datafusion_tpu.exec.kernels import fuse_batch_count
 

@@ -58,11 +58,18 @@ class CsvDataSource(DataSource):
         self.has_header = has_header
         self.batch_size = batch_size
         self.projection = list(projection) if projection is not None else None
-        # native C++ parser when built (the host hot loop — reference
-        # `datasource.rs:31-50` is native too); pyarrow fallback
+        # two parsers, both full-fidelity and parity-tested in CI:
+        # the native C++ one (the host hot loop — reference
+        # `datasource.rs:31-50` is native too) selected by
+        # DATAFUSION_TPU_CSV_READER=native, and the pyarrow SIMD parser
+        # with auto_dict_encode (measured ~2x the native reader), the
+        # default
+        import os
+
         from datafusion_tpu.native import native_available
 
-        if native_available():
+        choice = os.environ.get("DATAFUSION_TPU_CSV_READER", "auto")
+        if choice == "native" and native_available():
             from datafusion_tpu.native.csv import NativeCsvReader
 
             self._reader = NativeCsvReader(

@@ -267,7 +267,7 @@ class PipelineRelation(Relation):
 
     def batches(self) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.batch import device_inputs
-        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_prefetch
+        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
 
         core = self.core
         batches = self.child.batches()
@@ -286,7 +286,7 @@ class PipelineRelation(Relation):
                 )
                 device_inputs(self._subset_view(b), self.device)
 
-            batches = staged_prefetch(batches, _stage)
+            batches = staged_pipeline(batches, _stage)
 
         for batch in batches:
             if not core.needs_kernel:

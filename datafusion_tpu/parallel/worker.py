@@ -189,6 +189,14 @@ def main(argv=None) -> int:
     ap.add_argument("--device", default=None,
                     help="execution device: cpu | tpu (default: jax default)")
     ap.add_argument("--batch-size", type=int, default=131072)
+    # multi-host accelerator bring-up (jax.distributed — the etcd
+    # replacement, SURVEY §5.8): workers on a TPU pod join one global
+    # mesh before serving fragments
+    ap.add_argument("--coordinator", default=None,
+                    help="jax.distributed coordinator address host:port "
+                         "(omit on single-host deployments)")
+    ap.add_argument("--num-processes", type=int, default=None)
+    ap.add_argument("--process-id", type=int, default=None)
     args = ap.parse_args(argv)
     # honor JAX_PLATFORMS even on hosts whose sitecustomize registers an
     # accelerator backend and overrides the env var at interpreter boot
@@ -198,6 +206,21 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", platforms)
+    if args.coordinator is not None or args.num_processes is not None:
+        from datafusion_tpu.parallel.mesh import initialize_distributed
+
+        initialize_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+        import jax
+
+        print(
+            f"distributed: process {jax.process_index()}/"
+            f"{jax.process_count()}, global devices {jax.device_count()}",
+            flush=True,
+        )
     server = serve(args.bind, device=args.device, batch_size=args.batch_size)
     host, port = server.server_address[:2]
     print(f"worker listening on {host}:{port}", flush=True)

@@ -1,0 +1,116 @@
+"""Multi-host seam on the real accelerator: a CPU coordinator ships
+plan fragments to a `python -m datafusion_tpu.worker --device tpu`
+OS process serving them on the attached chip, asserting parity with
+the single-process CPU engine.  Writes artifacts/TPU_WORKER_SMOKE.json.
+
+Run:  python scripts/tpu_worker_smoke.py
+(Equivalent pytest: DATAFUSION_TPU_TEST_TPU_WORKER=1
+ python -m pytest tests/test_distributed.py::TestTpuWorker)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+    schema = Schema(
+        [
+            Field("region", DataType.UTF8, False),
+            Field("v", DataType.INT64, False),
+            Field("x", DataType.FLOAT64, False),
+        ]
+    )
+    tmp = tempfile.mkdtemp(prefix="tpu_worker_smoke_")
+    rng = np.random.default_rng(3)
+    regions = ["north", "south", "east", "west"]
+    paths = []
+    rows_per = 50_000
+    n_parts = 4
+    for p in range(n_parts):
+        path = os.path.join(tmp, f"part{p}.csv")
+        with open(path, "w") as f:
+            f.write("region,v,x\n")
+            for _ in range(rows_per):
+                f.write(
+                    f"{regions[rng.integers(0, 4)]},"
+                    f"{int(rng.integers(-1000, 1000))},"
+                    f"{rng.uniform(0, 100):.4f}\n"
+                )
+        paths.append(path)
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the accelerator register
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    worker = subprocess.Popen(
+        [sys.executable, "-m", "datafusion_tpu.worker",
+         "--bind", "127.0.0.1:0", "--device", "tpu"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    try:
+        line = worker.stdout.readline()
+        assert "listening on" in line, line
+        host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+        info = worker.stdout.readline().strip()
+        print(f"worker: {info}", flush=True)
+
+        def pds():
+            return PartitionedDataSource(
+                [CsvDataSource(p, schema, True, 131072) for p in paths]
+            )
+
+        dctx = DistributedContext([(host, int(port))])
+        dctx.register_datasource("t", pds())
+        lctx = ExecutionContext(device="cpu")
+        lctx.register_datasource("t", pds())
+        sql = (
+            "SELECT region, COUNT(1), SUM(v), MIN(v), MAX(v), AVG(x) "
+            "FROM t WHERE v > -500 GROUP BY region"
+        )
+        t0 = time.perf_counter()
+        got = sorted(collect(dctx.sql(sql)).to_rows())
+        elapsed = time.perf_counter() - t0
+        want = sorted(collect(lctx.sql(sql)).to_rows())
+        assert len(got) == len(want) == 4
+        for g, w in zip(got, want):
+            assert g[:2] == w[:2], (g, w)
+            np.testing.assert_allclose(
+                np.asarray(g[2:], float), np.asarray(w[2:], float), rtol=1e-6
+            )
+        artifact = {
+            "worker_info": info,
+            "rows": rows_per * n_parts,
+            "partitions": n_parts,
+            "query_s": round(elapsed, 3),
+            "groups": len(got),
+            "parity": "exact keys/counts; numeric rtol<=1e-6 vs CPU engine",
+        }
+        os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+        out = os.path.join(REPO, "artifacts", "TPU_WORKER_SMOKE.json")
+        with open(out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps(artifact))
+        return 0
+    finally:
+        worker.terminate()
+        worker.wait(timeout=10)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -144,7 +144,10 @@ class TestNativeCsvParity:
 
 
 class TestNativeEngine:
-    def test_sql_through_native_reader(self):
+    def test_sql_through_native_reader(self, monkeypatch):
+        # the native C++ reader is the explicit-selection path (the
+        # default is the faster pyarrow SIMD parser)
+        monkeypatch.setenv("DATAFUSION_TPU_CSV_READER", "native")
         ctx = ExecutionContext(batch_size=8)
         ctx.register_csv("cities", os.path.join(DATA, "uk_cities.csv"),
                          UK_SCHEMA, has_header=False)
