@@ -52,6 +52,7 @@ class CsvDataSource(DataSource):
         has_header: bool = True,
         batch_size: int = DEFAULT_BATCH_SIZE,
         projection: Optional[Sequence[int]] = None,
+        reader: Optional[str] = None,
     ):
         self.path = path
         self.table_schema = schema
@@ -60,7 +61,8 @@ class CsvDataSource(DataSource):
         self.projection = list(projection) if projection is not None else None
         # two parsers, both full-fidelity and parity-tested in CI:
         # the native C++ one (the host hot loop — reference
-        # `datasource.rs:31-50` is native too) selected by
+        # `datasource.rs:31-50` is native too) selected per-source via
+        # `reader="native"` or process-wide via
         # DATAFUSION_TPU_CSV_READER=native, and the pyarrow SIMD parser
         # with auto_dict_encode (measured ~2x the native reader), the
         # default
@@ -68,7 +70,8 @@ class CsvDataSource(DataSource):
 
         from datafusion_tpu.native import native_available
 
-        choice = os.environ.get("DATAFUSION_TPU_CSV_READER", "auto")
+        self.reader_choice = reader
+        choice = reader or os.environ.get("DATAFUSION_TPU_CSV_READER", "auto")
         if choice == "native" and native_available():
             from datafusion_tpu.native.csv import NativeCsvReader
 
@@ -89,7 +92,8 @@ class CsvDataSource(DataSource):
 
     def with_projection(self, projection: Sequence[int]) -> "CsvDataSource":
         return CsvDataSource(
-            self.path, self.table_schema, self.has_header, self.batch_size, projection
+            self.path, self.table_schema, self.has_header, self.batch_size,
+            projection, reader=self.reader_choice,
         )
 
     def to_meta(self) -> dict:

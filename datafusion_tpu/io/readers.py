@@ -19,6 +19,7 @@ import numpy as np
 from datafusion_tpu.datatypes import DataType, Schema
 from datafusion_tpu.errors import ExecutionError, IoError
 from datafusion_tpu.exec.batch import RecordBatch, StringDictionary, make_host_batch
+from datafusion_tpu.io.io_thread import confined_iter, run_on_io_thread
 from datafusion_tpu.utils.metrics import METRICS
 
 DEFAULT_BATCH_SIZE = 131072
@@ -115,7 +116,14 @@ class CsvReader:
         ]
 
     def batches(self) -> Iterator[RecordBatch]:
-        yield from METRICS.timed_iter("scan.parse", self._batches())
+        # pyarrow work is confined to the persistent IO threads — scans
+        # issued from short-lived threads (server handlers) otherwise
+        # intermittently segfault inside pyarrow (io_thread.py
+        # docstring).  timed_iter sits INSIDE the confinement so
+        # scan.parse measures parse work, not queue wait.
+        yield from confined_iter(
+            METRICS.timed_iter("scan.parse", self._batches())
+        )
 
     def _batches(self) -> Iterator[RecordBatch]:
         import pyarrow as pa
@@ -265,7 +273,10 @@ class ParquetReader:
         ]
 
     def batches(self) -> Iterator[RecordBatch]:
-        yield from METRICS.timed_iter("scan.parse", self._batches())
+        # confined for the same reason as CsvReader.batches
+        yield from confined_iter(
+            METRICS.timed_iter("scan.parse", self._batches())
+        )
 
     def _batches(self) -> Iterator[RecordBatch]:
         import pyarrow as pa
@@ -298,11 +309,14 @@ class ParquetReader:
 
 def infer_parquet_schema(path: str) -> Schema:
     """Derive an engine Schema from parquet file metadata."""
-    import pyarrow.parquet as pq
-
     from datafusion_tpu.datatypes import Field
 
-    arrow_schema = pq.ParquetFile(path).schema_arrow
+    def _read_schema(p):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(p).schema_arrow
+
+    arrow_schema = run_on_io_thread(_read_schema, path)
     mapping = {
         "bool": DataType.BOOLEAN,
         "int8": DataType.INT8,

@@ -100,9 +100,12 @@ class PlanFragment:
     def logical_plan(self) -> LogicalPlan:
         return LogicalPlan.from_json(self.plan)
 
-    def build_datasource(self, batch_size: int):
+    def build_datasource(self, batch_size: int, csv_reader: Optional[str] = None):
         """Reconstruct the partition's DataSource from its wire meta —
-        what a remote worker does on receipt."""
+        what a remote worker does on receipt.  `csv_reader` pins the
+        CSV parser for the rebuilt sources (workers pass "native" so
+        handler-thread scans avoid pyarrow) without touching the
+        process-wide env knob."""
         from datafusion_tpu.datatypes import Schema
         from datafusion_tpu.exec.datasource import (
             CsvDataSource,
@@ -115,7 +118,7 @@ class PlanFragment:
             m = meta["CsvFile"]
             return CsvDataSource(
                 m["filename"], Schema.from_json(m["schema"]), m["has_header"],
-                batch_size, m.get("projection"),
+                batch_size, m.get("projection"), reader=csv_reader,
             )
         if "ParquetFile" in meta:
             m = meta["ParquetFile"]
@@ -134,7 +137,7 @@ class PlanFragment:
 
             children = [
                 PlanFragment(self.shard, self.num_shards, self.plan, child_meta)
-                .build_datasource(batch_size)
+                .build_datasource(batch_size, csv_reader)
                 for child_meta in meta["Partitioned"]
             ]
             return PartitionedDataSource(children)

@@ -55,7 +55,17 @@ class WorkerState:
     def _relation(self, frag: PlanFragment):
         plan = frag.logical_plan()
         scan = _find_scan(plan)
-        ds = frag.build_datasource(self.batch_size)
+        # worker scans run on server handler threads: prefer the C++
+        # CSV reader there (no pyarrow on the CSV path at all; when the
+        # native lib is unavailable the pyarrow leg stays safe via the
+        # io_thread confinement).  Scoped per-datasource on purpose —
+        # a process embedding a worker keeps its own reader default —
+        # while an explicit DATAFUSION_TPU_CSV_READER still wins (the
+        # soak test pins "auto" to stress the pyarrow leg).
+        import os
+
+        choice = os.environ.get("DATAFUSION_TPU_CSV_READER") or "native"
+        ds = frag.build_datasource(self.batch_size, csv_reader=choice)
         ctx = ExecutionContext(device=self.device, batch_size=self.batch_size)
         ctx.register_datasource(scan.table_name, ds)
         return ctx.execute(plan), plan

@@ -62,24 +62,26 @@ def workers():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
-    for _ in range(2):
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "datafusion_tpu.worker",
-             "--bind", "127.0.0.1:0", "--device", "cpu"],
-            cwd=REPO, env=env,
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
-        )
-        line = proc.stdout.readline()  # "worker listening on host:port"
-        assert "listening on" in line, line
-        host_port = line.strip().rsplit(" ", 1)[1]
-        host, port = host_port.rsplit(":", 1)
-        procs.append(proc)
-        addrs.append((host, int(port)))
-    yield procs, addrs
-    for p in procs:
-        p.terminate()
-    for p in procs:
-        p.wait(timeout=10)
+    try:
+        for _ in range(2):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "datafusion_tpu.worker",
+                 "--bind", "127.0.0.1:0", "--device", "cpu"],
+                cwd=REPO, env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            )
+            procs.append(proc)
+            line = proc.stdout.readline()  # "worker listening on host:port"
+            assert "listening on" in line, line
+            host_port = line.strip().rsplit(" ", 1)[1]
+            host, port = host_port.rsplit(":", 1)
+            addrs.append((host, int(port)))
+        yield procs, addrs
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
 
 
 def _contexts(addrs, paths):
@@ -348,3 +350,81 @@ class TestTpuWorker:
         finally:
             proc.terminate()
             proc.wait(timeout=10)
+
+
+class TestWorkerSoak:
+    """A worker must survive sustained query pressure from fresh handler
+    threads.  Regression for the round-3 SIGSEGV: pyarrow scans issued
+    from short-lived `ThreadingTCPServer` handler threads intermittently
+    crashed the worker on its 2nd+ query; scans are now confined to one
+    persistent IO thread (io/io_thread.py) and workers default to the
+    C++ CSV reader.  The soak worker is pinned to the PYARROW reader leg
+    on purpose — the worst case — and every request opens a fresh
+    connection, so each of the 100 queries runs on a brand-new thread."""
+
+    @pytest.fixture(scope="class")
+    def soak_worker(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        env["DATAFUSION_TPU_CSV_READER"] = "auto"  # force the pyarrow leg
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "datafusion_tpu.worker",
+             "--bind", "127.0.0.1:0", "--device", "cpu"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        try:
+            line = proc.stdout.readline()
+            assert "listening on" in line, line
+            host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+            yield proc, (host, int(port))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_100_query_soak(self, tmp_path, soak_worker):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from datafusion_tpu.exec.datasource import CsvDataSource, ParquetDataSource
+        from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+        proc, addr = soak_worker
+        csv_paths = _write_partitions(tmp_path, n_parts=2, rows_per=300)
+        rng = np.random.default_rng(43)
+        pq_path = str(tmp_path / "soak.parquet")
+        pq.write_table(
+            pa.table({"g": pa.array(rng.integers(0, 4, 300)),
+                      "v": pa.array(rng.uniform(-1, 1, 300))}),
+            pq_path,
+        )
+
+        def fresh_ctx():
+            # a fresh context per query: no connection reuse, maximum
+            # handler-thread churn on the worker
+            dctx = DistributedContext([addr])
+            dctx.register_datasource(
+                "t",
+                PartitionedDataSource(
+                    [CsvDataSource(p, SCHEMA, True, 131072) for p in csv_paths]
+                ),
+            )
+            dctx.register_datasource(
+                "pq", PartitionedDataSource([ParquetDataSource(pq_path)])
+            )
+            return dctx
+
+        queries = [
+            "SELECT region, SUM(v), COUNT(1), MIN(city) FROM t GROUP BY region",
+            "SELECT region, v, x FROM t WHERE v > 200",
+            "SELECT g, COUNT(1), SUM(v) FROM pq GROUP BY g",
+        ]
+        baselines = [_rows(fresh_ctx(), q) for q in queries]
+        for i in range(100):
+            q = i % len(queries)
+            assert _rows(fresh_ctx(), queries[q]) == baselines[q], (
+                f"query #{i} diverged"
+            )
+            assert proc.poll() is None, f"worker died after query #{i}"
+        assert proc.poll() is None
