@@ -427,7 +427,7 @@ class _AggregateCore:
     every executable in its cache."""
 
     def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions,
-                 param_slots=None):
+                 param_slots=None, host_pred=False):
         for g in group_expr:
             if not isinstance(g, Column):
                 raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
@@ -441,9 +441,29 @@ class _AggregateCore:
             self.specs.append(AggregateSpec(a, in_schema))
 
         compiler = ExprCompiler(in_schema, functions, param_slots)
-        self._pred_fn = compiler.compile(predicate) if predicate is not None else None
+        # under `host_pred` (accelerator devices, numpy-evaluable
+        # predicate) the filter evaluates on the host per batch and
+        # travels as a bit-packed mask — the predicate's input columns
+        # never cross H2D at all (relation._host_routed rationale)
+        self.host_predicate = predicate if host_pred else None
+        self._pred_fn = (
+            compiler.compile(predicate)
+            if predicate is not None and not host_pred
+            else None
+        )
         self.slots = self._build_slots(compiler)
         self.aux_specs = compiler.aux_specs
+        # ship only the columns the kernel reads (group keys travel as
+        # dense ids, a host-predicate's inputs not at all); Env's
+        # col_map translates schema indices to subset positions
+        used: set[int] = set()
+        if predicate is not None and not host_pred:
+            predicate.collect_columns(used)
+        for a in aggr_expr:
+            a.collect_columns(used)
+        self.used_cols = sorted(used)
+        self.col_map = {c: i for i, c in enumerate(self.used_cols)}
+        self.sub_schema = in_schema.select(self.used_cols)
         self.jit = jax.jit(self._kernel)
         self.fused_jit = jax.jit(self._fused_kernel)
 
@@ -459,12 +479,16 @@ class _AggregateCore:
         return state
 
     @staticmethod
-    def param_exprs(predicate, aggr_expr):
-        """Exprs compiled into the device kernel, in slot order."""
-        return ([] if predicate is None else [predicate]) + list(aggr_expr)
+    def param_exprs(predicate, aggr_expr, host_pred=False):
+        """Exprs compiled into the device kernel, in slot order (a
+        host-routed predicate keeps its literal values inline; the
+        cache key carries the full expr for it)."""
+        dev_pred = [] if predicate is None or host_pred else [predicate]
+        return dev_pred + list(aggr_expr)
 
     @staticmethod
-    def build(in_schema, group_expr, aggr_expr, predicate, functions):
+    def build(in_schema, group_expr, aggr_expr, predicate, functions,
+              host_pred=False):
         from datafusion_tpu.exec.kernels import (
             cached_kernel,
             functions_fingerprint,
@@ -472,22 +496,28 @@ class _AggregateCore:
             schema_fingerprint,
         )
 
-        elig = _AggregateCore.param_exprs(predicate, aggr_expr)
+        elig = _AggregateCore.param_exprs(predicate, aggr_expr, host_pred)
         fps, slot_by_id, _ = parameterize_exprs(elig)
-        n_pred = 0 if predicate is None else 1
+        n_pred = 0 if predicate is None or host_pred else 1
+        if predicate is None:
+            pred_key = None
+        elif host_pred:
+            pred_key = ("hostpred", predicate)
+        else:
+            pred_key = fps[0]
         key = (
             "aggregate",
             schema_fingerprint(in_schema),
             tuple(group_expr),
             fps[n_pred:],
-            fps[0] if n_pred else None,
+            pred_key,
             functions_fingerprint(functions),
         )
         return cached_kernel(
             key,
             lambda: _AggregateCore(
                 in_schema, group_expr, aggr_expr, predicate, functions,
-                slot_by_id,
+                slot_by_id, host_pred,
             ),
         )
 
@@ -570,7 +600,7 @@ class _AggregateCore:
 
     def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state,
                 str_aux=(), params=()):
-        env = Env(cols, valids, aux, params=params)
+        env = Env(cols, valids, aux, self.col_map, params)
         capacity = cols[0].shape[0] if cols else ids.shape[0]
         mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
         if base_mask is not None:
@@ -850,15 +880,30 @@ class AggregateRelation(Relation):
         self.child = child
         self._schema = out_schema
         self.device = device
+        from datafusion_tpu.exec.hostfn import host_evaluable
+        from datafusion_tpu.exec.relation import _is_accelerator
+
+        # On accelerators a numpy-evaluable predicate runs on the host:
+        # its mask travels bit-packed, its input columns don't travel at
+        # all (the Q1 shipdate filter drops ~12 MB of dict codes per
+        # SF-1 scan to a 0.75 MB mask).  No function metas reach this
+        # ctor, so predicates containing UDFs conservatively stay on
+        # device ({} finds no host_fn).
+        host_pred = (
+            predicate is not None
+            and _is_accelerator(device)
+            and host_evaluable(predicate, {}, child.schema)
+        )
         self.core = _AggregateCore.build(
-            child.schema, list(group_expr), list(aggr_expr), predicate, functions
+            child.schema, list(group_expr), list(aggr_expr), predicate,
+            functions, host_pred,
         )
         # THIS query's literal values for the shared core's parameter
         # slots (identical fingerprints guarantee identical slot order)
         from datafusion_tpu.exec.kernels import parameterize_exprs
 
         self._params = parameterize_exprs(
-            _AggregateCore.param_exprs(predicate, list(aggr_expr))
+            _AggregateCore.param_exprs(predicate, list(aggr_expr), host_pred)
         )[2]
         self.key_cols = self.core.key_cols
         self.specs = self.core.specs
@@ -976,7 +1021,7 @@ class AggregateRelation(Relation):
                     tuple(compute_aux_values(self._aux_specs, b, self._aux_cache)),
                     self._compute_str_aux(b),
                 )
-                device_inputs(b, self.device)
+                device_inputs(self._device_view(b), self.device)
 
             batches = staged_pipeline(batches, _stage)
 
@@ -1029,7 +1074,9 @@ class AggregateRelation(Relation):
                 aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
                 str_aux = self._compute_str_aux(batch)
             with device_scope(self.device):
-                data, validity, mask = device_inputs(batch, self.device)
+                data, validity, mask = device_inputs(
+                    self._device_view(batch), self.device
+                )
             chunk.append(
                 (data, validity, tuple(aux), np.int32(batch.num_rows), mask,
                  ids, str_aux)
@@ -1040,6 +1087,44 @@ class AggregateRelation(Relation):
         if state is None:
             state = self._init_state(group_capacity(1))
         return state
+
+    def _device_view(self, batch: RecordBatch) -> RecordBatch:
+        """The batch as the device kernel sees it: only `used_cols`
+        (group keys travel as dense ids, host-predicate inputs not at
+        all), with the host-evaluated predicate folded into the mask.
+        Cached on the batch (core-pinned) so re-scanned in-memory
+        batches keep their device copies across runs."""
+        core = self.core
+        if core.host_predicate is None and len(core.used_cols) == batch.num_columns:
+            return batch
+        key = "agg_view"
+        hit = batch.cache.get(key)
+        if hit is not None and hit[0] is core:
+            return hit[1]
+        mask = batch.mask
+        if core.host_predicate is not None:
+            from datafusion_tpu.exec.hostfn import eval_host_expr
+
+            pv, pvalid = eval_host_expr(core.host_predicate, batch, {})
+            pm = np.broadcast_to(np.asarray(pv, dtype=bool), (batch.capacity,))
+            if pvalid is not None:
+                pm = pm & np.broadcast_to(
+                    np.asarray(pvalid, dtype=bool), (batch.capacity,)
+                )
+            # an upstream device mask would need a D2H pull to combine
+            # host-side — rare (the planner fuses filters into the
+            # aggregate), and still correct when it happens
+            mask = pm if mask is None else (np.asarray(mask) & pm)
+        view = RecordBatch(
+            core.sub_schema,
+            [batch.data[c] for c in core.used_cols],
+            [batch.validity[c] for c in core.used_cols],
+            [batch.dicts[c] for c in core.used_cols],
+            num_rows=batch.num_rows,
+            mask=mask,
+        )
+        batch.cache[key] = (core, view)
+        return view
 
     def _group_ids(self, batch: RecordBatch):
         """Device array of dense group ids for one batch; cached on the

@@ -59,6 +59,71 @@ def _and_valid(a, b):
     return a & b
 
 
+_CMP_OPS = (
+    Operator.Eq, Operator.NotEq,
+    Operator.Lt, Operator.LtEq, Operator.Gt, Operator.GtEq,
+)
+
+_CMP_SYMBOL = {
+    Operator.Lt: "<", Operator.LtEq: "<=",
+    Operator.Gt: ">", Operator.GtEq: ">=",
+}
+
+
+def _string_literal_cmp(expr: Expr, schema) -> Optional[tuple]:
+    """(column, op, literal_str, flipped) when `expr` compares a Utf8
+    column against a string literal — the shape eval_host_expr handles
+    via the dictionary compare table (no decode)."""
+    if not isinstance(expr, BinaryExpr) or expr.op not in _CMP_OPS:
+        return None
+    for col, lit, flipped in (
+        (expr.left, expr.right, False),
+        (expr.right, expr.left, True),
+    ):
+        if (
+            isinstance(col, Column)
+            and schema.field(col.index).data_type == DataType.UTF8
+            and isinstance(lit, Literal)
+            and not lit.value.is_null
+            and isinstance(lit.value.value, str)
+        ):
+            return col, expr.op, lit.value.value, flipped
+    return None
+
+
+def host_evaluable(expr: Expr, metas: dict[str, FunctionMeta], schema) -> bool:
+    """True when eval_host_expr can evaluate `expr` with numpy alone,
+    cheaply: no ScalarFunction whose only implementation is a jax_fn
+    (calling that from the host would bounce through the accelerator)
+    and no Utf8 column references in positions that would force a
+    decode to python object arrays — fine for the rare host-fn string
+    producers, too slow to opt into for bulk routing.  Utf8-vs-literal
+    comparisons ARE allowed: they evaluate against the dictionary
+    compare table, codes only (the TPC-H shipdate filter shape)."""
+    if isinstance(expr, Column):
+        return schema.field(expr.index).data_type != DataType.UTF8
+    if isinstance(expr, Literal):
+        return True
+    if isinstance(expr, (Cast, IsNull, IsNotNull)):
+        return host_evaluable(expr.expr, metas, schema)
+    if isinstance(expr, BinaryExpr):
+        if _string_literal_cmp(expr, schema) is not None:
+            return True
+        if expr.op not in _NUMPY_OPS and expr.op not in (
+            Operator.Divide, Operator.Modulus,
+        ):
+            return False
+        return host_evaluable(expr.left, metas, schema) and host_evaluable(
+            expr.right, metas, schema
+        )
+    if isinstance(expr, ScalarFunction):
+        fm = metas.get(expr.name.lower())
+        if fm is None or fm.host_fn is None:
+            return False
+        return all(host_evaluable(a, metas, schema) for a in expr.args)
+    return False
+
+
 _NUMPY_OPS = {
     Operator.Plus: np.add,
     Operator.Minus: np.subtract,
@@ -71,7 +136,6 @@ _NUMPY_OPS = {
     Operator.GtEq: np.greater_equal,
     Operator.And: np.logical_and,
     Operator.Or: np.logical_or,
-    Operator.Modulus: np.mod,
 }
 
 
@@ -111,15 +175,71 @@ def eval_host_expr(
             return np.ones(batch.capacity, bool), None
         return valid, None
     if isinstance(expr, BinaryExpr):
+        cmp = _string_literal_cmp(expr, batch.schema)
+        if cmp is not None:
+            col, op, lit, flipped = cmp
+            d = batch.dicts[col.index]
+            if d is not None:
+                codes = np.asarray(batch.data[col.index])
+                v = batch.validity[col.index]
+                valid = None if v is None else np.asarray(v)
+                if flipped:
+                    op = {
+                        Operator.Lt: Operator.Gt, Operator.Gt: Operator.Lt,
+                        Operator.LtEq: Operator.GtEq,
+                        Operator.GtEq: Operator.LtEq,
+                    }.get(op, op)
+                if op == Operator.Eq:
+                    return codes == np.int32(d.code_of(lit)), valid
+                if op == Operator.NotEq:
+                    return codes != np.int32(d.code_of(lit)), valid
+                # ordered: gather the per-code compare table (identical
+                # to the device kernel's aux-table gather)
+                table = d.compare_table(_CMP_SYMBOL[op], lit)
+                if len(table) == 0:
+                    return np.zeros(len(codes), bool), valid
+                return table[codes], valid
+            # no dictionary: fall through to the generic decode path
         lv, lvalid = eval_host_expr(expr.left, batch, metas)
         rv, rvalid = eval_host_expr(expr.right, batch, metas)
         if expr.op == Operator.Divide:
             out_int = expr.get_type(batch.schema).is_integer
             with np.errstate(divide="ignore", invalid="ignore"):
-                val = (
-                    np.floor_divide(lv, rv) if out_int else np.true_divide(lv, rv)
-                )
+                if out_int:
+                    # C-style truncated division, matching the device
+                    # compiler's lax.div (expression.py `_div`) — numpy's
+                    # floor_divide floors, which differs on negatives
+                    q = np.floor_divide(lv, rv)
+                    r = lv - q * rv
+                    val = q + ((r != 0) & ((lv < 0) != (rv < 0)))
+                else:
+                    val = np.true_divide(lv, rv)
             return val, _and_valid(lvalid, rvalid)
+        if expr.op == Operator.Modulus:
+            # C-style remainder (sign of dividend), matching lax.rem —
+            # numpy's np.mod uses the divisor's sign instead
+            with np.errstate(divide="ignore", invalid="ignore"):
+                val = np.fmod(lv, rv)
+            return val, _and_valid(lvalid, rvalid)
+        if expr.op in (Operator.And, Operator.Or):
+            # SQL three-valued logic, mirroring the device compiler
+            # (expression.py bool_fn): FALSE AND NULL = FALSE,
+            # TRUE OR NULL = TRUE — a null operand must not poison a
+            # determined result
+            if lvalid is None and rvalid is None:
+                val = (lv & rv) if expr.op == Operator.And else (lv | rv)
+                return val, None
+            lva = np.ones((), bool) if lvalid is None else lvalid
+            rva = np.ones((), bool) if rvalid is None else rvalid
+            lv = np.asarray(lv, bool)
+            rv = np.asarray(rv, bool)
+            lv_t = lv & lva  # known TRUE
+            rv_t = rv & rva
+            lv_f = ~lv & lva  # known FALSE
+            rv_f = ~rv & rva
+            if expr.op == Operator.And:
+                return lv_t & rv_t, (lva & rva) | lv_f | rv_f
+            return lv_t | rv_t, (lva & rva) | lv_t | rv_t
         op = _NUMPY_OPS.get(expr.op)
         if op is None:
             raise NotSupportedError(f"host eval of operator {expr.op!r}")

@@ -34,6 +34,14 @@ def device_scope(device):
     return jax.default_device(device) if device is not None else nullcontext()
 
 
+def _is_accelerator(device) -> bool:
+    """True when batches execute on a non-CPU device (`device` is a jax
+    Device, or None = the JAX default backend)."""
+    if device is not None:
+        return getattr(device, "platform", "cpu") != "cpu"
+    return jax.default_backend() != "cpu"
+
+
 class Relation:
     """Pull-based iterator of RecordBatches (reference `Relation` trait)."""
 
@@ -59,6 +67,23 @@ class DataSourceRelation(Relation):
         return self.datasource.batches()
 
 
+def _host_routed(e, metas, in_schema, host_scalar: bool) -> bool:
+    """Should projection expr `e` evaluate on the host instead of inside
+    the device kernel?  Always for host-only functions; additionally,
+    under `host_scalar` (accelerator devices), for any numpy-evaluable
+    scalar expression — computing a+b on one CPU core costs
+    milliseconds, while shipping the computed column back over the
+    device link costs D2H bytes, the scarce resource (BASELINE.md: the
+    tunneled link moves D2H at ~0.01-0.025 GB/s)."""
+    from datafusion_tpu.exec.hostfn import contains_host_fn, host_evaluable
+
+    if contains_host_fn(e, metas):
+        return True
+    if not host_scalar or isinstance(e, Column):
+        return False
+    return host_evaluable(e, metas, in_schema)
+
+
 class _PipelineCore:
     """The compiled, shareable part of a pipeline: expression closures
     and the jitted kernel.  Cached process-wide by plan fingerprint
@@ -67,7 +92,7 @@ class _PipelineCore:
     with it every compiled executable in jit's cache."""
 
     def __init__(self, in_schema, predicate, projections, functions, metas,
-                 param_slots=None):
+                 param_slots=None, host_scalar=False):
         from datafusion_tpu.exec.hostfn import contains_host_fn
 
         compiler = ExprCompiler(in_schema, functions, param_slots)
@@ -83,13 +108,18 @@ class _PipelineCore:
         # EXACT on TPU (f64 is emulated there: even an identity kernel
         # round-trip perturbs values by ~1e-14) and removes their D2H
         # transfer — only computed columns and the mask cross the link.
+        # Under `host_scalar` (accelerator devices) scalar arithmetic
+        # projections are host-routed too (_host_routed above): the
+        # device kernel shrinks to the predicate mask, and no computed
+        # column ever crosses D2H.
+        self.host_scalar = host_scalar
         self.host_proj: dict[int, Expr] = {}
         self.identity_proj: dict[int, int] = {}
         self.proj_fns = None
         if projections is not None:
             self.proj_fns = []
             for j, e in enumerate(projections):
-                if contains_host_fn(e, metas):
+                if _host_routed(e, metas, in_schema, host_scalar):
                     self.host_proj[j] = e
                     self.proj_fns.append(None)
                 elif isinstance(e, Column):
@@ -136,23 +166,24 @@ class _PipelineCore:
         self.jit = jax.jit(self._kernel)
 
     @staticmethod
-    def param_exprs(predicate, projections, metas):
+    def param_exprs(predicate, projections, metas, in_schema=None,
+                    host_scalar=False):
         """The exprs that compile into the device kernel, in slot-
         assignment order (host-evaluated projections keep their literal
         values inline — their exprs live in the shared core and run on
-        the host with the FIRST relation's values)."""
-        from datafusion_tpu.exec.hostfn import contains_host_fn
-
+        the host with the FIRST relation's values; the cache key carries
+        the full expr for them, so differing literals build new cores)."""
         elig = [] if predicate is None else [predicate]
         if projections is not None:
             elig.extend(
-                e for e in projections if not contains_host_fn(e, metas or {})
+                e for e in projections
+                if not _host_routed(e, metas or {}, in_schema, host_scalar)
             )
         return elig
 
     @staticmethod
-    def build(in_schema, predicate, projections, functions, metas):
-        from datafusion_tpu.exec.hostfn import contains_host_fn
+    def build(in_schema, predicate, projections, functions, metas,
+              host_scalar=False):
         from datafusion_tpu.exec.kernels import (
             cached_kernel,
             functions_fingerprint,
@@ -160,18 +191,22 @@ class _PipelineCore:
             schema_fingerprint,
         )
 
-        elig = _PipelineCore.param_exprs(predicate, projections, metas)
+        elig = _PipelineCore.param_exprs(
+            predicate, projections, metas, in_schema, host_scalar
+        )
         fps, slot_by_id, _ = parameterize_exprs(elig)
         fp_of = dict(zip((id(e) for e in elig), fps))
         proj_key = None
         if projections is not None:
             proj_key = tuple(
-                ("host", e) if contains_host_fn(e, metas or {})
+                ("host", e)
+                if _host_routed(e, metas or {}, in_schema, host_scalar)
                 else fp_of[id(e)]
                 for e in projections
             )
         key = (
             "pipeline",
+            host_scalar,
             schema_fingerprint(in_schema),
             None if predicate is None else fp_of[id(predicate)],
             proj_key,
@@ -181,7 +216,8 @@ class _PipelineCore:
         return cached_kernel(
             key,
             lambda: _PipelineCore(
-                in_schema, predicate, projections, functions, metas, slot_by_id
+                in_schema, predicate, projections, functions, metas,
+                slot_by_id, host_scalar,
             ),
         )
 
@@ -248,15 +284,19 @@ class PipelineRelation(Relation):
         self._schema = out_schema if out_schema is not None else child.schema
         self.device = device
         self._metas = function_metas or {}
+        host_scalar = _is_accelerator(device)
         self.core = _PipelineCore.build(
-            child.schema, predicate, projections, functions, self._metas
+            child.schema, predicate, projections, functions, self._metas,
+            host_scalar,
         )
         # THIS query's literal values for the shared core's parameter
         # slots (identical fingerprints guarantee identical slot order)
         from datafusion_tpu.exec.kernels import parameterize_exprs
 
         self._params = parameterize_exprs(
-            _PipelineCore.param_exprs(predicate, projections, self._metas)
+            _PipelineCore.param_exprs(
+                predicate, projections, self._metas, child.schema, host_scalar
+            )
         )[2]
         self._host_dicts: dict[int, "StringDictionary"] = {}
         self._aux_cache: dict = {}

@@ -114,8 +114,9 @@ _rr = itertools.count()
 
 
 def run_on_io_thread(fn: Callable, *args: Any, **kwargs: Any) -> Any:
-    """One-shot pyarrow call on a confinement thread."""
-    return _POOL[0].submit(fn, *args, **kwargs)
+    """One-shot pyarrow call on a confinement thread (round-robined so
+    it doesn't queue behind an in-flight scan step on one worker)."""
+    return _POOL[next(_rr) % _POOL_SIZE].submit(fn, *args, **kwargs)
 
 
 def confined_iter(gen: Iterator) -> Iterator:

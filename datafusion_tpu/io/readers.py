@@ -86,7 +86,10 @@ def _arrow_to_columns(
 
             null_mask = col.is_null().to_numpy(zero_copy_only=False)
             fill = False if pa.types.is_boolean(col.type) else 0
-            vals = col.fill_null(fill).to_numpy(zero_copy_only=False).astype(np_dtype)
+            vals = col.fill_null(fill).to_numpy(zero_copy_only=False)
+            # copy=False: parquet f64 columns arrive already-typed; the
+            # no-op astype would memcpy 48 MB per SF-1 numeric column
+            vals = np.asarray(vals).astype(np_dtype, copy=False)
             columns.append(vals)
             validity.append(None if not null_mask.any() else ~null_mask)
     return columns, validity

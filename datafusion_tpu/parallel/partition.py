@@ -476,9 +476,13 @@ class PartitionedAggregateRelation(AggregateRelation):
             _ShardFeed(rels) for rels in _round_robin(self.children, n)
         ]
         in_schema = self.child.schema
-        n_cols = len(in_schema)
         state = None
         group_cap = 0
+
+        sub_cols = self.core.used_cols
+        sub_dtypes = [
+            in_schema.field(i).data_type.np_dtype for i in sub_cols
+        ]
 
         while True:
             round_batches = [f.next_batch() for f in feeds]
@@ -490,9 +494,10 @@ class PartitionedAggregateRelation(AggregateRelation):
                 *(b.capacity for b in round_batches if b is not None),
             )
 
-            cols_np = [np.zeros((n, cap), dt) for dt in
-                       (in_schema.field(i).data_type.np_dtype for i in range(n_cols))]
-            valids_np = [np.ones((n, cap), bool) for _ in range(n_cols)]
+            # stack only the kernel's input columns (group keys travel
+            # as ids; a host-evaluated predicate's inputs not at all)
+            cols_np = [np.zeros((n, cap), dt) for dt in sub_dtypes]
+            valids_np = [np.ones((n, cap), bool) for _ in sub_cols]
             masks_np = np.ones((n, cap), bool)
             ids_np = np.zeros((n, cap), np.int32)
             rows_np = np.zeros((n,), np.int32)
@@ -504,12 +509,13 @@ class PartitionedAggregateRelation(AggregateRelation):
                 live_batch = b
                 rows_np[s_i] = b.num_rows
                 bc = b.capacity
-                for c_i in range(n_cols):
-                    cols_np[c_i][s_i, :bc] = np.asarray(b.data[c_i])
-                    if b.validity[c_i] is not None:
-                        valids_np[c_i][s_i, :bc] = np.asarray(b.validity[c_i])
-                if b.mask is not None:
-                    masks_np[s_i, :bc] = np.asarray(b.mask)
+                view = self._device_view(b)
+                for c_i in range(len(sub_cols)):
+                    cols_np[c_i][s_i, :bc] = np.asarray(view.data[c_i])
+                    if view.validity[c_i] is not None:
+                        valids_np[c_i][s_i, :bc] = np.asarray(view.validity[c_i])
+                if view.mask is not None:
+                    masks_np[s_i, :bc] = np.asarray(view.mask)
                 for idx in self.key_cols:
                     if b.dicts[idx] is not None:
                         self._key_dicts[idx] = b.dicts[idx]

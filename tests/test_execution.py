@@ -862,3 +862,115 @@ class TestWireCompression:
         assert np.array_equal(dec.view(np.int64), a.view(np.int64))
         # the values table is fixed-size: one decoder shape per capacity
         assert wires[1].shape == (256,)
+
+
+class TestHostRouting:
+    """Host-routed scalar projections / predicates (relation._host_routed):
+    active only on accelerator devices, so the CPU suite forces the mode
+    via monkeypatched `_is_accelerator` and asserts exact agreement with
+    the device-kernel path on the same queries."""
+
+    @pytest.fixture
+    def host_mode(self, monkeypatch):
+        import datafusion_tpu.exec.kernels as kernels
+        import datafusion_tpu.exec.relation as relation
+
+        monkeypatch.setattr(relation, "_is_accelerator", lambda device: True)
+        # host-routing changes kernel cache keys; isolate so other tests
+        # never see cores built in forced-host mode
+        saved = dict(kernels._REGISTRY)
+        kernels._REGISTRY.clear()
+        yield
+        kernels._REGISTRY.clear()
+        kernels._REGISTRY.update(saved)
+
+    def _both(self, make_ctx, sql):
+        from datafusion_tpu.exec.materialize import collect
+
+        return sorted(collect(make_ctx().sql(sql)).to_rows())
+
+    def test_scalar_projection_matches_device(self, ctx, host_mode, test_data_dir):
+        from datafusion_tpu.exec.materialize import collect
+
+        sql = (
+            "SELECT city, lat, lng, lat + lng, lat * 2 - lng "
+            "FROM cities WHERE lat > 51.0 AND lat < 53.0"
+        )
+        got = sorted(collect(ctx.sql(sql)).to_rows())
+        assert len(got) == 18
+        for row in got:
+            assert row[3] == row[1] + row[2]
+            assert row[4] == row[1] * 2 - row[2]
+
+    def test_int_division_modulus_parity(self, ctx, host_mode):
+        # C-style truncation on negatives: host eval must match the
+        # device kernel's lax.div/lax.rem semantics
+        from datafusion_tpu.exec.materialize import collect
+
+        rows = sorted(
+            collect(
+                ctx.sql("SELECT a, b, a / b, a % b FROM numerics WHERE b <> 0")
+            ).to_rows()
+        )
+        for a, b, q, r in rows:
+            # C-style truncation: round the true quotient toward zero
+            want_q = -(-a // b) if (a < 0) != (b < 0) and a % b != 0 else a // b
+            assert q == want_q, (a, b, q)
+            assert r == a - want_q * b, (a, b, r)
+
+    def test_string_predicate_aggregate(self, ctx, host_mode):
+        # Utf8-vs-literal predicate host-routes through the dictionary
+        # compare table on the aggregate path
+        from datafusion_tpu.exec.materialize import collect
+
+        got = collect(
+            ctx.sql(
+                "SELECT COUNT(1), MIN(city), MAX(lat) FROM cities "
+                "WHERE city > 'M'"
+            )
+        ).to_rows()
+        rows = collect(ctx.sql("SELECT city, lat FROM cities")).to_rows()
+        want = [r for r in rows if r[0] > "M"]
+        assert got[0][0] == len(want)
+        assert got[0][1] == min(r[0] for r in want)
+        assert got[0][2] == max(r[1] for r in want)
+
+    def test_nullable_predicate_and_projection(self, ctx, host_mode):
+        from datafusion_tpu.exec.materialize import collect
+
+        got = collect(
+            ctx.sql(
+                "SELECT c_int, c_int + 1, c_float / 2 FROM null_test "
+                "WHERE c_int IS NOT NULL"
+            )
+        ).to_rows()
+        assert all(r[0] is not None for r in got)
+        for r in got:
+            assert r[1] == r[0] + 1
+
+    def test_three_valued_logic_or_and(self, ctx, host_mode):
+        # TRUE OR NULL = TRUE / FALSE AND NULL = FALSE: a null operand
+        # must not poison a determined result (device bool_fn parity)
+        from datafusion_tpu.exec.materialize import collect
+
+        raw = collect(ctx.sql("SELECT c_int, c_float FROM null_test")).to_rows()
+
+        got = collect(
+            ctx.sql("SELECT COUNT(1) FROM null_test WHERE c_int > 0 OR c_float > 0")
+        ).to_rows()[0][0]
+        want = sum(
+            1 for ci, cf in raw
+            if (ci is not None and ci > 0) or (cf is not None and cf > 0)
+        )
+        assert got == want
+
+        got = collect(
+            ctx.sql(
+                "SELECT COUNT(1) FROM null_test WHERE c_int > 0 AND c_float > 0"
+            )
+        ).to_rows()[0][0]
+        want = sum(
+            1 for ci, cf in raw
+            if ci is not None and ci > 0 and cf is not None and cf > 0
+        )
+        assert got == want
