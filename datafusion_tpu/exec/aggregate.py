@@ -427,7 +427,7 @@ class _AggregateCore:
     every executable in its cache."""
 
     def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions,
-                 param_slots=None, host_pred=False):
+                 param_slots=None):
         for g in group_expr:
             if not isinstance(g, Column):
                 raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
@@ -441,23 +441,15 @@ class _AggregateCore:
             self.specs.append(AggregateSpec(a, in_schema))
 
         compiler = ExprCompiler(in_schema, functions, param_slots)
-        # under `host_pred` (accelerator devices, numpy-evaluable
-        # predicate) the filter evaluates on the host per batch and
-        # travels as a bit-packed mask — the predicate's input columns
-        # never cross H2D at all (relation._host_routed rationale)
-        self.host_predicate = predicate if host_pred else None
-        self._pred_fn = (
-            compiler.compile(predicate)
-            if predicate is not None and not host_pred
-            else None
-        )
+        self._pred_fn = compiler.compile(predicate) if predicate is not None else None
         self.slots = self._build_slots(compiler)
         self.aux_specs = compiler.aux_specs
         # ship only the columns the kernel reads (group keys travel as
-        # dense ids, a host-predicate's inputs not at all); Env's
+        # dense ids; a host-routed predicate never reaches this ctor,
+        # so its inputs don't appear here and never cross H2D); Env's
         # col_map translates schema indices to subset positions
         used: set[int] = set()
-        if predicate is not None and not host_pred:
+        if predicate is not None:
             predicate.collect_columns(used)
         for a in aggr_expr:
             a.collect_columns(used)
@@ -479,16 +471,12 @@ class _AggregateCore:
         return state
 
     @staticmethod
-    def param_exprs(predicate, aggr_expr, host_pred=False):
-        """Exprs compiled into the device kernel, in slot order (a
-        host-routed predicate keeps its literal values inline; the
-        cache key carries the full expr for it)."""
-        dev_pred = [] if predicate is None or host_pred else [predicate]
-        return dev_pred + list(aggr_expr)
+    def param_exprs(predicate, aggr_expr):
+        """Exprs compiled into the device kernel, in slot order."""
+        return ([] if predicate is None else [predicate]) + list(aggr_expr)
 
     @staticmethod
-    def build(in_schema, group_expr, aggr_expr, predicate, functions,
-              host_pred=False):
+    def build(in_schema, group_expr, aggr_expr, predicate, functions):
         from datafusion_tpu.exec.kernels import (
             cached_kernel,
             functions_fingerprint,
@@ -496,28 +484,22 @@ class _AggregateCore:
             schema_fingerprint,
         )
 
-        elig = _AggregateCore.param_exprs(predicate, aggr_expr, host_pred)
+        elig = _AggregateCore.param_exprs(predicate, aggr_expr)
         fps, slot_by_id, _ = parameterize_exprs(elig)
-        n_pred = 0 if predicate is None or host_pred else 1
-        if predicate is None:
-            pred_key = None
-        elif host_pred:
-            pred_key = ("hostpred", predicate)
-        else:
-            pred_key = fps[0]
+        n_pred = 0 if predicate is None else 1
         key = (
             "aggregate",
             schema_fingerprint(in_schema),
             tuple(group_expr),
             fps[n_pred:],
-            pred_key,
+            fps[0] if n_pred else None,
             functions_fingerprint(functions),
         )
         return cached_kernel(
             key,
             lambda: _AggregateCore(
                 in_schema, group_expr, aggr_expr, predicate, functions,
-                slot_by_id, host_pred,
+                slot_by_id,
             ),
         )
 
@@ -886,24 +868,29 @@ class AggregateRelation(Relation):
         # On accelerators a numpy-evaluable predicate runs on the host:
         # its mask travels bit-packed, its input columns don't travel at
         # all (the Q1 shipdate filter drops ~12 MB of dict codes per
-        # SF-1 scan to a 0.75 MB mask).  No function metas reach this
-        # ctor, so predicates containing UDFs conservatively stay on
-        # device ({} finds no host_fn).
+        # SF-1 scan to a 0.75 MB mask).  The predicate — literals and
+        # all — lives on THIS relation; the core is built as if there
+        # were no predicate, so every host-filtered query shape shares
+        # one device kernel regardless of literal values.  No function
+        # metas reach this ctor, so predicates containing UDFs
+        # conservatively stay on device ({} finds no host_fn).
         host_pred = (
             predicate is not None
             and _is_accelerator(device)
             and host_evaluable(predicate, {}, child.schema)
         )
+        self._host_pred_expr = predicate if host_pred else None
+        core_pred = None if host_pred else predicate
         self.core = _AggregateCore.build(
-            child.schema, list(group_expr), list(aggr_expr), predicate,
-            functions, host_pred,
+            child.schema, list(group_expr), list(aggr_expr), core_pred,
+            functions,
         )
         # THIS query's literal values for the shared core's parameter
         # slots (identical fingerprints guarantee identical slot order)
         from datafusion_tpu.exec.kernels import parameterize_exprs
 
         self._params = parameterize_exprs(
-            _AggregateCore.param_exprs(predicate, list(aggr_expr), host_pred)
+            _AggregateCore.param_exprs(core_pred, list(aggr_expr))
         )[2]
         self.key_cols = self.core.key_cols
         self.specs = self.core.specs
@@ -1095,17 +1082,17 @@ class AggregateRelation(Relation):
         Cached on the batch (core-pinned) so re-scanned in-memory
         batches keep their device copies across runs."""
         core = self.core
-        if core.host_predicate is None and len(core.used_cols) == batch.num_columns:
+        if self._host_pred_expr is None and len(core.used_cols) == batch.num_columns:
             return batch
         key = "agg_view"
         hit = batch.cache.get(key)
-        if hit is not None and hit[0] is core:
+        if hit is not None and hit[0] is self:
             return hit[1]
         mask = batch.mask
-        if core.host_predicate is not None:
+        if self._host_pred_expr is not None:
             from datafusion_tpu.exec.hostfn import eval_host_expr
 
-            pv, pvalid = eval_host_expr(core.host_predicate, batch, {})
+            pv, pvalid = eval_host_expr(self._host_pred_expr, batch, {})
             pm = np.broadcast_to(np.asarray(pv, dtype=bool), (batch.capacity,))
             if pvalid is not None:
                 pm = pm & np.broadcast_to(
@@ -1123,7 +1110,10 @@ class AggregateRelation(Relation):
             num_rows=batch.num_rows,
             mask=mask,
         )
-        batch.cache[key] = (core, view)
+        # pinned by RELATION, not core: the core is literal-insensitive
+        # and shared, but the host-predicate mask baked into this view
+        # carries THIS query's literals
+        batch.cache[key] = (self, view)
         return view
 
     def _group_ids(self, batch: RecordBatch):

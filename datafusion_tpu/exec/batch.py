@@ -48,11 +48,14 @@ class StringDictionary:
     recomputed only when the dictionary has grown.
     """
 
-    __slots__ = ("values", "index")
+    __slots__ = ("values", "index", "cmp_cache")
 
     def __init__(self):
         self.values: list[str] = []
         self.index: dict[str, int] = {}
+        # (op, literal) -> (version, table): host predicate eval reuses
+        # compare tables across batches (hostfn.eval_host_expr)
+        self.cmp_cache: dict = {}
 
     @property
     def version(self) -> int:

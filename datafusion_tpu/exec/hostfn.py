@@ -103,7 +103,10 @@ def host_evaluable(expr: Expr, metas: dict[str, FunctionMeta], schema) -> bool:
     if isinstance(expr, Column):
         return schema.field(expr.index).data_type != DataType.UTF8
     if isinstance(expr, Literal):
-        return True
+        # bare string literals stay on the device path so both paths
+        # raise the planner's NotSupportedError identically (inside
+        # comparisons they ride _string_literal_cmp, handled above)
+        return expr.value.is_null or not isinstance(expr.value.value, str)
     if isinstance(expr, (Cast, IsNull, IsNotNull)):
         return host_evaluable(expr.expr, metas, schema)
     if isinstance(expr, BinaryExpr):
@@ -194,8 +197,16 @@ def eval_host_expr(
                 if op == Operator.NotEq:
                     return codes != np.int32(d.code_of(lit)), valid
                 # ordered: gather the per-code compare table (identical
-                # to the device kernel's aux-table gather)
-                table = d.compare_table(_CMP_SYMBOL[op], lit)
+                # to the device kernel's aux-table gather), cached on
+                # the dictionary per (op, literal, version) — rebuilding
+                # is a python loop over every dictionary value
+                sym = _CMP_SYMBOL[op]
+                hit = d.cmp_cache.get((sym, lit))
+                if hit is None or hit[0] != d.version:
+                    table = d.compare_table(sym, lit)
+                    d.cmp_cache[(sym, lit)] = (d.version, table)
+                else:
+                    table = hit[1]
                 if len(table) == 0:
                     return np.zeros(len(codes), bool), valid
                 return table[codes], valid

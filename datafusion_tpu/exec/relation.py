@@ -169,10 +169,10 @@ class _PipelineCore:
     def param_exprs(predicate, projections, metas, in_schema=None,
                     host_scalar=False):
         """The exprs that compile into the device kernel, in slot-
-        assignment order (host-evaluated projections keep their literal
-        values inline — their exprs live in the shared core and run on
-        the host with the FIRST relation's values; the cache key carries
-        the full expr for them, so differing literals build new cores)."""
+        assignment order.  Host-routed projections are excluded: their
+        exprs (with each query's own literal values) live on the
+        relation (`PipelineRelation._host_proj`), and the cache key
+        carries their literal-parameterized fingerprints."""
         elig = [] if predicate is None else [predicate]
         if projections is not None:
             elig.extend(
@@ -198,8 +198,12 @@ class _PipelineCore:
         fp_of = dict(zip((id(e) for e in elig), fps))
         proj_key = None
         if projections is not None:
+            # host-routed exprs key by literal-parameterized fingerprint
+            # (their literal VALUES live on each relation, so numeric-
+            # literal variants share one compiled core exactly like
+            # device-routed exprs do)
             proj_key = tuple(
-                ("host", e)
+                ("host", parameterize_exprs([e])[0][0])
                 if _host_routed(e, metas or {}, in_schema, host_scalar)
                 else fp_of[id(e)]
                 for e in projections
@@ -289,6 +293,13 @@ class PipelineRelation(Relation):
             child.schema, predicate, projections, functions, self._metas,
             host_scalar,
         )
+        # THIS query's host-routed exprs (with its literal values) —
+        # the shared core only records which positions are host-routed
+        self._host_proj: dict[int, Expr] = {
+            j: e
+            for j, e in enumerate(projections or [])
+            if _host_routed(e, self._metas, child.schema, host_scalar)
+        }
         # THIS query's literal values for the shared core's parameter
         # slots (identical fingerprints guarantee identical slot order)
         from datafusion_tpu.exec.kernels import parameterize_exprs
@@ -336,8 +347,12 @@ class PipelineRelation(Relation):
                 # operators the same RecordBatch objects — their device
                 # copies (device_inputs cache) survive across runs
                 # instead of re-shipping every column per query run
+                # pinned by RELATION when host-routed exprs exist (their
+                # literal values are per-query; the core is shared
+                # across literals), by core otherwise
+                pin = self if self._host_proj else core
                 hit = batch.cache.get("pipeline_out")
-                if hit is not None and hit[0] is core:
+                if hit is not None and hit[0] is pin:
                     yield hit[1]
                     continue
                 cols, valids, mask = [], [], batch.mask
@@ -382,7 +397,9 @@ class PipelineRelation(Relation):
                 mask=mask,
             )
             if not core.needs_kernel:
-                batch.cache["pipeline_out"] = (core, out)
+                batch.cache["pipeline_out"] = (
+                    self if self._host_proj else core, out
+                )
             yield out
 
     def _subset_view(self, batch) -> RecordBatch:
@@ -421,7 +438,7 @@ class PipelineRelation(Relation):
                 cols.append(batch.data[src])
                 valids.append(batch.validity[src])
                 continue
-            host_expr = self.core.host_proj.get(j)
+            host_expr = self._host_proj.get(j)
             if host_expr is None:
                 cols.append(dev_cols[dev_i])
                 valids.append(dev_valids[dev_i])

@@ -510,6 +510,13 @@ class SortRelation(Relation):
 
         self._run_ops_cache: OrderedDict = OrderedDict()
         self._run_ops_cache_max = 4
+        # second-chance admission: a key must be SEEN twice before its
+        # device buffers are stored, so one-shot file scans (fresh batch
+        # objects every scan — their keys can never repeat) pin nothing.
+        # An id()-recycling false positive here merely admits an entry
+        # early; entries themselves pin their batches, so a stored key
+        # always identifies live objects.
+        self._run_seen: OrderedDict = OrderedDict()
 
     @property
     def schema(self) -> Schema:
@@ -776,9 +783,14 @@ class SortRelation(Relation):
         with _device_scope(self.device):
             dev_ops = tuple(put_compressed(host_ops, self.device))
         if cache_key is not None:
-            self._run_ops_cache[cache_key] = (dev_ops, pin)
-            while len(self._run_ops_cache) > self._run_ops_cache_max:
-                self._run_ops_cache.popitem(last=False)
+            if cache_key in self._run_seen:
+                self._run_ops_cache[cache_key] = (dev_ops, pin)
+                while len(self._run_ops_cache) > self._run_ops_cache_max:
+                    self._run_ops_cache.popitem(last=False)
+            else:
+                self._run_seen[cache_key] = True
+                while len(self._run_seen) > 32:
+                    self._run_seen.popitem(last=False)
         return self._sort_ops(dev_ops, n)
 
     def _sort_ops(self, dev_ops, n: int) -> np.ndarray:

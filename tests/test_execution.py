@@ -974,3 +974,25 @@ class TestHostRouting:
             if ci is not None and ci > 0 and cf is not None and cf > 0
         )
         assert got == want
+
+    def test_literal_variants_share_compiled_core(self, ctx, host_mode):
+        # host-routed predicates/projections must not fork the device
+        # kernel per literal value (SURVEY §7 recompilation control)
+        r1 = ctx.sql("SELECT lat + 1.0 FROM cities WHERE lat > 51.0")
+        r2 = ctx.sql("SELECT lat + 2.0 FROM cities WHERE lat > 52.0")
+        assert r1.core is r2.core
+        a1 = ctx.sql("SELECT COUNT(1), SUM(lat) FROM cities WHERE city > 'A'")
+        a2 = ctx.sql("SELECT COUNT(1), SUM(lat) FROM cities WHERE city > 'Q'")
+        assert a1.core is a2.core
+        from datafusion_tpu.exec.materialize import collect
+
+        # and each relation still applies ITS OWN literals
+        c1 = collect(a1).to_rows()[0][0]
+        c2 = collect(a2).to_rows()[0][0]
+        assert c1 > c2 > 0
+
+    def test_bare_string_literal_matches_device_error(self, ctx, host_mode):
+        from datafusion_tpu.errors import NotSupportedError
+
+        with pytest.raises(NotSupportedError):
+            ctx.sql_collect("SELECT city, 'x' FROM cities")
