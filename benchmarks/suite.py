@@ -236,9 +236,12 @@ def config3_tpch_q1(device_kind: str):
     batches = list(scan_src.batches())
     mem_src = MemoryDataSource(scan_src.schema, batches)
     cpu_warm_p50, cpu_warm_out = _warm_query("cpu", mem_src, "lineitem", Q1, rows)
+    utilization = {}
     if device_kind != "cpu":
         dev_warm_p50, dev_warm_out = _warm_query(device_kind, mem_src, "lineitem", Q1, rows)
         _assert_tables_match(dev_warm_out, cpu_warm_out, "config3 warm")
+        utilization = _q1_device_utilization(device_kind, mem_src, rows)
+        log(f"    utilization: {utilization}")
     else:
         dev_warm_p50 = cpu_warm_p50
 
@@ -254,6 +257,75 @@ def config3_tpch_q1(device_kind: str):
         "cold_p50_ms": round(dev_cold_p50 * 1e3, 2),
         "cold_vs_baseline": round(cpu_cold_p50 / dev_cold_p50, 3),
         "cold_breakdown": breakdown,
+        "utilization": utilization,
+    }
+
+
+def _q1_device_utilization(device_kind: str, mem_src, rows: int) -> dict:
+    """Device-side throughput and bandwidth utilization for the warm Q1
+    kernel, separated from the session's synchronization floor.
+
+    On the tunneled device every host<->device synchronization costs a
+    fixed ~100 ms once any D2H has occurred in the process (launches
+    pipeline; syncs do not), so the measured warm p50 is
+    sync-floor-bound.  This measures (a) the floor itself (a trivial
+    launch+block), and (b) N accumulate passes dispatched back-to-back
+    with ONE final block — the device-only rate with the floor
+    amortized — then converts bytes-touched into achieved HBM
+    bandwidth against the chip peak (v5e ~819 GB/s).
+    """
+    import time as _t
+
+    import jax
+    import jax.numpy as jnp
+
+    from datafusion_tpu.exec.context import ExecutionContext
+
+    ctx = ExecutionContext(device=device_kind, batch_size=1 << 19)
+    ctx.register_datasource("lineitem", mem_src)
+    rel = ctx.sql(Q1)
+    for _ in range(2):
+        jax.block_until_ready(rel.accumulate())
+
+    tiny = jnp.ones((8,))
+    trivial = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(trivial(tiny))
+    floors = []
+    for _ in range(5):
+        t0 = _t.perf_counter()
+        jax.block_until_ready(trivial(tiny))
+        floors.append(_t.perf_counter() - t0)
+    sync_floor = float(np.median(floors))
+
+    n_passes = 5
+    t0 = _t.perf_counter()
+    states = [rel.accumulate() for _ in range(n_passes)]
+    jax.block_until_ready(states)
+    total = _t.perf_counter() - t0
+    device_time = max(total - sync_floor, 1e-9)
+    dev_rows_s = n_passes * rows / device_time
+
+    # traffic lower bound: every input column read once per pass —
+    # 4 f64 value columns (quantity, extendedprice, discount, tax; the
+    # derived slots compute on-device from these), 2 narrow key-code
+    # columns, dense int32 ids, 1-byte mask
+    bytes_per_pass = rows * (4 * 8 + 2 * 4 + 4 + 1)
+    hbm_gbps = n_passes * bytes_per_pass / device_time / 1e9
+    peaks = {"tpu": 819.0, "v5e": 819.0, "v4": 1228.0, "v6e": 1640.0}
+    dev0 = jax.devices()[0]
+    kind = getattr(dev0, "device_kind", "").lower()
+    peak_gbps = next(
+        (v for k, v in peaks.items() if k != "tpu" and k in kind),
+        peaks["tpu"],
+    )
+    peak_gbps = float(os.environ.get("BENCH_HBM_PEAK_GBPS", peak_gbps))
+    return {
+        "sync_floor_ms": round(sync_floor * 1e3, 1),
+        "device_rows_per_s": round(dev_rows_s, 1),
+        "device_time_per_pass_ms": round(device_time / n_passes * 1e3, 2),
+        "hbm_gbps_achieved": round(hbm_gbps, 1),
+        "hbm_peak_gbps": peak_gbps,
+        "hbm_util_pct": round(100 * hbm_gbps / peak_gbps, 2),
     }
 
 

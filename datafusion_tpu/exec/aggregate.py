@@ -23,8 +23,9 @@ The reference never implemented aggregation (`context.rs:161`
   Q1's 8 aggregates touch 5 unique sum slots, not 8 sums + 8 counts.
 - **Accumulation (device, jitted)**: one fused kernel evaluates every
   slot argument and updates fixed-capacity accumulators.  Small group
-  counts (<= DENSE_GROUP_MAX) use a one-hot [rows, G] matmul — the
-  MXU's shape; XLA lowers the f64 contraction to double-float passes.
+  counts (<= DENSE_GROUP_MAX) use a one-hot [rows, G] masked
+  broadcast-reduce (spelled as a fused reduction, not a literal f64
+  dot — TPU emulates f64 dots catastrophically slowly).
   Larger group counts use **sort-merge aggregation**: XLA scatter is
   serial on TPU, so the state and batch are sorted together by group
   id (`lax.sort` is fast), runs of equal ids reduce with segmented
@@ -76,8 +77,9 @@ _WIDEN_IDS_JIT = jax.jit(lambda w: w.astype(jnp.int32))
 def group_capacity(n: int) -> int:
     """Accumulator capacity: next power of two, floor 8.  Kept tight
     (unlike row-batch bucketing) because capacities <= DENSE_GROUP_MAX
-    take the dense one-hot kernel path — matmul on the MXU instead of
-    XLA scatter, which executes serially on both CPU and TPU."""
+    take the dense one-hot kernel path — a fused masked reduction
+    instead of XLA scatter, which executes serially on both CPU and
+    TPU."""
     cap = 8
     while cap < n:
         cap <<= 1
@@ -765,20 +767,21 @@ class _AggregateCore:
         return new_counts, tuple(new_accs)
 
     def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
-        """Small-group path: segment reduction via a one-hot [rows, G]
-        matrix.  Float sums and all counts stack into ONE
-        [S, rows] @ [rows, G] matmul (the MXU's shape; XLA lowers the
-        f64 contraction to double-float MXU passes); int sums and
-        min/max are fused broadcast-reduces over [rows, G].  Count
+        """Small-group path: segment reduction against a one-hot
+        [rows, G] membership matrix.  Float sums and all counts stack
+        into one [rows, S] block and reduce through a single masked
+        broadcast-reduce (the fused-reduction spelling below — NOT a
+        literal f64 dot, which TPU emulates catastrophically); int sums
+        and min/max are fused broadcast-reduces over [rows, G].  Count
         columns whose ok-mask IS the row mask alias the row-count
-        matmul row instead of duplicating it.  No scatter anywhere."""
+        reduction row instead of duplicating it.  No scatter anywhere."""
         G = counts.shape[0]
         onehot_b = ids[:, None] == jnp.arange(G, dtype=ids.dtype)[None, :]
         inputs = self._slot_inputs(env, capacity, mask)
 
-        # -- one matmul for every f-dtype sum slot + all count columns --
+        # -- one fused reduction for every f-dtype sum slot + count column --
         mat_cols = [mask.astype(jnp.float64)]  # row 0: row count
-        mat_row_of: dict[int, int] = {}  # slot index -> matmul row
+        mat_row_of: dict[int, int] = {}  # slot index -> stacked-reduce row
         for i, (sl, (v, ok)) in enumerate(zip(self.slots, inputs)):
             if sl.kind == "sum" and sl.acc_dtype.kind == "f":
                 mat_row_of[i] = len(mat_cols)
@@ -790,8 +793,17 @@ class _AggregateCore:
                     mat_row_of[i] = len(mat_cols)
                     mat_cols.append(ok.astype(jnp.float64))
         stacked = jnp.stack(mat_cols, axis=1)  # [rows, S]
-        onehot_f = onehot_b.astype(jnp.float64)
-        sums = stacked.T @ onehot_f  # [S, G]
+        # [S, G] segment sums via a masked broadcast-reduce.  This IS
+        # the one-hot contraction, but spelled so XLA fuses it as a
+        # reduction: the literal f64 dot_general lowers on TPU to a
+        # multi-pass bf16-split emulation through while-loops over
+        # [rows, G]-sized scratch (~150 ms per fused launch on v5e for
+        # the TPC-H Q1 shape vs ~1 ms for this form; HLO at
+        # jit(_kernel)/dot_general pins it)
+        sums = jnp.sum(
+            jnp.where(onehot_b[:, None, :], stacked[:, :, None], 0.0),
+            axis=0,
+        )  # [S, G]
 
         new_counts = counts + sums[0].astype(jnp.int64)
         new_accs = []
@@ -812,8 +824,8 @@ class _AggregateCore:
                 if i in mat_row_of:
                     contrib = sums[mat_row_of[i]].astype(acc.dtype)
                 else:
-                    # integer sums: exact int64 broadcast-reduce (a f64
-                    # matmul would round above 2^53)
+                    # integer sums: exact int64 broadcast-reduce (an
+                    # f64 reduction would round above 2^53)
                     contrib = jnp.sum(
                         jnp.where(
                             onehot_b & ok[:, None], v[:, None].astype(acc.dtype), 0
@@ -967,7 +979,7 @@ class AggregateRelation(Relation):
 
     def _pick_capacity(self, current: int) -> int:
         """Accumulator capacity for the observed group count.  Tight
-        power-of-two steps while the dense matmul path applies (small G
+        power-of-two steps while the dense reduce path applies (small G
         keeps the one-hot matrix small); once past DENSE_GROUP_MAX,
         grow with 4x headroom jumps — each distinct capacity compiles a
         fresh sort-merge kernel (two large sorts, expensive to build),
