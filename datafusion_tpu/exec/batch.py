@@ -804,6 +804,28 @@ def device_inputs(batch: RecordBatch, device=None):
     return out
 
 
+def subset_view(batch: "RecordBatch", cols: list, tag: str = "subset_view"):
+    """A view batch holding only `cols`, cached on the parent batch so
+    device copies made against the view survive re-scans of in-memory
+    sources (device_inputs caches on the view object).  Used by the
+    pipeline/TopK operators to ship only the columns a kernel reads."""
+    if len(cols) == batch.num_columns:
+        return batch
+    key = (tag, tuple(cols))
+    hit = batch.cache.get(key)
+    if hit is None:
+        hit = RecordBatch(
+            batch.schema.select(list(cols)),
+            [batch.data[c] for c in cols],
+            [batch.validity[c] for c in cols],
+            [batch.dicts[c] for c in cols],
+            num_rows=batch.num_rows,
+            mask=batch.mask,
+        )
+        batch.cache[key] = hit
+    return hit
+
+
 def pad_to(arr: np.ndarray, capacity: int) -> np.ndarray:
     """Pad a 1-D host array with zeros up to `capacity`."""
     n = len(arr)

@@ -403,25 +403,12 @@ class PipelineRelation(Relation):
             yield out
 
     def _subset_view(self, batch) -> RecordBatch:
-        """A view batch holding only the kernel's input columns, cached
-        on the parent so device copies survive re-scans of in-memory
-        sources (device_inputs caches on the view)."""
-        used = self.core.used_cols
-        if len(used) == batch.num_columns:
-            return batch
-        key = ("subset_view", tuple(used))
-        view = batch.cache.get(key)
-        if view is None:
-            view = RecordBatch(
-                self.core.sub_schema,
-                [batch.data[c] for c in used],
-                [batch.validity[c] for c in used],
-                [batch.dicts[c] for c in used],
-                num_rows=batch.num_rows,
-                mask=batch.mask,
-            )
-            batch.cache[key] = view
-        return view
+        """A view batch holding only the kernel's input columns (shared
+        helper; caching on the parent keeps device copies alive across
+        re-scans of in-memory sources)."""
+        from datafusion_tpu.exec.batch import subset_view
+
+        return subset_view(batch, self.core.used_cols)
 
     def _assemble_outputs(self, batch, dev_cols, dev_valids, dicts):
         """Interleave identity passthroughs (the input arrays, exact)

@@ -7,9 +7,13 @@ The reference planned Sort/Limit but left them `unimplemented!()`
   fused kernel per batch transforms sort keys *on device* (DESC =
   negation / bit-complement, NULLs and padding to max sentinels, Utf8
   via host rank tables passed as aux), sorts the batch together with
-  the carried top-k state, and keeps the best k rows' full column
-  values.  Device state is O(k) — a scan of any length needs one
-  k + capacity sort per batch, never a full materialization.
+  the carried top-k state, and keeps the best k rows as GLOBAL ROW
+  IDS — payload columns never travel to the device; the host gathers
+  them from the source batches at the end (bit-exact f64 even on
+  emulated-f64 backends).  Device state is O(k).  Host-side, scanned
+  batches pin until an asynchronously-pulled state snapshot confirms
+  they hold no surviving candidates (never blocking on the link), so
+  host memory stays bounded near the scan window in the steady state.
 - **Run sort + host merge** (full ORDER BY): each batch-bucket-sized
   run sorts on device (multi-key `lax.sort`, stable), and the sorted
   runs merge on the host with a vectorized structured-array
@@ -107,6 +111,12 @@ class _TopKCore:
 
     def __init__(self, key_plans: list[_KeyPlan]):
         self._key_plans = key_plans
+        # the kernels see ONLY the key columns (payloads never touch
+        # the device — the state carries winning global row ids and the
+        # host gathers payloads from the source batches, bit-exactly);
+        # _sub_of maps schema column index -> position in the subset
+        self.key_cols = sorted({kp.index for kp in key_plans})
+        self._sub_of = {c: i for i, c in enumerate(self.key_cols)}
         # single-key fast path: `lax.top_k` on an exact int64 score
         # image (orders of magnitude faster than a multi-operand sort
         # on TPU).  Eligible when the whole key order embeds in int64
@@ -154,18 +164,21 @@ class _TopKCore:
         """Fold the per-batch merge over a chunk of prepared batches in
         ONE device launch (launch round trips dominate warm scans on
         tunneled devices)."""
-        for cols, valids, mask, num_rows, rank_tables, img in chunk:
+        for cols, valids, mask, num_rows, row_base, rank_tables, img in chunk:
             if self.single:
                 state = self._topk1_kernel(
-                    k, state, cols, valids, mask, num_rows, rank_tables
+                    k, state, cols, valids, mask, num_rows, row_base,
+                    rank_tables,
                 )
             elif self.wide:
                 state = self._topk_wide_kernel(
-                    k, state, cols, valids, mask, num_rows, rank_tables, img
+                    k, state, cols, valids, mask, num_rows, row_base,
+                    rank_tables, img,
                 )
             else:
                 state = self._topk_kernel(
-                    k, state, cols, valids, mask, num_rows, rank_tables
+                    k, state, cols, valids, mask, num_rows, row_base,
+                    rank_tables,
                 )
         return state
 
@@ -235,13 +248,16 @@ class _TopKCore:
             score = jnp.where(valid, score, jnp.int64(self._NULL_BASE))
         return jnp.where(row_mask, score, jnp.int64(self._DEAD_BASE))
 
-    def _topk1_kernel(self, k, state, cols, valids, mask, num_rows, rank_tables):
+    def _topk1_kernel(self, k, state, cols, valids, mask, num_rows, row_base,
+                      rank_tables):
         """Single-key merge: `lax.top_k` picks the batch's kb best rows,
         then a tiny 2*kb-row stable sort merges them with the carried
         state.  `top_k` tie order is backend-defined, so the row index
         rides in the score's low bits — earlier rows strictly outrank
         later equal-key rows on every backend; the carried state stores
-        only the base score (index bits are per-batch)."""
+        only the base score (index bits are per-batch).  Payloads never
+        enter the state: the winning rows travel as global row ids
+        (`row_base` + local index) and the host gathers values."""
         capacity = cols[0].shape[0]
         shift = max(capacity - 1, 1).bit_length()
         assert shift <= 27, "batch capacity too large for the score image"
@@ -249,8 +265,8 @@ class _TopKCore:
         if mask is not None:
             row_mask = row_mask & mask
         kp = self._key_plans[0]
-        base = self._score(cols[kp.index], valids[kp.index], row_mask,
-                           rank_tables)
+        sub = self._sub_of[kp.index]
+        base = self._score(cols[sub], valids[sub], row_mask, rank_tables)
         idx_bits = jnp.int64(capacity - 1) - jnp.arange(capacity, dtype=jnp.int64)
         full = base * jnp.int64(1 << shift) + idx_bits
         # top_k requires k <= capacity: small batches contribute only
@@ -260,25 +276,14 @@ class _TopKCore:
         cand_base = cs >> shift  # arithmetic shift recovers the base
         cand_live = row_mask[ci]
 
-        skeys, slive, svals, svalid = state
+        skeys, slive, srows = state
         all_score = jnp.concatenate([skeys[0], cand_base])
         all_live = jnp.concatenate([slive, cand_live])
+        all_rows = jnp.concatenate([srows, row_base + ci.astype(jnp.int64)])
         iota = jnp.arange(k + kk, dtype=jnp.int32)
         out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
         perm = out[1][:k]
-
-        new_score = all_score[perm]
-        new_live = all_live[perm]
-        new_vals = tuple(
-            jnp.concatenate([sv, c[ci]])[perm] for sv, c in zip(svals, cols)
-        )
-        new_valid = tuple(
-            jnp.concatenate(
-                [sb, (row_mask if v is None else (v & row_mask))[ci]]
-            )[perm]
-            for sb, v in zip(svalid, valids)
-        )
-        return (new_score,), new_live, new_vals, new_valid
+        return (all_score[perm],), all_live[perm], all_rows[perm]
 
     # -- wide single-key path (f64 / int64 / uint64) --
     # full-width int64 scores; sentinel ladder at the very bottom:
@@ -288,7 +293,8 @@ class _TopKCore:
     _W_NAN = np.int64(-(2**63) + 2)
 
     def _topk_wide_kernel(
-        self, k, state, cols, valids, mask, num_rows, rank_tables, img
+        self, k, state, cols, valids, mask, num_rows, row_base, rank_tables,
+        img
     ):
         """Single wide-key merge.  `img` is the host-computed monotone
         int64 bit-image of a float64 key (TPU won't lower the f64
@@ -302,8 +308,9 @@ class _TopKCore:
         if mask is not None:
             row_mask = row_mask & mask
         kp = self._key_plans[0]
-        v = cols[kp.index]
-        valid = valids[kp.index]
+        sub = self._sub_of[kp.index]
+        v = cols[sub]
+        valid = valids[sub]
         if kp.kind == "f":
             raw = img
         elif kp.kind == "u64":
@@ -328,27 +335,17 @@ class _TopKCore:
         cs, ci = lax.top_k(score, kk)  # index-stable ties on all backends
         cand_live = row_mask[ci]
 
-        skeys, slive, svals, svalid, flag = state
+        skeys, slive, srows, flag = state
         all_score = jnp.concatenate([skeys[0], cs])
         all_live = jnp.concatenate([slive, cand_live])
+        all_rows = jnp.concatenate([srows, row_base + ci.astype(jnp.int64)])
         iota = jnp.arange(k + kk, dtype=jnp.int32)
         out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
         perm = out[1][:k]
-
-        new_vals = tuple(
-            jnp.concatenate([sv, c[ci]])[perm] for sv, c in zip(svals, cols)
-        )
-        new_valid = tuple(
-            jnp.concatenate(
-                [sb, (row_mask if vv is None else (vv & row_mask))[ci]]
-            )[perm]
-            for sb, vv in zip(svalid, valids)
-        )
         return (
             (all_score[perm],),
             all_live[perm],
-            new_vals,
-            new_valid,
+            all_rows[perm],
             flag | collide.any(),
         )
 
@@ -372,8 +369,8 @@ class _TopKCore:
         sorting last; their values zeroed so they tie)."""
         keys = []
         for kp in self._key_plans:
-            v = cols[kp.index]
-            valid = valids[kp.index]
+            v = cols[self._sub_of[kp.index]]
+            valid = valids[self._sub_of[kp.index]]
             if kp.kind == "str":
                 table = rank_tables[kp.rank_slot]
                 cap = table.shape[0]
@@ -404,27 +401,32 @@ class _TopKCore:
         return keys
 
     # -- streaming TopK path --
-    def _topk_kernel(self, k, state, cols, valids, mask, num_rows, rank_tables):
+    def _topk_kernel(self, k, state, cols, valids, mask, num_rows, row_base,
+                     rank_tables):
         """Merge one batch into the carried top-k state.
 
-        state = (keys..., col values..., col validity bits) each length
-        k; returns the same structure.  The sort carries ONLY the key
-        operands plus a permutation iota — value columns are gathered
-        by the winning indices afterwards.  (Sorting every payload
-        column along, as an n-operand `lax.sort`, made XLA:TPU build a
-        monstrous sort computation: compile times in the minutes.)
+        state = (keys..., live bits, global row ids) each length k;
+        returns the same structure.  The sort carries ONLY the key
+        operands plus a permutation iota; the winning rows travel as
+        global row ids and the HOST gathers payload values from the
+        source batches afterwards — bit-exact f64 payloads (an
+        emulated-f64 device round trip perturbs them ~1e-14), and no
+        payload bytes ever cross H2D.
         """
         capacity = cols[0].shape[0]
         row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
         if mask is not None:
             row_mask = row_mask & mask
         bkeys = self._device_keys(cols, valids, row_mask, capacity, rank_tables)
-        skeys, slive, svals, svalid = state
+        skeys, slive, srows = state
 
         ops = []
         for sk, bk in zip(skeys, bkeys):
             ops.append(jnp.concatenate([sk, bk.astype(sk.dtype)]))
         live_col = jnp.concatenate([slive, row_mask])
+        rows_col = jnp.concatenate(
+            [srows, row_base + jnp.arange(capacity, dtype=jnp.int64)]
+        )
         # tiebreak: among equal (dead) keys, real rows beat padding —
         # NULL-key rows tie with empty state slots and must still fill
         # a LIMIT larger than the non-null count
@@ -435,15 +437,7 @@ class _TopKCore:
         perm = out[n_keys][:k]
 
         new_keys = tuple(o[:k] for o in out[:n_keys - 1])  # drop tiebreak
-        new_live = live_col[perm]
-        new_vals = tuple(
-            jnp.concatenate([sv, c])[perm] for sv, c in zip(svals, cols)
-        )
-        new_valid = tuple(
-            jnp.concatenate([sb, row_mask if v is None else (v & row_mask)])[perm]
-            for sb, v in zip(svalid, valids)
-        )
-        return new_keys, new_live, new_vals, new_valid
+        return new_keys, live_col[perm], rows_col[perm]
 
 
 
@@ -545,12 +539,7 @@ class SortRelation(Relation):
             # empty slots carry the dead-sentinel base score (lose always)
             sentinel = _TopKCore._W_DEAD if core.wide else _TopKCore._DEAD_BASE
             keys = [jnp.full(k, sentinel, jnp.int64)]
-            vals = tuple(
-                jnp.zeros(k, in_schema.field(i).data_type.np_dtype)
-                for i in range(len(in_schema))
-            )
-            valid = tuple(jnp.zeros(k, bool) for _ in range(len(in_schema)))
-            base = (tuple(keys), jnp.zeros(k, bool), vals, valid)
+            base = (tuple(keys), jnp.zeros(k, bool), jnp.zeros(k, jnp.int64))
             if core.wide:
                 return base + (jnp.zeros((), bool),)
             return base
@@ -560,12 +549,7 @@ class SortRelation(Relation):
             keys.append(
                 jnp.zeros(k, jnp.float64 if kp.kind == "f" else jnp.int64)
             )
-        vals = tuple(
-            jnp.zeros(k, in_schema.field(i).data_type.np_dtype)
-            for i in range(len(in_schema))
-        )
-        valid = tuple(jnp.zeros(k, bool) for _ in range(len(in_schema)))
-        return tuple(keys), jnp.zeros(k, bool), vals, valid
+        return tuple(keys), jnp.zeros(k, bool), jnp.zeros(k, jnp.int64)
 
     def _f64_image_input(self, batch, kp):
         """Device copy of the host-computed f64 key image, cached on the
@@ -611,13 +595,59 @@ class SortRelation(Relation):
             with METRICS.timer("execute.sort"), _device_scope(self.device):
                 if len(chunk) == 1:
                     c = chunk[0]
-                    args = [k, state, c[0], c[1], c[2], c[3], c[4]]
+                    args = [k, state, c[0], c[1], c[2], c[3], c[4], c[5]]
                     if core.wide:
-                        args.append(c[5])
+                        args.append(c[6])
                     state = device_call(topk_jit, *args)
                 else:
                     state = device_call(core.fused_jit, k, state, tuple(chunk))
             chunk.clear()
+            # bounded host memory: snapshot the survivors asynchronously
+            # and release batches that no longer hold candidates
+            try:
+                state[1].copy_to_host_async()
+                state[2].copy_to_host_async()
+                prune_q.append((state[1], state[2], len(bases)))
+            except AttributeError:  # non-jax arrays in tests
+                pass
+            try_prune()
+
+        # per-batch bases into one global row-id space; scanned batches
+        # pin until the final gather (payloads come from their host
+        # arrays, bit-exact — the device only ever sees the KEY
+        # columns).  To keep host memory bounded on long scans, each
+        # flush starts an ASYNC pull of the state's row ids; once a
+        # pull completes (checked non-blocking — never a sync on the
+        # link), batches holding no surviving candidates are released.
+        # Safe because the state is monotone: a row absent from the
+        # state at any snapshot can never re-enter it.
+        from collections import deque
+
+        src_batches: list = []
+        bases: list[int] = []
+        next_base = 0
+        prune_q: deque = deque()
+
+        def try_prune():
+            while prune_q:
+                live_a, rows_a, upto = prune_q[0]
+                if not (
+                    getattr(rows_a, "is_ready", lambda: False)()
+                    and getattr(live_a, "is_ready", lambda: False)()
+                ):
+                    return
+                prune_q.popleft()
+                live_h = np.asarray(live_a)
+                rows_h = np.asarray(rows_a)
+                win = rows_h[live_h]
+                keep: set = set()
+                if len(win):
+                    base_arr = np.asarray(bases[:upto], dtype=np.int64)
+                    hit = np.searchsorted(base_arr, win, side="right") - 1
+                    keep = {int(b) for b in np.unique(hit) if 0 <= b < upto}
+                for j in range(upto):
+                    if j not in keep:
+                        src_batches[j] = None
 
         for batch in self.child.batches():
             for i, d in enumerate(batch.dicts):
@@ -647,11 +677,16 @@ class SortRelation(Relation):
             if state is None:
                 state = self._topk_init(k, in_schema, core)
             with _device_scope(self.device):
-                data, validity, mask = device_inputs(batch, self.device)
+                data, validity, mask = device_inputs(
+                    self._key_view(batch, core), self.device
+                )
+            src_batches.append(batch)
+            bases.append(next_base)
             chunk.append(
                 (data, validity, mask, np.int32(batch.num_rows),
-                 tuple(rank_tables), img)
+                 np.int64(next_base), tuple(rank_tables), img)
             )
+            next_base += batch.capacity
             if len(chunk) >= fuse:
                 flush()
         flush()
@@ -661,12 +696,12 @@ class SortRelation(Relation):
         from datafusion_tpu.exec.batch import device_pull
 
         if core.wide:
-            _, live, vals, valid, flag = state
+            _, live, rows, flag = state
             # ONE blob-packed transfer for the whole k-row result
-            live, vals, valid, flag = device_pull((live, vals, valid, flag))
+            live, rows, flag = device_pull((live, rows, flag))
         else:
-            _, live, vals, valid = state
-            live, vals, valid = device_pull((live, vals, valid))
+            _, live, rows = state
+            live, rows = device_pull((live, rows))
         if core.wide and bool(np.asarray(flag)):
             # an integer key touched the sentinel ladder (values at the
             # extreme two of the 2^64 range): replay the scan through
@@ -680,12 +715,37 @@ class SortRelation(Relation):
         # the scan produced fewer than k rows; the state is bucket-sized,
         # so slice down to the actual LIMIT
         take = np.nonzero(np.asarray(live))[0][: self.limit]
-        out_cols = [np.asarray(c)[take] for c in vals]
+        win = np.asarray(rows)[take]
+        # host payload gather: global row id -> (source batch, local row)
+        base_arr = np.asarray(bases, dtype=np.int64)
+        b_idx = np.searchsorted(base_arr, win, side="right") - 1
+        local = win - base_arr[b_idx]
+        out_cols = []
         out_valid = []
         for i in range(len(in_schema)):
-            v = np.asarray(valid[i])[take]
-            out_valid.append(None if bool(v.all()) else v)
+            dt = in_schema.field(i).data_type.np_dtype
+            vals_i = np.empty(len(win), dtype=dt)
+            valid_i = np.ones(len(win), dtype=bool)
+            any_null = False
+            for b in np.unique(b_idx):
+                m = b_idx == b
+                src = src_batches[b]
+                vals_i[m] = np.asarray(src.data[i])[local[m]]
+                if src.validity[i] is not None:
+                    valid_i[m] = np.asarray(src.validity[i])[local[m]]
+                    any_null = True
+            out_cols.append(vals_i)
+            out_valid.append(
+                None if not any_null or bool(valid_i.all()) else valid_i
+            )
         yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+
+    def _key_view(self, batch: RecordBatch, core) -> RecordBatch:
+        """The batch as TopK kernels see it: only the key columns (the
+        state carries global row ids; payload columns never travel)."""
+        from datafusion_tpu.exec.batch import subset_view
+
+        return subset_view(batch, core.key_cols, tag="topk_key_view")
 
     def _empty_result(self, in_schema, dicts) -> RecordBatch:
         cols = [

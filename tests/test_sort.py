@@ -456,3 +456,71 @@ class TestSingleKeyFastPath:
         ctx = _ctx_with("t", schema, [vals], valids=[valid])
         t = ctx.sql_collect("SELECT v FROM t ORDER BY v LIMIT 5")
         assert t.column_values(0) == [1.25, 2.0, 3.5, None, None]
+
+
+class TestTopKExactPayloads:
+    """TopK carries global row indices, not payload columns: payloads
+    gather host-side from the source batches, so ORDER BY ... LIMIT
+    equals the no-LIMIT sort prefix BIT-FOR-BIT even on emulated-f64
+    devices (round-3 ADVICE item)."""
+
+    def _src(self, rows=20_000):
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        rng = np.random.default_rng(99)
+        schema = Schema([
+            Field("a", DataType.FLOAT64, False),
+            Field("b", DataType.INT64, False),
+            Field("x", DataType.FLOAT64, False),
+        ])
+        batches = []
+        for lo in range(0, rows, 4096):
+            n = min(4096, rows - lo)
+            batches.append(make_host_batch(schema, [
+                rng.uniform(-1e6, 1e6, n),
+                rng.integers(-1000, 1000, n),
+                rng.uniform(-1e9, 1e9, n),
+            ]))
+        return schema, MemoryDataSource(schema, batches)
+
+    @pytest.mark.parametrize("sql_key", ["a DESC", "a", "b, a DESC"])
+    def test_limit_equals_full_sort_prefix_bitwise(self, sql_key):
+        import numpy as np
+
+        from datafusion_tpu.exec.context import ExecutionContext
+        from datafusion_tpu.exec.materialize import collect
+
+        _, src = self._src()
+        ctx = ExecutionContext()
+        ctx.register_datasource("t", src)
+        limited = collect(ctx.sql(f"SELECT a, b, x FROM t ORDER BY {sql_key} LIMIT 137"))
+        full = collect(ctx.sql(f"SELECT a, b, x FROM t ORDER BY {sql_key}"))
+        for i in range(3):
+            want = np.asarray(full.columns[i][:137])
+            got = np.asarray(limited.columns[i])
+            if want.dtype.kind == "f":
+                assert np.array_equal(
+                    got.view(np.int64), want.view(np.int64)
+                ), f"col {i} not bit-identical"
+            else:
+                assert np.array_equal(got, want)
+
+    def test_state_carries_no_payload_columns(self):
+        # structural: the streaming state is (keys, live, rows[, flag])
+        from datafusion_tpu.exec.context import ExecutionContext
+        from datafusion_tpu.exec.materialize import collect
+
+        _, src = self._src(rows=5000)
+        ctx = ExecutionContext()
+        ctx.register_datasource("t", src)
+        rel = ctx.sql("SELECT a, b, x FROM t ORDER BY a DESC LIMIT 10")
+        init = rel._topk_init(128, rel.child.schema)
+        # wide single-key path: (keys, live, rows, flag)
+        assert len(init) == 4
+        keys, live, rows = init[0], init[1], init[2]
+        assert rows.dtype.name == "int64"
+        collect(rel)  # executes end to end
