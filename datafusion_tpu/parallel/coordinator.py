@@ -318,7 +318,10 @@ class DistributedUnionRelation(Relation):
             for i, f in enumerate(self._schema.fields):
                 c = resp["columns"][i]
                 if f.data_type == DataType.UTF8:
-                    cols.append(dicts[i].encode(c["strings"]))
+                    # codes + value table (codes ride the binary frame);
+                    # remap the worker-local codes into OUR dictionary
+                    codes = dec_array(c["codes"])
+                    cols.append(dicts[i].merge_codes(codes, c["values"]))
                 else:
                     cols.append(dec_array(c).astype(f.data_type.np_dtype))
             valids = [

@@ -23,6 +23,7 @@ from __future__ import annotations
 import socket
 import socketserver
 import threading
+from typing import Optional
 
 import numpy as np
 
@@ -32,7 +33,7 @@ from datafusion_tpu.exec.aggregate import AggregateRelation
 from datafusion_tpu.exec.context import ExecutionContext
 from datafusion_tpu.exec.materialize import collect_columns
 from datafusion_tpu.parallel.physical import PlanFragment
-from datafusion_tpu.parallel.wire import enc_array, recv_msg, send_msg
+from datafusion_tpu.parallel.wire import BinWriter, enc_array, recv_msg, send_msg
 from datafusion_tpu.plan.logical import TableScan
 
 
@@ -70,7 +71,7 @@ class WorkerState:
         ctx.register_datasource(scan.table_name, ds)
         return ctx.execute(plan), plan
 
-    def execute_fragment(self, fragment_str: str) -> dict:
+    def execute_fragment(self, fragment_str: str, bw: Optional[BinWriter] = None) -> dict:
         """Partial-aggregate path: returns accumulator state + key table."""
         rel, _plan = self._relation(PlanFragment.from_json_str(fragment_str))
         if not isinstance(rel, AggregateRelation):
@@ -102,18 +103,19 @@ class WorkerState:
         return {
             "type": "partial_state",
             "num_groups": n_groups,
-            "counts": enc_array(counts),
-            "slots": [enc_array(s) for s in slots],
+            "counts": enc_array(counts, bw),
+            "slots": [enc_array(s, bw) for s in slots],
             "key_rows": enc_array(
                 rel.encoder._arr[:n_groups]
                 if rel.key_cols
-                else np.empty((0, 0), np.int64)
+                else np.empty((0, 0), np.int64),
+                bw,
             ),
             "key_dicts": key_dicts,
             "slot_dicts": slot_dicts,
         }
 
-    def execute_plan(self, fragment_str: str) -> dict:
+    def execute_plan(self, fragment_str: str, bw: Optional[BinWriter] = None) -> dict:
         """Row-returning path (Projection/Selection fragments): scan,
         filter, project on-device, materialize and ship the rows."""
         rel, plan = self._relation(PlanFragment.from_json_str(fragment_str))
@@ -123,17 +125,32 @@ class WorkerState:
         for i, f in enumerate(plan.schema.fields):
             c = columns[i]
             if f.data_type == DataType.UTF8:
-                # decode: dictionaries are worker-local
-                if dicts[i] is not None:
-                    c = dicts[i].decode(c)
-                out_cols.append({"strings": [str(s) for s in c]})
+                # ship dictionary codes + a COMPACT value table holding
+                # only the values the result actually references (a
+                # selective filter over a high-cardinality column must
+                # not drag the whole global dictionary along); codes
+                # remap to the compact table and ride the binary frame
+                d = dicts[i]
+                codes = np.asarray(c, dtype=np.int32)
+                if d is None or len(d.values) == 0:
+                    out_cols.append({
+                        "codes": enc_array(codes, bw), "values": [],
+                    })
+                else:
+                    uniq, inv = np.unique(codes, return_inverse=True)
+                    out_cols.append({
+                        "codes": enc_array(inv.astype(np.int32), bw),
+                        "values": [d.values[u] for u in uniq],
+                    })
             else:
-                out_cols.append(enc_array(c))
+                out_cols.append(enc_array(c, bw))
         return {
             "type": "rows",
             "num_rows": total,
             "columns": out_cols,
-            "validity": [None if v is None else enc_array(v) for v in validity],
+            "validity": [
+                None if v is None else enc_array(v, bw) for v in validity
+            ],
         }
 
 
@@ -147,14 +164,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             if msg is None:
                 return
+            bw = BinWriter()
             try:
                 kind = msg.get("type")
                 if kind == "ping":
                     out = {"type": "pong", "queries": state.queries}
                 elif kind == "execute_fragment":
-                    out = state.execute_fragment(msg["fragment"])
+                    out = state.execute_fragment(msg["fragment"], bw)
                 elif kind == "execute_plan":
-                    out = state.execute_plan(msg["fragment"])
+                    out = state.execute_plan(msg["fragment"], bw)
                 elif kind == "shutdown":
                     send_msg(self.request, {"type": "bye"})
                     threading.Thread(
@@ -165,10 +183,12 @@ class _Handler(socketserver.BaseRequestHandler):
                     out = {"type": "error", "message": f"unknown request {kind!r}"}
             except DataFusionError as e:
                 out = {"type": "error", "message": str(e)}
+                bw = BinWriter()  # a failed build may have partial segments
             except Exception as e:  # noqa: BLE001 — workers must not die on a bad query
                 out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
+                bw = BinWriter()
             try:
-                send_msg(self.request, out)
+                send_msg(self.request, out, bw)
             except (ConnectionError, OSError):
                 return
 
