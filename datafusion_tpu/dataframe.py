@@ -17,7 +17,7 @@ reachable without SQL text.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Union
+from typing import Sequence, Union
 
 from datafusion_tpu.datatypes import DataType, Schema
 from datafusion_tpu.errors import PlanError

@@ -25,7 +25,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.datatypes import Schema
 from datafusion_tpu.errors import ExecutionError
 
 MIN_CAPACITY = 1024

@@ -44,31 +44,29 @@ INLINE_MAX = int(os.environ.get("DATAFUSION_TPU_WIRE_INLINE", 256))
 
 
 class BinWriter:
-    """Collects bulk array segments for one outgoing message."""
+    """Collects bulk array segments for one outgoing message as
+    zero-copy buffer views (the views pin their source arrays)."""
 
     __slots__ = ("chunks",)
 
     def __init__(self) -> None:
-        self.chunks: list[bytes] = []
+        self.chunks: list = []  # buffer-protocol objects
 
 
 def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None) -> None:
     if bw is not None and bw.chunks:
+        sizes = [memoryview(c).nbytes for c in bw.chunks]
         obj = dict(obj)
-        obj["_bins"] = [len(c) for c in bw.chunks]
+        obj["_bins"] = sizes
         data = json.dumps(obj).encode("utf-8")
-        frame_len = 1 + _U32.size + len(data) + sum(obj["_bins"])
+        frame_len = 1 + _U32.size + len(data) + sum(sizes)
         sock.sendall(
-            b"".join(
-                [
-                    _LEN.pack(frame_len),
-                    bytes([_TAG_BIN]),
-                    _U32.pack(len(data)),
-                    data,
-                    *bw.chunks,
-                ]
-            )
+            _LEN.pack(frame_len) + bytes([_TAG_BIN]) + _U32.pack(len(data)) + data
         )
+        # segments stream straight from the source arrays — no
+        # intermediate frame buffer, no per-array tobytes copy
+        for c in bw.chunks:
+            sock.sendall(c)
         return
     data = json.dumps(obj).encode("utf-8")
     sock.sendall(_LEN.pack(len(data)) + data)
@@ -133,7 +131,7 @@ def enc_array(a: np.ndarray, bw: Optional[BinWriter] = None) -> dict:
     a = np.ascontiguousarray(a)
     if bw is not None and a.nbytes > INLINE_MAX:
         idx = len(bw.chunks)
-        bw.chunks.append(a.tobytes())
+        bw.chunks.append(memoryview(a).cast("B"))  # zero-copy, pins `a`
         return {"dtype": a.dtype.str, "shape": list(a.shape), "bin": idx}
     return {
         "dtype": a.dtype.str,  # byte-order explicit ('<i8', '|b1', ...)

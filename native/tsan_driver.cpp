@@ -1,0 +1,118 @@
+// ThreadSanitizer driver for the native runtime (scripts/tsan_check.sh).
+//
+// The host runtime is threaded — worker handler threads, the prefetch
+// producer, the pyarrow-confinement pool — and worker fragment scans
+// run the native CSV reader from whatever handler thread took the
+// connection (parallel/worker.py).  SURVEY §5.2 names TSan+ASan CI as
+// the rebuild's answer to Rust's compile-time data-race freedom; this
+// drives the exact concurrent shapes the engine uses:
+//   - N threads each scanning their own reader handle over one shared
+//     input file (the worker serving parallel fragment requests);
+//   - N threads through the SQL front-end + plan IR round trip (the
+//     parser is called from server threads too).
+// Reader handles are documented single-thread-per-handle, so no handle
+// is shared; what TSan checks is that the implementation has no hidden
+// shared mutable state (globals, caches, errno-style buffers).
+
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* dtf_csv_open(const char* path, int32_t n_cols, const int32_t* types,
+                   int32_t has_header, int64_t batch_size,
+                   const uint8_t* projected);
+const char* dtf_csv_error(void* r);
+int64_t dtf_csv_next(void* r);
+void* dtf_csv_col_data(void* r, int32_t col);
+const uint8_t* dtf_csv_col_validity(void* r, int32_t col);
+int32_t dtf_csv_dict_size(void* r, int32_t col);
+void* dtf_csv_dict_value(void* r, int32_t col, int32_t code, int32_t* len);
+void dtf_csv_close(void* r);
+char* dtf_parse_sql(const char* sql);
+char* dtf_plan_roundtrip(const char* json);
+char* dtf_plan_repr(const char* json);
+void dtf_free(char* p);
+}
+
+static const char* kPath = "/tmp/tsan_driver_input.csv";
+
+static void write_input() {
+  FILE* f = fopen(kPath, "w");
+  assert(f);
+  fprintf(f, "city,lat,flag,n\n");
+  for (int i = 0; i < 20000; i++) {
+    fprintf(f, "name%d,%d.%02d,%s,%d\n", i % 257, i % 90, i % 100,
+            (i % 3 ? "true" : "false"), i);
+  }
+  fclose(f);
+}
+
+static void scan_worker(int64_t* total_rows) {
+  // types: 11=Utf8, 10=Float64, 0=Boolean, 4=Int64 (native/csv.py map)
+  int32_t types[4] = {11, 10, 0, 4};
+  void* r = dtf_csv_open(kPath, 4, types, 1, 4096, nullptr);
+  assert(r && !dtf_csv_error(r));
+  int64_t rows = 0;
+  for (;;) {
+    int64_t n = dtf_csv_next(r);
+    assert(n >= 0);
+    if (n == 0) break;
+    rows += n;
+    // touch every column surface a real scan touches
+    assert(dtf_csv_col_data(r, 0));
+    assert(dtf_csv_col_data(r, 3));
+    dtf_csv_col_validity(r, 1);
+    int32_t len = 0;
+    int32_t ds = dtf_csv_dict_size(r, 0);
+    assert(ds > 0);
+    assert(dtf_csv_dict_value(r, 0, ds - 1, &len));
+  }
+  dtf_csv_close(r);
+  *total_rows = rows;
+}
+
+static void sql_worker(int reps) {
+  const char* stmts[] = {
+      "SELECT a, b + 1 FROM t WHERE a > 2.5 AND c = 'x'",
+      "SELECT COUNT(*), MIN(x) FROM t GROUP BY z ORDER BY z LIMIT 5",
+      "SELEC nonsense",  // error path from a thread
+  };
+  for (int i = 0; i < reps; i++) {
+    for (const char* s : stmts) {
+      char* out = dtf_parse_sql(s);
+      assert(out);
+      if (out[0] == '{' && strstr(out, "\"error\"") == nullptr) {
+        char* rt = dtf_plan_roundtrip(out);
+        assert(rt);
+        dtf_free(rt);
+        char* pr = dtf_plan_repr(out);
+        assert(pr);
+        dtf_free(pr);
+      }
+      dtf_free(out);
+    }
+  }
+}
+
+int main() {
+  write_input();
+  const int kThreads = 8;
+  std::vector<std::thread> ts;
+  std::vector<int64_t> rows(kThreads, 0);
+  for (int i = 0; i < kThreads; i++) {
+    if (i % 2 == 0)
+      ts.emplace_back(scan_worker, &rows[i]);
+    else
+      ts.emplace_back(sql_worker, 50);
+  }
+  for (auto& t : ts) t.join();
+  for (int i = 0; i < kThreads; i += 2) assert(rows[i] == 20000);
+  std::remove(kPath);
+  printf("tsan driver done\n");
+  return 0;
+}

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# ThreadSanitizer check of the C++ native runtime (SURVEY §5.2: the
+# reference gets data-race freedom from Rust; the rebuild's host
+# runtime is genuinely threaded — worker handler threads, prefetch
+# producers, the pyarrow confinement pool — and worker fragment scans
+# run the native CSV reader from those threads).  Builds everything
+# with -fsanitize=thread and drives concurrent scans + parses.
+set -euo pipefail
+cd "$(dirname "$0")/../native"
+
+CXX="${CXX:-g++}"
+"$CXX" -O1 -g -std=c++17 -fsanitize=thread -fno-omit-frame-pointer \
+  -Wall -Wextra \
+  datafusion_native.cpp sql_frontend.cpp tsan_driver.cpp \
+  -o tsan_driver -pthread
+./tsan_driver
+rm -f tsan_driver
+echo "TSan check passed"

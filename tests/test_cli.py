@@ -11,8 +11,6 @@ import os
 import subprocess
 import sys
 
-import pytest
-
 from datafusion_tpu.cli import Console, make_context, run_script
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

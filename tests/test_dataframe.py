@@ -3,7 +3,6 @@ full surface; the golden `test_df_udf_udt.csv` runs through it)."""
 
 import os
 
-import numpy as np
 import pytest
 
 from datafusion_tpu import DataType, Field, Schema, lit, f

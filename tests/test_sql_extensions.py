@@ -4,7 +4,6 @@ PhysicalPlan executor (Write/Show), unsigned-literal adaptation."""
 
 import os
 
-import numpy as np
 import pytest
 
 from datafusion_tpu import DataType, Field, Schema

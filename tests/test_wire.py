@@ -25,12 +25,18 @@ def _roundtrip(obj, bw=None):
         out = {}
 
         def rx():
-            out["msg"] = recv_msg(b)
+            try:
+                out["msg"] = recv_msg(b)
+            except BaseException as e:  # surfaced after join
+                out["err"] = e
 
         t = threading.Thread(target=rx)
         t.start()
         send_msg(a, obj, bw)
         t.join(timeout=10)
+        assert not t.is_alive(), "receiver did not finish"
+        if "err" in out:
+            raise out["err"]
         return out["msg"]
     finally:
         a.close()
