@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# One-command local cluster smoketest (the reference's intended
+# harness, scripts/smoketest.sh:30-66, working): coordinator + 2
+# workers + a kill-one failover check.
+#
+#   ./scripts/cluster_smoketest.sh            # worker OS processes
+#   ./scripts/cluster_smoketest.sh --docker   # compose-built containers
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" == "--docker" ]]; then
+  # partitions must be visible to the containers at the SAME path the
+  # coordinator writes them (fragments reference files by path)
+  export DFTPU_SHARED_TMP=/tmp/dftpu-cluster
+  mkdir -p "$DFTPU_SHARED_TMP"
+  docker compose -f deploy/docker-compose.yml up -d --build worker1 worker2
+  trap 'docker compose -f deploy/docker-compose.yml down' EXIT
+  # cluster_smoke polls worker liveness with its own deadline
+  DFTPU_KILL_CMD="docker compose -f deploy/docker-compose.yml kill worker1" \
+    python scripts/cluster_smoke.py 127.0.0.1:8462 127.0.0.1:8463
+else
+  python scripts/cluster_smoke.py
+fi
