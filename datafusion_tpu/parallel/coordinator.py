@@ -90,6 +90,12 @@ class WorkerHandle:
             self.alive = False
         return self.alive
 
+    def status(self) -> dict:
+        """Operator introspection: uptime, query/error counts, device,
+        metrics snapshot (the worker web UI the reference planned,
+        delivered over the fragment protocol instead)."""
+        return self.request({"type": "status"}, timeout=10.0)
+
 
 class _SchemaOnlyRelation(Relation):
     """Zero-batch child used to instantiate the coordinator's template
@@ -379,6 +385,17 @@ class DistributedContext(ExecutionContext):
         """Liveness probe (the heartbeat the reference's etcd scheme
         implied, `smoketest.sh:41-54`)."""
         return {f"{w.host}:{w.port}": w.ping() for w in self.workers}
+
+    def worker_status(self) -> dict[str, Optional[dict]]:
+        """Per-worker introspection snapshot (None for unreachable
+        workers)."""
+        out: dict[str, Optional[dict]] = {}
+        for w in self.workers:
+            try:
+                out[f"{w.host}:{w.port}"] = w.status()
+            except (ConnectionError, OSError, ExecutionError):
+                out[f"{w.host}:{w.port}"] = None
+        return out
 
     def execute(self, plan: LogicalPlan) -> Relation:
         # unlike the single-host mesh matcher this one keeps Utf8

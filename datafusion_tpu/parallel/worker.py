@@ -12,10 +12,12 @@ merge.  Arbitrary Projection/Selection fragments return materialized
 rows instead.
 
 Requests:  {"type": "ping"}
+           {"type": "status"}
            {"type": "execute_fragment", "fragment": <PlanFragment str>}
            {"type": "execute_plan", "fragment": <PlanFragment str>}
-Responses: {"type": "pong", ...} / {"type": "partial_state", ...} /
-           {"type": "rows", ...} / {"type": "error", "message": ...}
+Responses: {"type": "pong", ...} / {"type": "status", ...} /
+           {"type": "partial_state", ...} / {"type": "rows", ...} /
+           {"type": "error", "message": ...}
 """
 
 from __future__ import annotations
@@ -49,9 +51,43 @@ def _find_scan(plan) -> TableScan:
 
 class WorkerState:
     def __init__(self, device=None, batch_size: int = 131072):
+        import time
+
         self.device = device
         self.batch_size = batch_size
         self.queries = 0
+        self.errors = 0
+        self.started = time.time()
+
+    def status(self) -> dict:
+        """Operator-facing introspection (the reference's worker image
+        EXPOSEd 8080 for a status web UI that never shipped,
+        `scripts/docker/worker/Dockerfile`; this is the working
+        equivalent over the fragment protocol — `{"type": "status"}`)."""
+        import time
+
+        import jax
+
+        from datafusion_tpu.native import native_available
+        from datafusion_tpu.utils.metrics import METRICS
+
+        snap = METRICS.snapshot()
+        return {
+            "type": "status",
+            "uptime_s": round(time.time() - self.started, 1),
+            "queries": self.queries,
+            "errors": self.errors,
+            "device": self.device or jax.default_backend(),
+            "devices": [str(d) for d in jax.devices()],
+            "native": native_available(),
+            "batch_size": self.batch_size,
+            "metrics": {
+                "timings_s": {
+                    k: round(v, 3) for k, v in snap["timings_s"].items()
+                },
+                "counts": snap["counts"],
+            },
+        }
 
     def _relation(self, frag: PlanFragment):
         plan = frag.logical_plan()
@@ -169,6 +205,8 @@ class _Handler(socketserver.BaseRequestHandler):
                 kind = msg.get("type")
                 if kind == "ping":
                     out = {"type": "pong", "queries": state.queries}
+                elif kind == "status":
+                    out = state.status()
                 elif kind == "execute_fragment":
                     out = state.execute_fragment(msg["fragment"], bw)
                 elif kind == "execute_plan":
@@ -184,9 +222,11 @@ class _Handler(socketserver.BaseRequestHandler):
             except DataFusionError as e:
                 out = {"type": "error", "message": str(e)}
                 bw = BinWriter()  # a failed build may have partial segments
+                state.errors += 1
             except Exception as e:  # noqa: BLE001 — workers must not die on a bad query
                 out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
                 bw = BinWriter()
+                state.errors += 1
             try:
                 send_msg(self.request, out, bw)
             except (ConnectionError, OSError):

@@ -428,3 +428,26 @@ class TestWorkerSoak:
             )
             assert proc.poll() is None, f"worker died after query #{i}"
         assert proc.poll() is None
+
+
+class TestWorkerStatus:
+    def test_status_request(self, tmp_path, workers):
+        # the reference's worker image EXPOSEd a status web UI that
+        # never shipped; this is its working protocol equivalent
+        _, addrs = workers
+        paths = _write_partitions(tmp_path, n_parts=2, rows_per=100)
+        dctx, _ = _contexts(addrs, paths)
+        collect(dctx.sql("SELECT region, SUM(v) FROM t GROUP BY region"))
+        status = dctx.worker_status()
+        assert set(status) == {f"{h}:{p}" for h, p in addrs}
+        served = 0
+        for s in status.values():
+            assert s is not None and s["type"] == "status"
+            assert s["uptime_s"] >= 0
+            assert "metrics" in s and "devices" in s
+            served += s["queries"]
+        assert served >= len(paths)  # the fragments we just ran
+
+    def test_status_of_dead_worker_is_none(self):
+        dctx = DistributedContext([("127.0.0.1", 1)])
+        assert dctx.worker_status() == {"127.0.0.1:1": None}
