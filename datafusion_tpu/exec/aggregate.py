@@ -458,6 +458,9 @@ class _AggregateCore:
         self.used_cols = sorted(used)
         self.col_map = {c: i for i, c in enumerate(self.used_cols)}
         self.sub_schema = in_schema.select(self.used_cols)
+        # per-column codec memory for put_compressed (persists across
+        # cold re-runs of the same query shape — see batch.py)
+        self.wire_hints: dict = {}
         self.jit = jax.jit(self._kernel)
         self.fused_jit = jax.jit(self._fused_kernel)
 
@@ -1020,7 +1023,7 @@ class AggregateRelation(Relation):
                     tuple(compute_aux_values(self._aux_specs, b, self._aux_cache)),
                     self._compute_str_aux(b),
                 )
-                device_inputs(self._device_view(b), self.device)
+                device_inputs(self._device_view(b), self.device, self.core.wire_hints)
 
             batches = staged_pipeline(batches, _stage)
 
@@ -1074,7 +1077,7 @@ class AggregateRelation(Relation):
                 str_aux = self._compute_str_aux(batch)
             with device_scope(self.device):
                 data, validity, mask = device_inputs(
-                    self._device_view(batch), self.device
+                    self._device_view(batch), self.device, self.core.wire_hints
                 )
             chunk.append(
                 (data, validity, tuple(aux), np.int32(batch.num_rows), mask,
@@ -1162,13 +1165,17 @@ class AggregateRelation(Relation):
             ids_np = np.zeros(batch.capacity, dtype=np.int32)
         # ship ids in the narrowest width that holds the group count and
         # widen on device (H2D bytes 4x/2x smaller for the common small-
-        # cardinality GROUP BY)
+        # cardinality GROUP BY); pointless when the target is the host
+        # platform itself (no link — see batch._wire_enabled)
+        from datafusion_tpu.exec.batch import _wire_enabled
+
         wire = ids_np
         n_groups = self.encoder.num_groups
-        if n_groups <= 127:
-            wire = ids_np.astype(np.int8)
-        elif n_groups <= 32767:
-            wire = ids_np.astype(np.int16)
+        if _wire_enabled(self.device):
+            if n_groups <= 127:
+                wire = ids_np.astype(np.int8)
+            elif n_groups <= 32767:
+                wire = ids_np.astype(np.int16)
         dev_wire = (
             jax.device_put(wire, self.device)
             if self.device is not None

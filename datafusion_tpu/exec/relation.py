@@ -34,6 +34,11 @@ def device_scope(device):
     return jax.default_device(device) if device is not None else nullcontext()
 
 
+# tiny fused AND for combining a host predicate mask with a device-
+# resident upstream mask (built lazily; one jit for every shape pair)
+_MASK_AND_JIT = None
+
+
 def _is_accelerator(device) -> bool:
     """True when batches execute on a non-CPU device (`device` is a jax
     Device, or None = the JAX default backend)."""
@@ -163,6 +168,10 @@ class _PipelineCore:
         self.used_cols = sorted(used)
         self.col_map = {c: i for i, c in enumerate(self.used_cols)}
         self.sub_schema = in_schema.select(self.used_cols)
+        # per-column codec memory for put_compressed; the core persists
+        # across cold re-runs of the same query shape, so batch 2+ of
+        # every scan skips the encode probe ladder
+        self.wire_hints: dict = {}
         self.jit = jax.jit(self._kernel)
 
     @staticmethod
@@ -289,8 +298,24 @@ class PipelineRelation(Relation):
         self.device = device
         self._metas = function_metas or {}
         host_scalar = _is_accelerator(device)
+        # On accelerators a numpy-evaluable predicate runs on the host
+        # (mirroring AggregateRelation's host predicate): its input
+        # columns never cross H2D and — with projections host-routed
+        # under host_scalar — the whole batch often never touches the
+        # device.  Predicates containing host-only UDFs keep going to
+        # the core so it raises its NotSupportedError contract.
+        from datafusion_tpu.exec.hostfn import contains_host_fn, host_evaluable
+
+        host_pred = (
+            predicate is not None
+            and host_scalar
+            and not contains_host_fn(predicate, self._metas)
+            and host_evaluable(predicate, self._metas, child.schema)
+        )
+        self._host_pred_expr = predicate if host_pred else None
+        core_pred = None if host_pred else predicate
         self.core = _PipelineCore.build(
-            child.schema, predicate, projections, functions, self._metas,
+            child.schema, core_pred, projections, functions, self._metas,
             host_scalar,
         )
         # THIS query's host-routed exprs (with its literal values) —
@@ -306,7 +331,7 @@ class PipelineRelation(Relation):
 
         self._params = parameterize_exprs(
             _PipelineCore.param_exprs(
-                predicate, projections, self._metas, child.schema, host_scalar
+                core_pred, projections, self._metas, child.schema, host_scalar
             )
         )[2]
         self._host_dicts: dict[int, "StringDictionary"] = {}
@@ -335,7 +360,11 @@ class PipelineRelation(Relation):
                     core,
                     tuple(compute_aux_values(core.aux_specs, b, self._aux_cache)),
                 )
-                device_inputs(self._subset_view(b), self.device)
+                device_inputs(
+                    self._subset_view(b), self.device, core.wire_hints
+                )
+                if self._host_pred_expr is not None:
+                    self._device_mask(b)
 
             batches = staged_pipeline(batches, _stage)
 
@@ -348,14 +377,18 @@ class PipelineRelation(Relation):
                 # copies (device_inputs cache) survive across runs
                 # instead of re-shipping every column per query run
                 # pinned by RELATION when host-routed exprs exist (their
-                # literal values are per-query; the core is shared
-                # across literals), by core otherwise
-                pin = self if self._host_proj else core
+                # literal values — and the host predicate's — are
+                # per-query; the core is shared across literals), by
+                # core otherwise
+                pin = (
+                    self if (self._host_proj or self._host_pred_expr is not None)
+                    else core
+                )
                 hit = batch.cache.get("pipeline_out")
                 if hit is not None and hit[0] is pin:
                     yield hit[1]
                     continue
-                cols, valids, mask = [], [], batch.mask
+                cols, valids, mask = [], [], self._effective_mask(batch)
             else:
                 staged = batch.cache.get("staged_aux")
                 if staged is not None and staged[0] is core:
@@ -366,8 +399,13 @@ class PipelineRelation(Relation):
                     )
                 with METRICS.timer("execute.pipeline"), device_scope(self.device):
                     data, validity, mask_in = device_inputs(
-                        self._subset_view(batch), self.device
+                        self._subset_view(batch), self.device, core.wire_hints
                     )
+                    if self._host_pred_expr is not None:
+                        # the shared subset view keeps the column device
+                        # copies literal-independent; only this query's
+                        # predicate mask uploads per relation
+                        mask_in = self._device_mask(batch)
                     cols, valids, mask = device_call(
                         core.jit,
                         data,
@@ -398,9 +436,71 @@ class PipelineRelation(Relation):
             )
             if not core.needs_kernel:
                 batch.cache["pipeline_out"] = (
-                    self if self._host_proj else core, out
+                    self
+                    if (self._host_proj or self._host_pred_expr is not None)
+                    else core,
+                    out,
                 )
             yield out
+
+    def _host_pred_mask(self, batch) -> np.ndarray:
+        """This query's host-routed predicate over one batch, as a
+        numpy bool mask (cached on the batch, pinned by relation — the
+        predicate carries per-query literals).  Predicate inputs are
+        host arrays in every shape the planner emits (scans pass host
+        columns through; device-computed columns only come from
+        non-host-evaluable projections, whose consumers can't route
+        here) — a device-resident input would still be correct, at the
+        cost of a per-batch pull."""
+        hit = batch.cache.get("pipe_pred_mask")
+        if hit is not None and hit[0] is self:
+            return hit[1]
+        from datafusion_tpu.exec.hostfn import eval_host_expr
+
+        pv, pvalid = eval_host_expr(self._host_pred_expr, batch, self._metas)
+        pm = np.broadcast_to(np.asarray(pv, dtype=bool), (batch.capacity,))
+        if pvalid is not None:
+            # SQL: NULL predicate drops the row
+            pm = pm & np.broadcast_to(
+                np.asarray(pvalid, dtype=bool), (batch.capacity,)
+            )
+        batch.cache["pipe_pred_mask"] = (self, pm)
+        return pm
+
+    def _effective_mask(self, batch):
+        """The batch's selection mask with this query's host-routed
+        predicate folded in.  A device-resident upstream mask combines
+        ON DEVICE (one tiny fused AND) rather than being pulled to the
+        host — D2H round trips are the scarce resource."""
+        if self._host_pred_expr is None:
+            return batch.mask
+        pm = self._host_pred_mask(batch)
+        if batch.mask is None:
+            return pm
+        if hasattr(batch.mask, "copy_to_host_async"):  # device mask
+            global _MASK_AND_JIT
+            if _MASK_AND_JIT is None:
+                _MASK_AND_JIT = jax.jit(lambda a, b: a & b)
+            with device_scope(self.device):
+                return _MASK_AND_JIT(jax.device_put(pm), batch.mask)
+        return np.asarray(batch.mask) & pm
+
+    def _device_mask(self, batch):
+        """Device copy of the effective mask for the kernel path
+        (cached on the batch, pinned by relation — per-query literals).
+        Travels bit-packed through put_compressed; the kernel's input
+        columns keep riding the literal-independent subset-view cache."""
+        hit = batch.cache.get("pipe_pred_dev_mask")
+        if hit is not None and hit[0] is self:
+            return hit[1]
+        m = self._effective_mask(batch)
+        if m is not None and not hasattr(m, "copy_to_host_async"):
+            from datafusion_tpu.exec.batch import put_compressed
+
+            with device_scope(self.device):
+                m = put_compressed([m], self.device)[0]
+        batch.cache["pipe_pred_dev_mask"] = (self, m)
+        return m
 
     def _subset_view(self, batch) -> RecordBatch:
         """A view batch holding only the kernel's input columns (shared

@@ -159,6 +159,8 @@ class _TopKCore:
         else:
             self.jit = jax.jit(self._topk_kernel, static_argnums=(0,))
         self.fused_jit = jax.jit(self._fused_topk, static_argnums=(0,))
+        # per-column codec memory for put_compressed (see batch.py)
+        self.wire_hints: dict = {}
 
     def _fused_topk(self, k, state, chunk):
         """Fold the per-batch merge over a chunk of prepared batches in
@@ -678,7 +680,7 @@ class SortRelation(Relation):
                 state = self._topk_init(k, in_schema, core)
             with _device_scope(self.device):
                 data, validity, mask = device_inputs(
-                    self._key_view(batch, core), self.device
+                    self._key_view(batch, core), self.device, core.wire_hints
                 )
             src_batches.append(batch)
             bases.append(next_base)

@@ -996,3 +996,193 @@ class TestHostRouting:
 
         with pytest.raises(NotSupportedError):
             ctx.sql_collect("SELECT city, 'x' FROM cities")
+
+
+class TestHostRoutedPredicate:
+    """On accelerators, numpy-evaluable predicates run on the host
+    (relation.PipelineRelation._host_pred_expr): the predicate's input
+    columns never cross H2D, and together with host-routed projections
+    the batch usually never touches the device at all."""
+
+    def test_filter_never_builds_device_kernel(self, ctx, test_data_dir, monkeypatch):
+        import datafusion_tpu.exec.kernels as kernels
+        import datafusion_tpu.exec.relation as relation
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.exec.relation import PipelineRelation
+
+        monkeypatch.setattr(relation, "_is_accelerator", lambda device: True)
+        saved = dict(kernels._REGISTRY)
+        kernels._REGISTRY.clear()
+        try:
+            rel = ctx.sql(
+                "SELECT city, lat, lng, lat + lng FROM cities "
+                "WHERE lat > 51.0 AND lat < 53.0"
+            )
+            node = rel
+            pipe = None
+            while node is not None:
+                if isinstance(node, PipelineRelation):
+                    pipe = node
+                    break
+                node = getattr(node, "child", None)
+            assert pipe is not None
+            assert pipe._host_pred_expr is not None
+            assert not pipe.core.needs_kernel  # scalar projections host-route too
+            got = sorted(collect(rel).to_rows())
+        finally:
+            kernels._REGISTRY.clear()
+            kernels._REGISTRY.update(saved)
+        want = sorted(
+            collect(
+                ctx.sql(
+                    "SELECT city, lat, lng, lat + lng FROM cities "
+                    "WHERE lat > 51.0 AND lat < 53.0"
+                )
+            ).to_rows()
+        )
+        assert got == want
+        assert len(got) == 18
+
+    def test_distinct_literals_share_core_not_results(self, ctx, monkeypatch):
+        # the host predicate carries per-query literals; the shared
+        # compiled core must not leak one query's mask into another's
+        import datafusion_tpu.exec.kernels as kernels
+        import datafusion_tpu.exec.relation as relation
+        from datafusion_tpu.exec.materialize import collect
+
+        monkeypatch.setattr(relation, "_is_accelerator", lambda device: True)
+        saved = dict(kernels._REGISTRY)
+        kernels._REGISTRY.clear()
+        try:
+            a = collect(ctx.sql("SELECT city FROM cities WHERE lat > 52.0"))
+            b = collect(ctx.sql("SELECT city FROM cities WHERE lat > 54.0"))
+        finally:
+            kernels._REGISTRY.clear()
+            kernels._REGISTRY.update(saved)
+        assert a.num_rows > b.num_rows > 0
+
+
+class TestWirePolicy:
+    """put_compressed skips the codec entirely when the transfer target
+    is the host platform (no link to compress for); DATAFUSION_TPU_WIRE
+    forces either mode."""
+
+    def _batch(self):
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+
+        rng = np.random.default_rng(11)
+        schema = Schema(
+            [
+                Field("p", DataType.FLOAT64, False),
+                Field("q", DataType.FLOAT64, False),
+                Field("i", DataType.INT64, True),
+            ]
+        )
+        cols = [
+            np.round(rng.uniform(900, 105000, 2048), 2),
+            rng.integers(0, 11, 2048) / 100.0,
+            rng.integers(-100, 100, 2048).astype(np.int64),
+        ]
+        valid = rng.random(2048) > 0.2
+        return make_host_batch(schema, cols, [None, None, valid], [None] * 3)
+
+    def test_host_target_skips_wire(self, monkeypatch):
+        from datafusion_tpu.exec import batch as B
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "auto")
+        calls = []
+        orig = B._encode_wire
+        monkeypatch.setattr(
+            B, "_encode_wire", lambda a, d=None: calls.append(1) or orig(a, d)
+        )
+        b = self._batch()
+        data, validity, _ = B.device_inputs(b, None)
+        assert not calls  # CPU target: no codec probing at all
+        for got, want in zip(data, b.data):
+            assert np.array_equal(np.asarray(got), want)
+        assert np.array_equal(np.asarray(validity[2]), b.validity[2])
+
+    def test_forced_wire_matches_raw(self, monkeypatch):
+        from datafusion_tpu.exec import batch as B
+
+        b1 = self._batch()
+        b2 = self._batch()
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        d_wire, v_wire, _ = B.device_inputs(b1, None)
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "never")
+        d_raw, v_raw, _ = B.device_inputs(b2, None)
+        for a, c in zip(d_wire, d_raw):
+            ha, hc = np.asarray(a), np.asarray(c)
+            assert ha.dtype == hc.dtype
+            assert np.array_equal(ha, hc)
+        assert np.array_equal(np.asarray(v_wire[2]), np.asarray(v_raw[2]))
+
+    def test_wire_hints_skip_probe_and_stay_exact(self, monkeypatch):
+        from datafusion_tpu.exec import batch as B
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        rng = np.random.default_rng(5)
+        col1 = np.round(rng.uniform(900, 105000, 2048), 2)   # decimal 100
+        col2 = rng.integers(0, 11, 2048) / 100.0             # dict
+        hints: dict = {}
+        out1 = B.put_compressed([col1, col2], None, hints)
+        assert set(hints) == {0, 1}
+        assert hints[0][0] == "decimal" and hints[1][0] == "dict"
+        # second batch of the same columns: the hint path must produce
+        # bit-identical decodes
+        col1b = np.round(rng.uniform(900, 105000, 2048), 2)
+        col2b = rng.integers(0, 11, 2048) / 100.0
+        full = []
+        orig = B._encode_wire
+        monkeypatch.setattr(
+            B, "_encode_wire", lambda a, d=None: full.append(1) or orig(a, d)
+        )
+        out2 = B.put_compressed([col1b, col2b], None, hints)
+        assert not full  # both columns rode their hints
+        assert np.array_equal(np.asarray(out2[0]).view(np.int64), col1b.view(np.int64))
+        assert np.array_equal(np.asarray(out2[1]).view(np.int64), col2b.view(np.int64))
+        assert np.array_equal(np.asarray(out1[0]).view(np.int64), col1.view(np.int64))
+
+    def test_wire_hint_miss_falls_back(self, monkeypatch):
+        from datafusion_tpu.exec import batch as B
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        rng = np.random.default_rng(6)
+        col = np.round(rng.uniform(0, 100, 2048), 2)  # decimal 100
+        hints: dict = {}
+        B.put_compressed([col], None, hints)
+        assert hints[0][0] == "decimal"
+        # next batch breaks the fixed-point assumption: full probe rules
+        wild = rng.standard_normal(2048)
+        out = B.put_compressed([wild], None, hints)
+        assert np.array_equal(
+            np.asarray(out[0]).view(np.int64), wild.view(np.int64)
+        )
+
+    def test_blob_pull_roundtrip_forced(self, monkeypatch):
+        # DATAFUSION_TPU_WIRE=always keeps the blob-packed D2H path live
+        # on CPU (device_pull_start's host-platform skip is bypassed)
+        import jax.numpy as jnp
+
+        from datafusion_tpu.exec import batch as B
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        rng = np.random.default_rng(9)
+        tree = (
+            jnp.asarray(rng.integers(-(2**62), 2**62, 1024)),
+            (
+                jnp.asarray(rng.standard_normal(1024)),
+                jnp.asarray(rng.random(1024) > 0.5),
+            ),
+            jnp.asarray(rng.integers(0, 255, 1024).astype(np.uint8)),
+        )
+        pull = B.device_pull_start(tree)
+        assert pull._blob is not None  # the packed path, not direct pulls
+        out = pull.finish()
+        leaves_in = [tree[0], tree[1][0], tree[1][1], tree[2]]
+        leaves_out = [out[0], out[1][0], out[1][1], out[2]]
+        for want, got in zip(leaves_in, leaves_out):
+            w = np.asarray(want)
+            assert got.dtype == w.dtype
+            assert np.array_equal(got, w, equal_nan=(w.dtype.kind == "f"))
