@@ -1105,14 +1105,9 @@ class AggregateRelation(Relation):
             return hit[1]
         mask = batch.mask
         if self._host_pred_expr is not None:
-            from datafusion_tpu.exec.hostfn import eval_host_expr
+            from datafusion_tpu.exec.hostfn import host_pred_mask
 
-            pv, pvalid = eval_host_expr(self._host_pred_expr, batch, {})
-            pm = np.broadcast_to(np.asarray(pv, dtype=bool), (batch.capacity,))
-            if pvalid is not None:
-                pm = pm & np.broadcast_to(
-                    np.asarray(pvalid, dtype=bool), (batch.capacity,)
-                )
+            pm = host_pred_mask(self._host_pred_expr, batch, {})
             # an upstream device mask would need a D2H pull to combine
             # host-side — rare (the planner fuses filters into the
             # aggregate), and still correct when it happens

@@ -348,6 +348,10 @@ def _encode_wire_hinted(a: np.ndarray, hint, device=None):
             return ("dict",), (pos.astype(np.uint8), _dict_table(values_bits))
         return None
     if tag == "decimal":
+        if not _decimal_allowed(device):
+            # hints travel with process-wide cores across devices; the
+            # probe's platform gate must hold on THIS target too
+            return None
         scale = hint[1]
         image = _decimal_image(a, bits, scale)
         if image is None:
@@ -837,6 +841,10 @@ def put_compressed(host_arrays, device=None, hints=None):
                     h = _wire_hint_of(spec, wires)
                     if h is not None:
                         hints[i] = h
+                    else:
+                        # evict a dead hint: re-validating it would cost
+                        # full-column passes per batch just to fail
+                        hints.pop(i, None)
         else:
             spec, wires = ("raw",), (a,)  # already a device array
         specs.append(spec)

@@ -142,6 +142,22 @@ _NUMPY_OPS = {
 }
 
 
+def host_pred_mask(
+    expr: Expr, batch: RecordBatch, metas: dict[str, FunctionMeta]
+) -> np.ndarray:
+    """Evaluate a host-routed predicate to a capacity-length bool mask,
+    with SQL semantics: a NULL predicate drops the row.  The one shared
+    definition of this fold — the pipeline and aggregate host-predicate
+    paths must never diverge on it."""
+    pv, pvalid = eval_host_expr(expr, batch, metas)
+    pm = np.broadcast_to(np.asarray(pv, dtype=bool), (batch.capacity,))
+    if pvalid is not None:
+        pm = pm & np.broadcast_to(
+            np.asarray(pvalid, dtype=bool), (batch.capacity,)
+        )
+    return pm
+
+
 def eval_host_expr(
     expr: Expr, batch: RecordBatch, metas: dict[str, FunctionMeta]
 ):

@@ -455,15 +455,9 @@ class PipelineRelation(Relation):
         hit = batch.cache.get("pipe_pred_mask")
         if hit is not None and hit[0] is self:
             return hit[1]
-        from datafusion_tpu.exec.hostfn import eval_host_expr
+        from datafusion_tpu.exec.hostfn import host_pred_mask
 
-        pv, pvalid = eval_host_expr(self._host_pred_expr, batch, self._metas)
-        pm = np.broadcast_to(np.asarray(pv, dtype=bool), (batch.capacity,))
-        if pvalid is not None:
-            # SQL: NULL predicate drops the row
-            pm = pm & np.broadcast_to(
-                np.asarray(pvalid, dtype=bool), (batch.capacity,)
-            )
+        pm = host_pred_mask(self._host_pred_expr, batch, self._metas)
         batch.cache["pipe_pred_mask"] = (self, pm)
         return pm
 
