@@ -854,6 +854,96 @@ class _AggregateCore:
         return new_counts, tuple(new_accs)
 
 
+# host throughput assumed by the placement cost model: one grouped
+# pass (numpy eval + bincount) over a column on one core.  Measured
+# ~100-150 M rows/s here; the constant only needs order-of-magnitude
+# accuracy — link rates differ from it by 50x in either direction.
+_HOST_AGG_SECONDS_PER_ROW = 8e-9
+
+
+class _Placement:
+    """Outcome of the link-aware slot split: which SELECT-list specs
+    compute on host, and the (smaller) device core for the rest."""
+
+    __slots__ = ("host_idx", "core", "params")
+
+    def __init__(self, host_idx, core, params):
+        self.host_idx = host_idx  # frozenset of spec positions
+        self.core = core          # _AggregateCore or None (full host)
+        self.params = params
+
+
+class _HostPartials:
+    """Grouped partial aggregation on the host for link-expensive
+    slots: per-batch numpy eval of the slot argument + np.bincount per
+    group.  Arithmetic is plain IEEE f64 — the same number class as
+    the engine's CPU path.  Only float SUM/AVG and COUNT route here
+    (integer sums keep exact int64 accumulation on device; bincount
+    weights are f64)."""
+
+    __slots__ = ("rel", "sum_exprs", "cnt_exprs", "sums", "cnts", "rowcounts")
+
+    def __init__(self, rel, host_idx):
+        self.rel = rel
+        self.sum_exprs: dict[str, Expr] = {}
+        self.cnt_exprs: dict[str, Expr] = {}
+        for j in host_idx:
+            s = rel.specs[j]
+            k = repr(s.arg)
+            if s.name in ("sum", "avg"):
+                self.sum_exprs[k] = s.arg
+                self.cnt_exprs[k] = s.arg
+            elif s.name == "count" and not s.count_star:
+                self.cnt_exprs[k] = s.arg
+        self.sums: dict[str, np.ndarray] = {}
+        self.cnts: dict[str, np.ndarray] = {}
+        self.rowcounts: Optional[np.ndarray] = None
+
+    @staticmethod
+    def _grown(arr, n, dtype):
+        if arr is None:
+            return np.zeros(n, dtype)
+        if len(arr) < n:
+            return np.pad(arr, (0, n - len(arr)))
+        return arr
+
+    def update(self, batch, ids_np, live, track_rowcounts):
+        from datafusion_tpu.exec.hostfn import eval_host_expr
+
+        n = max(self.rel.encoder.num_groups, 1) if self.rel.key_cols else 1
+        if track_rowcounts:
+            self.rowcounts = self._grown(self.rowcounts, n, np.int64)
+            rc = np.bincount(ids_np[live], minlength=n)
+            self.rowcounts[: len(rc)] += rc
+        for k in set(self.sum_exprs) | set(self.cnt_exprs):
+            e = self.sum_exprs.get(k)
+            count_only = e is None
+            if count_only:
+                e = self.cnt_exprs[k]
+            if count_only and isinstance(e, Column):
+                # COUNT(col): only the validity matters — never decode
+                # or materialize the values (Utf8 columns would build
+                # an object array per batch just to be discarded)
+                v = None
+                valid = batch.validity[e.index]
+                valid = None if valid is None else np.asarray(valid)
+            else:
+                v, valid = eval_host_expr(e, batch, {})
+            ok = live if valid is None else (live & np.asarray(valid, bool))
+            idsk = ids_np[ok]
+            if k in self.sum_exprs:
+                vv = np.broadcast_to(
+                    np.asarray(v, np.float64), (batch.capacity,)
+                )
+                s = np.bincount(idsk, weights=vv[ok], minlength=n)
+                self.sums[k] = self._grown(self.sums.get(k), n, np.float64)
+                self.sums[k][: len(s)] += s
+            if k in self.cnt_exprs:
+                c = np.bincount(idsk, minlength=n)
+                self.cnts[k] = self._grown(self.cnts.get(k), n, np.int64)
+                self.cnts[k][: len(c)] += c
+
+
 class AggregateRelation(Relation):
     """Executes [Selection +] Aggregate over a child relation in one
     fused kernel; emits a single result batch.
@@ -896,6 +986,15 @@ class AggregateRelation(Relation):
         )
         self._host_pred_expr = predicate if host_pred else None
         core_pred = None if host_pred else predicate
+        self._core_pred = core_pred
+        self._group_expr = list(group_expr)
+        self._aggr_expr = list(aggr_expr)
+        self._functions = functions
+        # link-aware slot placement (decided lazily from the first
+        # batch; see _decide_placement).  Workers disable it: their
+        # partial-state wire protocol ships device accumulators.
+        self._placement = None
+        self._allow_host_split = True
         self.core = _AggregateCore.build(
             child.schema, list(group_expr), list(aggr_expr), core_pred,
             functions,
@@ -947,11 +1046,11 @@ class AggregateRelation(Relation):
     def _grow_state(self, state, new_capacity: int):
         return self.core._grow_state(state, new_capacity)
 
-    def _compute_str_aux(self, batch: RecordBatch):
+    def _compute_str_aux(self, batch: RecordBatch, slots=None):
         """(ranks, rank->code) pair per string min/max slot, padded to a
         bucketed capacity, cached per dictionary version."""
         out = []
-        for k, sl in enumerate(self.slots):
+        for k, sl in enumerate(self.slots if slots is None else slots):
             if not sl.is_string:
                 out.append(None)
                 continue
@@ -993,24 +1092,177 @@ class AggregateRelation(Relation):
             return max(needed, current)
         return group_capacity(4 * n)
 
+    def _decide_placement(self, batch) -> Optional[_Placement]:
+        """Link-aware split of the SELECT-list aggregates between host
+        and device, decided once per query from the first batch.
+
+        Accelerator links vary by ~50x in both directions around the
+        break-even point, so placement must be measured, not assumed:
+        shipping a column costs wire_bytes/link_rate; computing its
+        grouped partials on the host costs ~rows * 8 ns per pass.  On
+        a slow link (tunneled chip) wide columns — or everything —
+        stay on the host; on real TPU interconnects everything ships
+        exactly as before.  Only float SUM/AVG and COUNT are eligible
+        (exact integer accumulation, MIN/MAX, and Utf8 slots keep
+        their device forms); in-memory (reusable) sources always ship
+        because their device copies amortize across queries.
+        """
+        from datafusion_tpu.exec.batch import (
+            _encode_wire,
+            _wire_enabled,
+            link_rate_mbps,
+        )
+        from datafusion_tpu.exec.hostfn import host_evaluable
+
+        if not self._allow_host_split or not _wire_enabled(self.device):
+            return None
+        # reusable sources: upload once, re-query forever — always ship
+        node = self.child
+        while node is not None:
+            ds = getattr(node, "datasource", None)
+            if ds is not None:
+                if getattr(ds, "reusable_batches", False):
+                    return None
+                break
+            node = getattr(node, "child", None)
+        # host slots need a host-visible mask
+        if batch.mask is not None and hasattr(batch.mask, "copy_to_host_async"):
+            return None
+        # ... and a host-evaluable predicate: host partials must apply
+        # the same row filter the device kernel would (a device-only
+        # predicate would silently include filtered rows in host sums)
+        if self._core_pred is not None and not host_evaluable(
+            self._core_pred, {}, self.child.schema
+        ):
+            return None
+        host_idx = set()
+        for j, s in enumerate(self.specs):
+            if s.is_string or s.name in ("min", "max") or s.count_star:
+                continue
+            if s.name in ("sum", "avg") and np.dtype(s.sum_dtype).kind != "f":
+                continue
+            # COUNT(col) needs only the column's validity, so any bare
+            # column reference (Utf8 included) is host-computable
+            count_of_col = s.name == "count" and isinstance(s.arg, Column)
+            if not count_of_col and not host_evaluable(
+                s.arg, {}, self.child.schema
+            ):
+                continue
+            host_idx.add(j)
+        if not host_idx:
+            return None
+        # bytes saved = wire bytes of columns used ONLY by host slots
+        host_cols: set[int] = set()
+        for j in host_idx:
+            self.specs[j].arg.collect_columns(host_cols)
+        kept: set[int] = set()
+        if self._core_pred is not None:
+            self._core_pred.collect_columns(kept)
+        for j, s in enumerate(self.specs):
+            if j not in host_idx:
+                s.arg.collect_columns(kept)
+        saved = host_cols - kept
+        if not saved:
+            return None
+        bytes_per_row = 0.0
+        for c in sorted(saved):
+            col = np.asarray(batch.data[c])
+            _, wires = _encode_wire(col, self.device)
+            bytes_per_row += sum(
+                w.nbytes for w in wires if isinstance(w, np.ndarray)
+            ) / max(batch.capacity, 1)
+        passes = len(set(
+            repr(self.specs[j].arg) for j in host_idx
+        ))
+        ship_s = bytes_per_row / (link_rate_mbps(self.device) * 1e6)
+        host_s = passes * _HOST_AGG_SECONDS_PER_ROW
+        if ship_s <= host_s:
+            return None
+        METRICS.add("aggregate.host_routed_slots", len(host_idx))
+        dev_idx = [j for j in range(len(self.specs)) if j not in host_idx]
+        if all(self.specs[j].count_star for j in dev_idx):
+            # only COUNT(*) would remain: its value is the host row
+            # counts — skip the device entirely
+            host_idx.update(dev_idx)
+            dev_idx = []
+        if dev_idx:
+            from datafusion_tpu.exec.kernels import parameterize_exprs
+
+            dev_exprs = [self._aggr_expr[j] for j in dev_idx]
+            core2 = _AggregateCore.build(
+                self.child.schema, self._group_expr, dev_exprs,
+                self._core_pred, self._functions,
+            )
+            params2 = parameterize_exprs(
+                _AggregateCore.param_exprs(self._core_pred, dev_exprs)
+            )[2]
+        else:
+            core2, params2 = None, ()
+        return _Placement(frozenset(host_idx), core2, params2)
+
+    def _host_live_mask(self, batch) -> np.ndarray:
+        """Numpy row-liveness for host-side slot updates: row bound +
+        upstream mask + the query predicate (whether it was routed to
+        the host or rides in the device core — _decide_placement
+        guarantees it is host-evaluable whenever this path runs)."""
+        live = np.zeros(batch.capacity, bool)
+        live[: batch.num_rows] = True
+        pred = self._host_pred_expr or self._core_pred
+        if pred is not None:
+            from datafusion_tpu.exec.hostfn import host_pred_mask
+
+            live &= host_pred_mask(pred, batch, {})
+        if batch.mask is not None:
+            live &= np.asarray(batch.mask)
+        return live
+
     def accumulate(self):
-        """Run the scan, returning the partial-aggregate device state.
+        """Run the scan, returning the partial-aggregate device state
+        (or a ("hostsplit", device_state, partials) triple when the
+        link-aware placement routed slots to the host).
 
         Partitioned mode calls this per shard and combines states with
         collectives; single-device mode finalizes it directly.
         """
+        import itertools
+
+        src = iter(self.child.batches())
+        first = next(src, None)
+        if first is None:
+            return self._init_state(group_capacity(1))
+        if self._placement is None:
+            self._placement = self._decide_placement(first) or False
+        placement = self._placement or None
+        batches = itertools.chain([first], src)
+        if placement is None:
+            return self._accumulate_core(
+                batches, self.core, self._params, host_partials=None
+            )
+        partials = _HostPartials(self, placement.host_idx)
+        state = self._accumulate_core(
+            batches, placement.core, placement.params, host_partials=partials
+        )
+        return ("hostsplit", state, partials)
+
+    def _accumulate_core(self, batches, core, params, host_partials):
+        """The scan loop over one device core (the full core, or the
+        placement's reduced core — None when every slot went host)."""
         from datafusion_tpu.exec.batch import device_inputs
         from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
         from datafusion_tpu.exec.relation import device_scope
 
-        batches = self.child.batches()
         if pipeline_enabled(self.device):
             # producer thread runs all host prep for batch N+1 (group-id
             # encode, aux tables, wire encode + H2D dispatch) while the
             # consumer below dispatches batch N's kernel; results land
             # in batch.cache / relation caches and are re-read as hits
             def _stage(b):
-                self._group_ids(b)
+                self._group_ids(
+                    b, upload=core is not None,
+                    keep_np=host_partials is not None,
+                )
+                if core is None:
+                    return
                 # pin the aux tables computed NOW on the batch: global
                 # dictionaries keep growing while later batches parse,
                 # so a consumer-side recompute could see a bigger table
@@ -1019,11 +1271,11 @@ class AggregateRelation(Relation):
                 # encoder pin) so another relation on the same long-
                 # lived batch can never consume this one's aux.
                 b.cache["staged_aux"] = (
-                    self.core,
-                    tuple(compute_aux_values(self._aux_specs, b, self._aux_cache)),
-                    self._compute_str_aux(b),
+                    core,
+                    tuple(compute_aux_values(core.aux_specs, b, self._aux_cache)),
+                    self._compute_str_aux(b, core.slots),
                 )
-                device_inputs(self._device_view(b), self.device, self.core.wire_hints)
+                device_inputs(self._device_view(b, core), self.device, core.wire_hints)
 
             batches = staged_pipeline(batches, _stage)
 
@@ -1047,20 +1299,20 @@ class AggregateRelation(Relation):
             needed = self._pick_capacity(capacity)
             if state is None:
                 capacity = needed
-                state = self._init_state(capacity)
+                state = core._init_state(capacity)
             elif needed > capacity:
-                state = self._grow_state(state, needed)
+                state = core._grow_state(state, needed)
                 capacity = needed
             with METRICS.timer("execute.aggregate"), device_scope(self.device):
                 if len(chunk) == 1:
                     c = chunk[0]
                     state = device_call(
-                        self._jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
-                        c[6], self._params,
+                        core.jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
+                        c[6], params,
                     )
                 else:
                     state = device_call(
-                        self.core.fused_jit, tuple(chunk), state, self._params
+                        core.fused_jit, tuple(chunk), state, params
                     )
             chunk.clear()
 
@@ -1068,16 +1320,32 @@ class AggregateRelation(Relation):
             for idx in self.key_cols:
                 if batch.dicts[idx] is not None:
                     self._key_dicts[idx] = batch.dicts[idx]
-            ids = self._group_ids(batch)
+            ids = self._group_ids(
+                batch, upload=core is not None,
+                keep_np=host_partials is not None,
+            )
+            if host_partials is not None:
+                np_hit = batch.cache.get("group_ids_np")
+                ids_np = (
+                    np_hit[1]
+                    if np_hit is not None and np_hit[0] is self.encoder
+                    else self._group_ids(batch, upload=False)
+                )
+                host_partials.update(
+                    batch, ids_np, self._host_live_mask(batch),
+                    track_rowcounts=core is None,
+                )
+            if core is None:
+                continue
             staged = batch.cache.get("staged_aux")
-            if staged is not None and staged[0] is self.core:
+            if staged is not None and staged[0] is core:
                 _, aux, str_aux = staged
             else:
-                aux = compute_aux_values(self._aux_specs, batch, self._aux_cache)
-                str_aux = self._compute_str_aux(batch)
+                aux = compute_aux_values(core.aux_specs, batch, self._aux_cache)
+                str_aux = self._compute_str_aux(batch, core.slots)
             with device_scope(self.device):
                 data, validity, mask = device_inputs(
-                    self._device_view(batch), self.device, self.core.wire_hints
+                    self._device_view(batch, core), self.device, core.wire_hints
                 )
             chunk.append(
                 (data, validity, tuple(aux), np.int32(batch.num_rows), mask,
@@ -1085,24 +1353,27 @@ class AggregateRelation(Relation):
             )
             if len(chunk) >= fuse:
                 flush()
+        if core is None:
+            return None
         flush()
         if state is None:
-            state = self._init_state(group_capacity(1))
+            state = core._init_state(group_capacity(1))
         return state
 
-    def _device_view(self, batch: RecordBatch) -> RecordBatch:
+    def _device_view(self, batch: RecordBatch, core=None) -> RecordBatch:
         """The batch as the device kernel sees it: only `used_cols`
         (group keys travel as dense ids, host-predicate inputs not at
         all), with the host-evaluated predicate folded into the mask.
-        Cached on the batch (core-pinned) so re-scanned in-memory
-        batches keep their device copies across runs."""
-        core = self.core
+        Cached on the batch (relation+core-pinned) so re-scanned
+        in-memory batches keep their device copies across runs."""
+        if core is None:
+            core = self.core
         if self._host_pred_expr is None and len(core.used_cols) == batch.num_columns:
             return batch
         key = "agg_view"
         hit = batch.cache.get(key)
-        if hit is not None and hit[0] is self:
-            return hit[1]
+        if hit is not None and hit[0] is self and hit[1] is core:
+            return hit[2]
         mask = batch.mask
         if self._host_pred_expr is not None:
             from datafusion_tpu.exec.hostfn import host_pred_mask
@@ -1120,16 +1391,20 @@ class AggregateRelation(Relation):
             num_rows=batch.num_rows,
             mask=mask,
         )
-        # pinned by RELATION, not core: the core is literal-insensitive
-        # and shared, but the host-predicate mask baked into this view
-        # carries THIS query's literals
-        batch.cache[key] = (self, view)
+        # pinned by RELATION (the host-predicate mask carries THIS
+        # query's literals) and by the specific core (full vs reduced)
+        batch.cache[key] = (self, core, view)
         return view
 
-    def _group_ids(self, batch: RecordBatch):
-        """Device array of dense group ids for one batch; cached on the
-        batch (keyed by this relation's encoder) so re-scanned in-memory
-        batches skip both the host encode and the H2D transfer.
+    def _group_ids(self, batch: RecordBatch, upload: bool = True,
+                   keep_np: bool = False):
+        """Dense group ids for one batch — the device array (plus,
+        under `keep_np`, the host `"group_ids_np"` cache entry the
+        host-partials path reads).  `upload=False` (full-host
+        placement) encodes without ever touching the device.  Cached on
+        the batch (keyed by this relation's encoder) so re-scanned
+        in-memory batches skip both the host encode and the H2D
+        transfer; pure-device runs keep only the device copy.
 
         Serialized by `_ids_lock`: the staging producer thread normally
         does all encoding, but a pin miss (another relation's encode
@@ -1139,17 +1414,25 @@ class AggregateRelation(Relation):
         # it) so long-lived in-memory batches hold at most one ids array,
         # not one per query ever run; the entry pins the encoder so the
         # identity check can't hit a recycled object
-        hit = batch.cache.get("group_ids")
+        key = "group_ids" if upload else "group_ids_np"
+        hit = batch.cache.get(key)
         if hit is not None and hit[0] is self.encoder:
-            return hit[1]
+            if not keep_np or batch.cache.get("group_ids_np") is not None:
+                return hit[1]
         with self._ids_lock:
-            return self._group_ids_locked(batch)
+            return self._group_ids_locked(batch, upload, keep_np)
 
-    def _group_ids_locked(self, batch: RecordBatch):
-        hit = batch.cache.get("group_ids")
+    def _group_ids_locked(self, batch: RecordBatch, upload: bool = True,
+                          keep_np: bool = False):
+        key = "group_ids" if upload else "group_ids_np"
+        hit = batch.cache.get(key)
         if hit is not None and hit[0] is self.encoder:
-            return hit[1]
-        if self.key_cols:
+            if not keep_np or batch.cache.get("group_ids_np") is not None:
+                return hit[1]
+        np_hit = batch.cache.get("group_ids_np")
+        if np_hit is not None and np_hit[0] is self.encoder:
+            ids_np = np_hit[1]
+        elif self.key_cols:
             key_cols = [np.asarray(batch.data[idx]) for idx in self.key_cols]
             key_valids = [
                 None if batch.validity[idx] is None else np.asarray(batch.validity[idx])
@@ -1158,6 +1441,12 @@ class AggregateRelation(Relation):
             ids_np = self.encoder.encode(key_cols, key_valids)
         else:
             ids_np = np.zeros(batch.capacity, dtype=np.int32)
+        if keep_np or not upload:
+            batch.cache["group_ids_np"] = (self.encoder, ids_np)
+        if not upload:
+            return ids_np
+        if hit is not None and hit[0] is self.encoder:
+            return hit[1]  # device copy already cached; np now kept too
         # ship ids in the narrowest width that holds the group count and
         # widen on device (H2D bytes 4x/2x smaller for the common small-
         # cardinality GROUP BY); pointless when the target is the host
@@ -1184,7 +1473,79 @@ class AggregateRelation(Relation):
         batch.cache["group_ids"] = (self.encoder, ids)
         return ids
 
-    def finalize(self, state) -> RecordBatch:
+    @staticmethod
+    def _numeric_output(s: AggregateSpec, sums, cnts, live_counts):
+        """(values, validity) for a SUM/AVG/COUNT spec from its summed
+        and counted per-group arrays — THE definition of these
+        aggregates' value/null semantics, shared by the device-pull and
+        host-partials finalize paths."""
+        if s.name in ("sum", "avg"):
+            if s.name == "sum":
+                vals = sums.astype(s.return_type.np_dtype)
+            else:
+                vals = (sums.astype(np.float64) / np.maximum(cnts, 1)).astype(
+                    s.return_type.np_dtype
+                )
+            valid = cnts > 0
+        else:  # count
+            raw = live_counts if cnts is None else cnts
+            vals = raw.astype(s.return_type.np_dtype)
+            valid = None
+        if valid is not None and bool(np.asarray(valid).all()):
+            valid = None
+        return vals, valid
+
+    @classmethod
+    def _spec_output(cls, s: AggregateSpec, slot_host, live_counts, str_dicts):
+        """(values, validity, dict) for one aggregate spec from pulled
+        per-slot live-group arrays — shared by the plain and the
+        host-split finalize paths."""
+        if s.is_string:
+            codes = slot_host[s.minmax_slot].astype(np.int32)
+            valid = codes >= 0
+            return (
+                np.where(valid, codes, 0).astype(np.int32),
+                None if bool(valid.all()) else valid,
+                str_dicts.get(s.minmax_slot),
+            )
+        if s.name in ("sum", "avg", "count"):
+            sums = None if s.sum_slot is None else slot_host[s.sum_slot]
+            cnts = None if s.cnt_slot is None else slot_host[s.cnt_slot]
+            vals, valid = cls._numeric_output(s, sums, cnts, live_counts)
+            return vals, valid, None
+        if s.name == "min":
+            raw = slot_host[s.minmax_slot]
+            vals = raw.astype(s.return_type.np_dtype)
+            valid = raw != _min_identity(np.dtype(raw.dtype))
+        else:
+            raw = slot_host[s.minmax_slot]
+            vals = raw.astype(s.return_type.np_dtype)
+            valid = raw != _max_identity(np.dtype(raw.dtype))
+        if bool(np.asarray(valid).all()):
+            valid = None
+        return vals, valid, None
+
+    def _key_outputs(self, live):
+        """Group-key output columns for the live groups, in key order."""
+        out_cols, out_valid, out_dicts = [], [], []
+        in_schema = self.child.schema
+        for k, idx in enumerate(self.key_cols):
+            keys, kvalid = self.encoder.key_column(k)
+            keys = keys[live]
+            f = in_schema.field(idx)
+            npd = np.dtype(f.data_type.np_dtype)
+            if npd.kind == "f":
+                # float keys were bit-cast into the encoder; bit-cast back
+                out_cols.append(keys.view(np.float64).astype(npd))
+            else:
+                out_cols.append(keys.astype(npd))
+            out_valid.append(None if kvalid is None else kvalid[live])
+            out_dicts.append(self._key_dicts.get(idx))
+        return out_cols, out_valid, out_dicts
+
+    def _pull_state(self, state):
+        """Pull a device accumulator state's live prefix to host.
+        Returns (counts, per-slot host arrays)."""
         counts, accs = state
         # transfer only the live prefix: dense ids mean groups occupy
         # [0, num_groups) of the power-of-two capacity, so slicing on
@@ -1200,69 +1561,75 @@ class AggregateRelation(Relation):
         # ONE blob-packed transfer for the whole result state: each
         # separate device->host copy costs a full link round trip
         counts, accs = device_pull((counts, accs))
-        counts = np.asarray(counts)
+        return np.asarray(counts), [np.asarray(a) for a in accs]
+
+    def finalize(self, state) -> RecordBatch:
+        if isinstance(state, tuple) and len(state) == 3 and state[0] == "hostsplit":
+            return self._finalize_split(state[1], state[2])
+        counts, accs = self._pull_state(state)
+        n_groups = self.encoder.num_groups if self.key_cols else 1
         if self.key_cols:
             live = np.nonzero(counts[:n_groups] > 0)[0]
         else:
             # global aggregate: always exactly one output row
             live = np.array([0], dtype=np.int64)
 
-        out_cols: list[np.ndarray] = []
-        out_valid: list[Optional[np.ndarray]] = []
-        out_dicts: list[Optional[StringDictionary]] = []
-
-        in_schema = self.child.schema
-        for k, idx in enumerate(self.key_cols):
-            keys, kvalid = self.encoder.key_column(k)
-            keys = keys[live]
-            f = in_schema.field(idx)
-            npd = np.dtype(f.data_type.np_dtype)
-            if npd.kind == "f":
-                # float keys were bit-cast into the encoder; bit-cast back
-                out_cols.append(keys.view(np.float64).astype(npd))
-            else:
-                out_cols.append(keys.astype(npd))
-            out_valid.append(None if kvalid is None else kvalid[live])
-            out_dicts.append(self._key_dicts.get(idx))
-
-        slot_host = [np.asarray(a)[live] for a in accs]
+        out_cols, out_valid, out_dicts = self._key_outputs(live)
+        slot_host = [a[live] for a in accs]
         live_counts = counts[live]
         for s in self.specs:
-            if s.is_string:
-                codes = slot_host[s.minmax_slot].astype(np.int32)
-                valid = codes >= 0
-                out_cols.append(np.where(valid, codes, 0).astype(np.int32))
-                out_valid.append(None if bool(valid.all()) else valid)
-                out_dicts.append(self._str_dicts.get(s.minmax_slot))
-                continue
-            if s.name in ("sum", "avg"):
-                sums = slot_host[s.sum_slot]
-                cnts = slot_host[s.cnt_slot]
-                if s.name == "sum":
-                    vals = sums.astype(s.return_type.np_dtype)
-                else:
-                    vals = (sums.astype(np.float64) / np.maximum(cnts, 1)).astype(
-                        s.return_type.np_dtype
-                    )
-                valid = cnts > 0
-            elif s.name == "count":
-                raw = live_counts if s.cnt_slot is None else slot_host[s.cnt_slot]
-                vals = raw.astype(s.return_type.np_dtype)
-                valid = None
-            elif s.name == "min":
-                raw = slot_host[s.minmax_slot]
-                vals = raw.astype(s.return_type.np_dtype)
-                valid = raw != _min_identity(np.dtype(raw.dtype))
-            else:
-                raw = slot_host[s.minmax_slot]
-                vals = raw.astype(s.return_type.np_dtype)
-                valid = raw != _max_identity(np.dtype(raw.dtype))
-            if valid is not None and bool(np.asarray(valid).all()):
-                valid = None
+            vals, valid, d = self._spec_output(
+                s, slot_host, live_counts, self._str_dicts
+            )
             out_cols.append(vals)
             out_valid.append(valid)
-            out_dicts.append(None)
+            out_dicts.append(d)
 
+        return make_host_batch(self._schema, out_cols, out_valid, out_dicts)
+
+    def _finalize_split(self, dev_state, partials: _HostPartials) -> RecordBatch:
+        """Merge device accumulators (reduced core) with host partials
+        into the SELECT-order output batch."""
+        placement = self._placement
+        core2 = placement.core
+        n_groups = max(self.encoder.num_groups, 1) if self.key_cols else 1
+        if core2 is not None and dev_state is not None:
+            counts, accs = self._pull_state(dev_state)
+        else:
+            counts = _HostPartials._grown(
+                partials.rowcounts, n_groups, np.int64
+            )
+            accs = []
+        if self.key_cols:
+            live = np.nonzero(counts[:n_groups] > 0)[0]
+        else:
+            live = np.array([0], dtype=np.int64)
+        out_cols, out_valid, out_dicts = self._key_outputs(live)
+        slot_host = [a[live] for a in accs]
+        live_counts = counts[live]
+        dev_pos = 0
+        grown = _HostPartials._grown
+        for j, s in enumerate(self.specs):
+            if j in placement.host_idx:
+                k = repr(s.arg)
+                sums = cnts = None
+                if s.name in ("sum", "avg"):
+                    sums = grown(partials.sums.get(k), n_groups, np.float64)[live]
+                if not s.count_star:
+                    cnts = grown(partials.cnts.get(k), n_groups, np.int64)[live]
+                vals, valid = self._numeric_output(s, sums, cnts, live_counts)
+                out_cols.append(vals)
+                out_valid.append(valid)
+                out_dicts.append(None)
+            else:
+                s2 = core2.specs[dev_pos]
+                dev_pos += 1
+                vals, valid, d = self._spec_output(
+                    s2, slot_host, live_counts, self._str_dicts
+                )
+                out_cols.append(vals)
+                out_valid.append(valid)
+                out_dicts.append(d)
         return make_host_batch(self._schema, out_cols, out_valid, out_dicts)
 
     def batches(self) -> Iterator[RecordBatch]:

@@ -328,6 +328,52 @@ def _dict_table(values_bits: np.ndarray) -> np.ndarray:
     return table.view(np.float64)
 
 
+# ---- link-rate probe: the placement cost model's one input ----------
+# Accelerator links differ by orders of magnitude (PCIe/ICI ~10+ GB/s;
+# a tunneled remote chip here sustains ~5 MB/s once a session has done
+# its first D2H).  Operators that can trade host compute against
+# shipping bytes (adaptive aggregate placement) read this once per
+# process.  DATAFUSION_TPU_LINK_MBPS overrides (tests pin both modes).
+_LINK_RATE: dict = {}
+
+
+def link_rate_mbps(device=None) -> float:
+    """Achieved H2D MB/s to `device`, measured once per platform.  The
+    probe first performs a small D2H so the measurement reflects the
+    steady session state (on tunneled transports the first D2H ends a
+    buffered-ack mode in which transfer timings are fiction)."""
+    knob = os.environ.get("DATAFUSION_TPU_LINK_MBPS")
+    if knob:
+        return float(knob)
+    platform = _target_platform(device)
+    if platform == "cpu":
+        return float("inf")
+    hit = _LINK_RATE.get(platform)
+    if hit is None:
+        import time
+
+        import jax
+
+        put = (
+            (lambda a: jax.device_put(a, device))
+            if device is not None
+            else jax.device_put
+        )
+        np.asarray(put(np.arange(16)))  # enter the post-D2H regime
+        rng = np.random.default_rng(0xBEEF)
+        arr = rng.integers(0, 255, 1 << 20, dtype=np.uint8)  # incompressible
+        rates = []
+        for _ in range(2):
+            t0 = time.perf_counter()
+            jax.block_until_ready(put(arr + np.uint8(1)))
+            rates.append(arr.nbytes / 1e6 / max(time.perf_counter() - t0, 1e-9))
+        hit = _LINK_RATE[platform] = float(max(rates))
+        from datafusion_tpu.utils.metrics import METRICS
+
+        METRICS.add("link.probe_mbps", int(hit))
+    return hit
+
+
 def _encode_wire_hinted(a: np.ndarray, hint, device=None):
     """Re-validate a previously chosen codec against a new batch of the
     same column: one verification pass instead of the full probe ladder

@@ -30,6 +30,13 @@ from datafusion_tpu.io.readers import (
 class DataSource:
     """Base: schema + re-iterable batches (reference `datasource.rs:26-29`)."""
 
+    # True when re-scans hand out the SAME RecordBatch objects, so
+    # device copies cached on them amortize across queries (in-memory
+    # tables).  File scans parse fresh batches per query.  Operators
+    # use this for link-aware placement: shipping a reusable table to
+    # the accelerator pays once; shipping a stream pays every query.
+    reusable_batches = False
+
     @property
     def schema(self) -> Schema:
         raise NotImplementedError
@@ -183,6 +190,8 @@ class ParquetDataSource(DataSource):
 
 class MemoryDataSource(DataSource):
     """In-memory source over prebuilt RecordBatches (test/bench helper)."""
+
+    reusable_batches = True
 
     def __init__(self, schema: Schema, record_batches: list[RecordBatch]):
         self._schema = schema

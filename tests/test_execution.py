@@ -1186,3 +1186,147 @@ class TestWirePolicy:
             w = np.asarray(want)
             assert got.dtype == w.dtype
             assert np.array_equal(got, w, equal_nan=(w.dtype.kind == "f"))
+
+
+class TestAdaptivePlacement:
+    """Link-aware aggregate slot placement (aggregate._decide_placement):
+    on a slow measured link, float SUM/AVG/COUNT partials compute on the
+    host via bincount instead of shipping their columns.  Forced on CPU
+    via DATAFUSION_TPU_WIRE=always + a pinned DATAFUSION_TPU_LINK_MBPS."""
+
+    def _rows(self, ctx, sql):
+        from datafusion_tpu.exec.materialize import collect
+
+        return sorted(collect(ctx.sql(sql)).to_rows())
+
+    def _assert_same(self, a, b):
+        assert len(a) == len(b)
+        for ra, rb in zip(a, b):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float):
+                    np.testing.assert_allclose(va, vb, rtol=1e-12)
+                else:
+                    assert va == vb
+
+    @pytest.fixture
+    def slow_link(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "0.001")
+
+    @pytest.fixture
+    def fast_link(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "1e9")
+
+    def test_full_host_split_matches_device(self, ctx, slow_link):
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+        from datafusion_tpu.utils.metrics import METRICS
+
+        sql = (
+            "SELECT city, SUM(lat), AVG(lng), COUNT(1) FROM cities "
+            "WHERE lat > 51.0 GROUP BY city"
+        )
+        rel = ctx.sql(sql)
+        node = rel
+        while node is not None and not isinstance(node, AggregateRelation):
+            node = getattr(node, "child", None)
+        assert node is not None
+        from datafusion_tpu.exec.materialize import collect
+
+        METRICS.reset()
+        got = sorted(collect(rel).to_rows())
+        # every slot went host: the reduced device core is gone entirely
+        assert node._placement and node._placement.core is None
+        assert METRICS.snapshot()["counts"].get("aggregate.host_routed_slots")
+        ctx2_rows = self._rows(self._fresh_ctx(ctx), sql)
+        self._assert_same(got, ctx2_rows)
+
+    def _fresh_ctx(self, ctx):
+        # same tables, default (no-split) placement: the comparison run
+        from datafusion_tpu import ExecutionContext
+        import os as _os
+
+        _os.environ["DATAFUSION_TPU_LINK_MBPS"] = "1e9"
+        c = ExecutionContext(batch_size=1024)
+        c.datasources = dict(ctx.datasources)
+        return c
+
+    def test_mixed_split_keeps_minmax_on_device(self, ctx, slow_link):
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+
+        sql = (
+            "SELECT SUM(lng), AVG(lng), COUNT(1), MIN(lat), MAX(city) "
+            "FROM cities WHERE lat > 51.0"
+        )
+        rel = ctx.sql(sql)
+        node = rel
+        while node is not None and not isinstance(node, AggregateRelation):
+            node = getattr(node, "child", None)
+        from datafusion_tpu.exec.materialize import collect
+
+        got = sorted(collect(rel).to_rows())
+        assert node._placement
+        assert node._placement.core is not None  # MIN/MAX stayed device
+        assert len(node._placement.core.specs) == 3  # count(*), min, max
+        self._assert_same(got, self._rows(self._fresh_ctx(ctx), sql))
+
+    def test_fast_link_never_splits(self, ctx, fast_link):
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+
+        sql = "SELECT city, SUM(lat) FROM cities GROUP BY city"
+        rel = ctx.sql(sql)
+        node = rel
+        while node is not None and not isinstance(node, AggregateRelation):
+            node = getattr(node, "child", None)
+        from datafusion_tpu.exec.materialize import collect
+
+        sorted(collect(rel).to_rows())
+        assert node._placement is False  # decided: no split
+
+    def test_nulls_through_host_partials(self, ctx, slow_link):
+        sql = (
+            "SELECT COUNT(1), COUNT(c_float), SUM(c_float), AVG(c_float) "
+            "FROM null_test"
+        )
+        got = self._rows(ctx, sql)
+        want = self._rows(self._fresh_ctx(ctx), sql)
+        self._assert_same(got, want)
+
+    def test_memory_source_always_ships(self, monkeypatch, slow_link):
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        schema = Schema([Field("k", DataType.INT64, False),
+                         Field("v", DataType.FLOAT64, False)])
+        rng = np.random.default_rng(2)
+        b = make_host_batch(
+            schema,
+            [rng.integers(0, 4, 2048), np.round(rng.uniform(0, 9, 2048), 2)],
+            [None, None], [None, None],
+        )
+        c = ExecutionContext(batch_size=2048)
+        c.register_datasource("t", MemoryDataSource(schema, [b]))
+        rel = c.sql("SELECT k, SUM(v) FROM t GROUP BY k")
+        node = rel
+        while node is not None and not isinstance(node, AggregateRelation):
+            node = getattr(node, "child", None)
+        from datafusion_tpu.exec.materialize import collect
+
+        sorted(collect(rel).to_rows())
+        assert node._placement is False  # reusable source: always device
+
+    def test_count_utf8_column_host(self, ctx, slow_link):
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+        from datafusion_tpu.exec.materialize import collect
+
+        sql = "SELECT COUNT(c_string), SUM(c_float) FROM null_test"
+        rel = ctx.sql(sql)
+        node = rel
+        while not isinstance(node, AggregateRelation):
+            node = node.child
+        got = sorted(collect(rel).to_rows())
+        assert node._placement and node._placement.core is None
+        want = self._rows(self._fresh_ctx(ctx), sql)
+        self._assert_same(got, want)
