@@ -87,6 +87,12 @@ def _np_sort_key(
     return dead, k
 
 
+# host throughput assumed by the sort placement cost model: np.lexsort
+# of one key pair over one core (order-of-magnitude constant, like
+# aggregate._HOST_AGG_SECONDS_PER_ROW)
+_HOST_SORT_SECONDS_PER_ROW = 1.5e-7
+
+
 class _KeyPlan:
     """How one ORDER BY key lowers onto a column: which column, its
     transform kind, direction, source width, and (for Utf8) a
@@ -797,6 +803,40 @@ class SortRelation(Relation):
 
     _SORT_RUN_JIT = None
 
+    def _host_run_sort(self, keys: list[np.ndarray], n: int):
+        """Host np.lexsort permutation when the link makes the device
+        round trip unprofitable, or None to use the device.
+
+        The device sort's D2H cost is the permutation itself
+        (~ceil(bits/8) incompressible bytes per row); on a slow link
+        that dwarfs a host lexsort of the same key operands.  Both
+        sorts are stable over identical operands, so the permutations
+        are identical — except for NaN float keys, where numpy (all
+        NaNs last) and XLA's total order (sign-respecting) disagree;
+        any NaN forces the device path."""
+        from datafusion_tpu.exec.batch import _wire_enabled, link_rate_mbps
+
+        if not _wire_enabled(self.device):
+            return None
+        cap = bucket_capacity(n)
+        perm_bytes = n * max(1, ((cap - 1).bit_length() + 7) >> 3)
+        dev_s = perm_bytes / (link_rate_mbps(self.device) * 1e6)
+        host_s = n * _HOST_SORT_SECONDS_PER_ROW * max(len(keys) // 2, 1)
+        if host_s >= dev_s:
+            return None
+        # NaN check last: it is an O(n) pass per float key, and on fast
+        # links the cost model above already routed to the device
+        for j in range(1, len(keys), 2):
+            if keys[j].dtype.kind == "f" and bool(np.isnan(keys[j][:n]).any()):
+                return None
+        METRICS.add("sort.host_routed_runs")
+        # significance: np.lexsort's LAST key is primary — reversing
+        # [dead0, val0, dead1, val1, ...] reproduces the device
+        # operand order (dead flag before value, key 0 outermost)
+        return np.lexsort(tuple(k[:n] for k in reversed(keys))).astype(
+            np.int32
+        )
+
     def _sorted_run(self, keys: list[np.ndarray], n: int, cache_key=None,
                     pin=None) -> np.ndarray:
         """Device-sort one run of n rows; returns the permutation.
@@ -811,6 +851,9 @@ class SortRelation(Relation):
         alive) so a warm re-query skips straight to _sort_ops."""
         from datafusion_tpu.exec.batch import put_compressed
 
+        host_perm = self._host_run_sort(keys, n)
+        if host_perm is not None:
+            return host_perm
         cap = bucket_capacity(n)
         host_ops: list[np.ndarray] = []
         # keys come as (dead-flag, value) pairs per ORDER BY key

@@ -524,3 +524,77 @@ class TestTopKExactPayloads:
         keys, live, rows = init[0], init[1], init[2]
         assert rows.dtype.name == "int64"
         collect(rel)  # executes end to end
+
+
+class TestHostRoutedRunSort:
+    """Link-aware full-sort placement (SortRelation._host_run_sort):
+    on a slow measured link the run permutation computes on the host
+    via np.lexsort; the stable orders must match the device path
+    exactly."""
+
+    def _src(self, nulls=False, nans=False):
+        import numpy as np
+
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        rng = np.random.default_rng(21)
+        n = 4096
+        schema = Schema([
+            Field("a", DataType.FLOAT64, True),
+            Field("b", DataType.INT64, False),
+            Field("s", DataType.UTF8, False),
+        ])
+        a = np.round(rng.uniform(-100, 100, n), 2)
+        if nans:
+            a[::97] = np.nan
+        valid_a = rng.random(n) > 0.1 if nulls else None
+        b = rng.integers(-50, 50, n)
+        from datafusion_tpu.exec.batch import StringDictionary
+
+        d = StringDictionary()
+        codes = d.encode([f"v{int(x) % 13}" for x in b])
+        batches = []
+        half = n // 2
+        for lo, hi in ((0, half), (half, n)):
+            batches.append(make_host_batch(
+                schema,
+                [a[lo:hi], b[lo:hi], codes[lo:hi]],
+                [None if valid_a is None else valid_a[lo:hi], None, None],
+                [None, None, d],
+            ))
+        ctx = ExecutionContext(batch_size=half)
+        ctx.register_datasource("t", MemoryDataSource(schema, batches))
+        return ctx
+
+    def _run(self, ctx, sql, env, monkeypatch):
+        from datafusion_tpu.exec.materialize import collect
+
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        return collect(ctx.sql(sql)).to_rows()
+
+    @pytest.mark.parametrize("sql", [
+        "SELECT a, b, s FROM t ORDER BY a, b",
+        "SELECT a, b, s FROM t ORDER BY b DESC, a",
+        "SELECT s, a FROM t ORDER BY s, a DESC",
+    ])
+    def test_host_sort_matches_device(self, sql, monkeypatch):
+        from datafusion_tpu.utils.metrics import METRICS
+
+        slow = {"DATAFUSION_TPU_WIRE": "always", "DATAFUSION_TPU_LINK_MBPS": "0.001"}
+        fast = {"DATAFUSION_TPU_WIRE": "always", "DATAFUSION_TPU_LINK_MBPS": "1e9"}
+        METRICS.reset()
+        got = self._run(self._src(nulls=True), sql, slow, monkeypatch)
+        assert METRICS.snapshot()["counts"].get("sort.host_routed_runs")
+        want = self._run(self._src(nulls=True), sql, fast, monkeypatch)
+        assert got == want
+
+    def test_nan_keys_stay_on_device(self, monkeypatch):
+        from datafusion_tpu.utils.metrics import METRICS
+
+        slow = {"DATAFUSION_TPU_WIRE": "always", "DATAFUSION_TPU_LINK_MBPS": "0.001"}
+        METRICS.reset()
+        self._run(self._src(nans=True), "SELECT a, b FROM t ORDER BY a DESC", slow, monkeypatch)
+        assert not METRICS.snapshot()["counts"].get("sort.host_routed_runs")
