@@ -11,8 +11,11 @@ Cold (scan-inclusive: Parquet parse, dictionary encode, H2D, kernel,
 D2H) is reported separately with a per-phase breakdown under
 `configs.tpch_q1_parquet`.
 
-Env knobs: BENCH_SF (lineitem scale factor, default 1), BENCH_CONFIGS
-(comma list, default "1,2,3,4,5"), BENCH_RUNS / BENCH_COLD_RUNS.
+Env knobs: BENCH_SF (lineitem scale factor for config 3, default 1),
+BENCH_CONFIGS (comma list, default "1,2,3,4,5,3sf10,worker" — "3sf10"
+runs Q1 at the north-star SF-10 scale, "worker" runs the
+coordinator->worker-on-chip parity smoke and writes
+artifacts/TPU_WORKER_SMOKE.json), BENCH_RUNS / BENCH_COLD_RUNS.
 """
 
 import json
@@ -30,14 +33,29 @@ def main():
     suite.log(f"devices: {jax.devices()}")
     device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
-    wanted = os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+    wanted = os.environ.get(
+        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker"
+    ).split(",")
     runners = {
         "1": suite.config1_csv_filter,
         "2": suite.config2_groupby,
         "3": suite.config3_tpch_q1,
         "4": suite.config4_sort_topk,
         "5": suite.config5_mesh,
+        # the north-star metric is defined at SF-10 (BASELINE.json);
+        # SF-1 stays in the run for round-over-round comparability
+        "3sf10": lambda dk: suite.config3_tpch_q1(dk, sf=10),
+        # coordinator -> worker-on-the-chip smoke: the remote-compute-
+        # node seam (reference scripts/smoketest.sh:30-66) exercised on
+        # real hardware as part of every bench run
+        "worker": suite.config_worker_smoke,
     }
+    if float(os.environ.get("BENCH_SF", 1)) == 10 and "3" in [
+        w.strip() for w in wanted
+    ]:
+        # BENCH_SF=10 makes config "3" the SF-10 run already — don't
+        # run the most expensive config twice under one output key
+        wanted = [w for w in wanted if w.strip() != "3sf10"]
     configs = {}
     for key in wanted:
         key = key.strip()
@@ -52,7 +70,10 @@ def main():
                      f"selected none of {sorted(runners)}"
         }))
         sys.exit(2)
-    headline = configs.get("tpch_q1_parquet")
+    # headline = the north-star config: Q1 at SF-10, else SF-1
+    headline = configs.get("tpch_q1_parquet_sf10") or configs.get(
+        "tpch_q1_parquet"
+    )
     if headline is None:  # driver ran a subset; promote the first config
         headline = next(iter(configs.values()))
     print(json.dumps({

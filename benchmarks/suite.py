@@ -175,13 +175,14 @@ def config2_groupby(device_kind: str):
 
 
 # -- config 3: TPC-H Q1 over Parquet lineitem (the headline) --
-def config3_tpch_q1(device_kind: str):
+def config3_tpch_q1(device_kind: str, sf=None):
     from datafusion_tpu.exec.context import ExecutionContext
     from datafusion_tpu.exec.datasource import MemoryDataSource
     from datafusion_tpu.exec.materialize import collect
     from datafusion_tpu.utils.metrics import METRICS
 
-    sf = float(os.environ.get("BENCH_SF", 1))
+    if sf is None:
+        sf = float(os.environ.get("BENCH_SF", 1))
     sf = int(sf) if sf == int(sf) else sf
     log(f"  config 3: TPC-H Q1, Parquet lineitem SF-{sf}")
     path = bdata.lineitem_parquet(sf)
@@ -246,7 +247,7 @@ def config3_tpch_q1(device_kind: str):
         dev_warm_p50 = cpu_warm_p50
 
     return {
-        "name": "tpch_q1_parquet",
+        "name": "tpch_q1_parquet" if sf == 1 else f"tpch_q1_parquet_sf{sf}",
         "sf": sf,
         "rows": rows,
         "unit": "rows/s",
@@ -297,6 +298,20 @@ def _q1_device_utilization(device_kind: str, mem_src, rows: int) -> dict:
         floors.append(_t.perf_counter() - t0)
     sync_floor = float(np.median(floors))
 
+    # per-launch overhead: N trivial launches chained + one block, with
+    # the single-launch sync floor subtracted — through a tunneled
+    # transport this floor (~10-15 ms/launch), not HBM, usually bounds
+    # the observable device-only rate
+    n_triv = 20
+    t0 = _t.perf_counter()
+    y = tiny
+    for _ in range(n_triv):
+        y = trivial(y)
+    jax.block_until_ready(y)
+    launch_floor = max(
+        (_t.perf_counter() - t0 - sync_floor) / n_triv, 0.0
+    )
+
     n_passes = 5
     t0 = _t.perf_counter()
     states = [rel.accumulate() for _ in range(n_passes)]
@@ -319,13 +334,32 @@ def _q1_device_utilization(device_kind: str, mem_src, rows: int) -> dict:
         peaks["tpu"],
     )
     peak_gbps = float(os.environ.get("BENCH_HBM_PEAK_GBPS", peak_gbps))
+    # launch-corrected compute: the per-pass time minus the transport's
+    # per-launch overhead x launches/pass.  On a direct-attached chip
+    # launch_floor ~ 0 and the two HBM numbers coincide; through a
+    # tunnel the corrected number is the chip-side bound the transport
+    # lets us observe.
+    from datafusion_tpu.exec.kernels import fuse_batch_count
+
+    n_batches = -(-rows // (1 << 19))
+    launches_per_pass = max(1, -(-n_batches // fuse_batch_count()))
+    compute_per_pass = max(
+        device_time / n_passes - launches_per_pass * launch_floor, 1e-9
+    )
+    hbm_corrected = bytes_per_pass / compute_per_pass / 1e9
     return {
         "sync_floor_ms": round(sync_floor * 1e3, 1),
+        "launch_floor_ms": round(launch_floor * 1e3, 2),
+        "launches_per_pass": launches_per_pass,
         "device_rows_per_s": round(dev_rows_s, 1),
         "device_time_per_pass_ms": round(device_time / n_passes * 1e3, 2),
         "hbm_gbps_achieved": round(hbm_gbps, 1),
+        "hbm_gbps_launch_corrected": round(hbm_corrected, 1),
         "hbm_peak_gbps": peak_gbps,
         "hbm_util_pct": round(100 * hbm_gbps / peak_gbps, 2),
+        "hbm_util_pct_launch_corrected": round(
+            100 * hbm_corrected / peak_gbps, 2
+        ),
     }
 
 
@@ -401,6 +435,43 @@ def config4_sort_topk(device_kind: str):
             "vs_baseline": round(fcpu_p50 / fdev_p50, 3),
         },
     }
+
+
+# -- worker-on-the-chip smoke (part of the bench protocol) --
+def config_worker_smoke(device_kind: str):
+    """Coordinator -> TPU-worker parity smoke on the attached chip
+    (scripts/tpu_worker_smoke.py; VERDICT r4 asked for this leg in the
+    recorded bench run).  On CPU-only hosts it reports skipped."""
+    import json
+    import subprocess
+
+    out = {"name": "tpu_worker_smoke", "value": 0, "unit": "s",
+           "vs_baseline": 0.0}
+    if device_kind == "cpu":
+        out["skipped"] = "no accelerator attached"
+        return out
+    log("  worker smoke: coordinator -> worker-on-TPU fragment parity")
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # a hung/crashed smoke must degrade to an error entry, never abort
+    # the whole bench run (the other configs' results would be lost)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "scripts", "tpu_worker_smoke.py")],
+            cwd=repo, capture_output=True, text=True, timeout=1200,
+        )
+        sys.stderr.write(proc.stderr[-2000:])
+        if proc.returncode != 0:
+            out["error"] = (proc.stdout + proc.stderr)[-500:]
+            return out
+        result = json.loads(proc.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001 — TimeoutExpired, bad JSON, ...
+        out["error"] = f"{type(e).__name__}: {e}"[:500]
+        return out
+    out.update(result)
+    out["value"] = result.get("query_s", 0)
+    out["vs_baseline"] = 1.0  # parity leg: pass/fail, not a speed ratio
+    log(f"    pass: {result.get('rows')} rows, query {result.get('query_s')}s")
+    return out
 
 
 # -- config 5: partitioned aggregate over an 8-device mesh --

@@ -1,0 +1,36 @@
+"""Error types for datafusion-tpu.
+
+Mirrors the reference's error taxonomy (`src/execution/error.rs:26-35`:
+IoError / ParserError / General / InvalidColumn / NotImplemented /
+ExecutionError) as a Python exception hierarchy.
+"""
+
+from __future__ import annotations
+
+
+class DataFusionError(Exception):
+    """Base class for all engine errors (reference `error.rs:26`)."""
+
+
+class IoError(DataFusionError):
+    """I/O failure reading a data source."""
+
+
+class ParserError(DataFusionError):
+    """SQL tokenizer/parser failure (reference `error.rs:28`)."""
+
+
+class PlanError(DataFusionError):
+    """Query-planning failure (the reference folds these into General)."""
+
+
+class InvalidColumnError(DataFusionError):
+    """Reference to a column that does not exist (reference `error.rs:31`)."""
+
+
+class NotSupportedError(DataFusionError):
+    """Feature recognized but not supported (reference `error.rs:32`)."""
+
+
+class ExecutionError(DataFusionError):
+    """Runtime failure while executing a plan (reference `error.rs:34`)."""

@@ -1,0 +1,2 @@
+"""Execution engine (mirror of reference `src/execution/`, rebuilt on
+padded columnar tensors + jitted XLA pipelines)."""

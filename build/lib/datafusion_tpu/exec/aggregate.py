@@ -1,0 +1,1636 @@
+"""Grouped aggregation on device.
+
+The reference never implemented aggregation (`context.rs:161`
+`unimplemented!()`; even the Avg accumulator is missing from its enum,
+`expression.rs:99-105`).  TPU design:
+
+- **Filter fusion**: when the aggregate sits directly over a Selection
+  (the planner's shape, `sqlplanner.rs:90-117`), the predicate compiles
+  *into the aggregation kernel* — filter + 8-way aggregate is one XLA
+  computation per batch (TPC-H Q1's whole body).
+- **Group-key encoding (host)**: a persistent `GroupKeyEncoder` maps
+  each row's key tuple to a dense, append-only group id.  Fully
+  vectorized: per-batch uniques via a mixed-radix pack (or a row-bytes
+  view when the pack overflows), matched against the known key set
+  with `searchsorted` — no Python loop over uniques, so 10^5-10^6
+  groups per batch encode in numpy time.  Dense ids are stable across
+  batches, so device accumulators grow by zero padding.
+- **Slot deduplication**: aggregates lower to accumulator *slots*
+  shared across functions — SUM(x) and AVG(x) share one sum slot and
+  one count slot; COUNT(*) rides the per-group row count, and any
+  count whose ok-mask turns out to equal the row mask at trace time
+  aliases the row-count reduction instead of re-running it.  TPC-H
+  Q1's 8 aggregates touch 5 unique sum slots, not 8 sums + 8 counts.
+- **Accumulation (device, jitted)**: one fused kernel evaluates every
+  slot argument and updates fixed-capacity accumulators.  Small group
+  counts (<= DENSE_GROUP_MAX) use a one-hot [rows, G] masked
+  broadcast-reduce (spelled as a fused reduction, not a literal f64
+  dot — TPU emulates f64 dots catastrophically slowly).
+  Larger group counts use **sort-merge aggregation**: XLA scatter is
+  serial on TPU, so the state and batch are sorted together by group
+  id (`lax.sort` is fast), runs of equal ids reduce with segmented
+  associative scans, and a second sort compacts totals back to the
+  dense layout.  Masked-out or null rows contribute identity
+  elements — the kernel never syncs a mask to the host.
+- **Finalization**: AVG = SUM/COUNT; grouped keys observed only in
+  filtered-out rows (count 0) are dropped.
+- **Distributed**: the accumulators are exactly the per-shard partial
+  state; partitioned mode combines them with psum/pmin/pmax over the
+  mesh (parallel/partition.py) — the partial->final aggregate the
+  reference's worker mode planned (`README.md:33-35`).
+
+Accumulator dtypes: integer SUM accumulates in 64-bit (overflow
+safety); COUNT is Int64 internally, UInt64 in the output (planner
+contract); MIN/MAX keep the argument dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.errors import ExecutionError, NotSupportedError
+from datafusion_tpu.exec.batch import (
+    RecordBatch,
+    StringDictionary,
+    bucket_capacity,
+    device_pull,
+    make_host_batch,
+)
+from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
+from datafusion_tpu.exec.relation import Relation
+from datafusion_tpu.plan.expr import AggregateFunction, Column, Expr
+from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
+
+
+DENSE_GROUP_MAX = 64
+
+# widen narrow wire-format group ids back to int32 on device
+_WIDEN_IDS_JIT = jax.jit(lambda w: w.astype(jnp.int32))
+
+
+def group_capacity(n: int) -> int:
+    """Accumulator capacity: next power of two, floor 8.  Kept tight
+    (unlike row-batch bucketing) because capacities <= DENSE_GROUP_MAX
+    take the dense one-hot kernel path — a fused masked reduction
+    instead of XLA scatter, which executes serially on both CPU and
+    TPU."""
+    cap = 8
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _row_bytes_view(a: np.ndarray) -> np.ndarray:
+    """(N, K) int64 -> (N,) opaque-bytes view with a consistent total
+    order (memcmp), used for cross-batch key identity."""
+    a = np.ascontiguousarray(a)
+    return a.view([("", a.dtype)] * a.shape[1]).ravel()
+
+
+class GroupKeyEncoder:
+    """Host-side dense encoder of group-key tuples -> stable group ids.
+
+    Vectorized: the known key set lives in a sorted row-view array
+    matched with `searchsorted`; no per-key Python dict operations, so
+    encoding stays numpy-speed at 10^6 groups.
+    """
+
+    # radix-LUT fast path bound: product of per-component radices must
+    # keep the id lookup table at most this many entries (16 MB int32)
+    _LUT_MAX = 1 << 22
+
+    def __init__(self, num_keys: int):
+        self.num_keys = num_keys
+        k = max(2 * num_keys, 1)
+        self._arr = np.empty((0, k), dtype=np.int64)  # key rows by group id
+        self._sorted_rows = _row_bytes_view(self._arr)  # sorted row view
+        self._sorted_ids = np.empty(0, dtype=np.int64)
+        # radix-LUT fast path (small non-negative key spaces: dictionary
+        # codes, low-cardinality ints): encode = one gather instead of a
+        # per-batch sort.  Disabled permanently on the first batch whose
+        # key space can't be packed small (negatives / wide ranges).
+        self._fast = True
+        self._radix: Optional[list[int]] = None
+        self._lut: Optional[np.ndarray] = None
+
+    @property
+    def num_groups(self) -> int:
+        return len(self._arr)
+
+    @staticmethod
+    def _to_int_image(c: np.ndarray) -> np.ndarray:
+        """Lossless integer image of a key column.  Floats are *bit-cast*
+        (a value cast would merge 1.5 and 1.7); -0.0 normalizes to 0.0
+        and NaNs to one canonical NaN so SQL equality groups them.
+        Integer columns keep their native width (packing upcasts)."""
+        if c.dtype.kind == "f":
+            c = c.astype(np.float64)
+            c = np.where(c == 0.0, 0.0, c)  # -0.0 == 0.0
+            c = np.where(np.isnan(c), np.float64(np.nan), c)
+            return c.view(np.int64)
+        if c.dtype.kind == "b":
+            return c.astype(np.int8)
+        return c
+
+    def encode(
+        self,
+        key_cols: list[np.ndarray],
+        key_valids: list,
+    ) -> np.ndarray:
+        """key_cols: per-key numpy arrays (dict codes for strings);
+        key_valids: per-key bool validity arrays or None.  Returns int32
+        group ids per row.  NULL keys form their own group (SQL
+        semantics): each key contributes (value-with-nulls-zeroed,
+        isnull flag) to the group tuple.
+        """
+        if key_cols and len(key_cols[0]) == 0:
+            return np.empty(0, dtype=np.int32)  # _pack can't reduce empty
+        # components: (value, isnull) per key.  None stands for an
+        # all-zero component (no nulls) — the fast path skips it and the
+        # general path materializes zeros.  Values keep their native
+        # integer width here; packing/stacking upcasts as needed.
+        comps: list[Optional[np.ndarray]] = []
+        n = len(key_cols[0]) if key_cols else 0
+        for c, v in zip(key_cols, key_valids):
+            c = self._to_int_image(np.asarray(c))
+            if v is None:
+                comps.append(c)
+                comps.append(None)
+            else:
+                v = np.asarray(v)
+                comps.append(np.where(v, c, 0))
+                comps.append(~v)
+        if self._fast:
+            ids = self._encode_fast(comps, n)
+            if ids is not None:
+                return ids
+            # the key space just outgrew the LUT: fall through to the
+            # general path for this and every later batch (ids assigned
+            # so far stay valid — _arr is shared between both paths)
+            self._rebuild_sorted()
+        rows = [
+            np.zeros(n, dtype=np.int64) if c is None else c.astype(np.int64)
+            for c in comps
+        ]
+        stacked = np.stack(rows, axis=1)  # (n, 2K)
+        # Fast path: pack the key tuple into one int64 (mixed radix), so
+        # per-batch uniquing is a single 1-D sort; the pack is per-batch
+        # only — cross-batch identity goes through the row-bytes view.
+        packed = self._pack(stacked)
+        if packed is not None:
+            _, first, inv = np.unique(packed, return_index=True, return_inverse=True)
+        else:
+            _, first, inv = np.unique(
+                _row_bytes_view(stacked), return_index=True, return_inverse=True
+            )
+        urows = stacked[first]  # (U, 2K), per-batch unique keys
+        uview = _row_bytes_view(urows)
+        order = np.argsort(uview)  # row-bytes order for searchsorted
+        sview = uview[order]
+        pos = np.searchsorted(self._sorted_rows, sview)
+        found = np.zeros(len(sview), dtype=bool)
+        in_range = pos < len(self._sorted_rows)
+        found[in_range] = self._sorted_rows[pos[in_range]] == sview[in_range]
+
+        lut_sorted = np.empty(len(sview), dtype=np.int64)
+        lut_sorted[found] = self._sorted_ids[pos[found]]
+        n_new = int((~found).sum())
+        if n_new:
+            new_ids = np.arange(
+                self.num_groups, self.num_groups + n_new, dtype=np.int64
+            )
+            lut_sorted[~found] = new_ids
+            self._arr = np.concatenate([self._arr, urows[order][~found]])
+            ins = pos[~found]  # insertion points into the old sorted view
+            self._sorted_rows = np.insert(self._sorted_rows, ins, sview[~found])
+            self._sorted_ids = np.insert(self._sorted_ids, ins, new_ids)
+
+        lut = np.empty(len(uview), dtype=np.int64)
+        lut[order] = lut_sorted
+        return lut[inv].astype(np.int32)
+
+    @staticmethod
+    def _pack(stacked: np.ndarray) -> Optional[np.ndarray]:
+        """Mixed-radix pack of (n, 2K) int64 key parts into (n,) int64;
+        None when the combined range could overflow 63 bits."""
+        mins = stacked.min(axis=0).tolist()
+        maxs = stacked.max(axis=0).tolist()
+        # ranges in Python ints: a single int64 column can span > 2^63,
+        # which would wrap (and slip past the bail-out) in int64 math
+        ranges = [int(mx) - int(mn) + 1 for mn, mx in zip(mins, maxs)]
+        total = 1
+        for r in ranges:
+            total *= r
+            if total > (1 << 62):
+                return None
+        # total <= 2^62 implies every range (and every shifted value)
+        # fits comfortably in int64
+        packed = np.zeros(stacked.shape[0], dtype=np.int64)
+        for k in range(stacked.shape[1]):
+            packed = packed * np.int64(ranges[k]) + (stacked[:, k] - np.int64(mins[k]))
+        return packed
+
+    def _encode_fast(self, comps, n: int) -> Optional[np.ndarray]:
+        """Radix-LUT encode: pack each key tuple into a small int64 with
+        FIXED per-component radices (stable across batches, unlike
+        `_pack`'s per-batch ranges) and look ids up in a dense table —
+        one gather per batch instead of a sort.  Returns None —
+        permanently disabling the path — when the key space has
+        negatives or would need a LUT past _LUT_MAX."""
+        maxs = []
+        for c in comps:
+            if c is None:
+                maxs.append(0)
+                continue
+            if c.dtype.kind == "b":
+                maxs.append(1)
+                continue
+            lo, hi = int(c.min()), int(c.max())
+            if lo < 0:
+                self._fast = False
+                return None
+            maxs.append(hi)
+        if self._radix is None or any(
+            mx >= r for mx, r in zip(maxs, self._radix)
+        ):
+            # (re)choose radices: next power of two above the observed
+            # max, doubled for growth headroom (string dictionaries keep
+            # appending codes); rebuild the LUT from the known groups
+            radix = []
+            for k, mx in enumerate(maxs):
+                seen = mx
+                if len(self._arr):
+                    seen = max(seen, int(self._arr[:, k].max()))
+                if seen == 0:
+                    radix.append(1)
+                    continue
+                r = 1
+                while r <= seen:
+                    r <<= 1
+                radix.append(r * 2)
+            total = 1
+            for r in radix:
+                total *= r
+                if total > self._LUT_MAX:
+                    self._fast = False
+                    return None
+            self._radix = radix
+            self._lut = np.full(total, -1, dtype=np.int32)
+            if len(self._arr):
+                self._lut[self._pack_rows(self._arr)] = np.arange(
+                    len(self._arr), dtype=np.int32
+                )
+        packed = self._pack_comps(comps, n)
+        ids = self._lut[packed]
+        if (ids < 0).any():
+            new_packed = np.unique(packed[ids < 0])
+            self._lut[new_packed] = np.arange(
+                self.num_groups, self.num_groups + len(new_packed), dtype=np.int32
+            )
+            self._arr = np.concatenate([self._arr, self._unpack_fixed(new_packed)])
+            ids = self._lut[packed]
+        return ids.astype(np.int32, copy=False)
+
+    def _pack_comps(self, comps, n: int) -> np.ndarray:
+        """Horner pack of per-component arrays (None = zeros) with the
+        fixed radices; int64 throughout (ranges proven < _LUT_MAX)."""
+        packed = np.zeros(n, dtype=np.int64)
+        for c, r in zip(comps, self._radix):
+            if r == 1:
+                continue  # radix 1 => component is globally all-zero
+            packed *= np.int64(r)
+            if c is not None:
+                if c.dtype != np.int64:
+                    c = c.astype(np.int64)
+                packed += c
+        return packed
+
+    def _pack_rows(self, rows2d: np.ndarray) -> np.ndarray:
+        packed = np.zeros(rows2d.shape[0], dtype=np.int64)
+        for k, r in enumerate(self._radix):
+            packed = packed * np.int64(r) + rows2d[:, k]
+        return packed
+
+    def _unpack_fixed(self, packed: np.ndarray) -> np.ndarray:
+        out = np.empty((len(packed), len(self._radix)), dtype=np.int64)
+        rest = packed.copy()
+        for k in range(len(self._radix) - 1, -1, -1):
+            out[:, k] = rest % self._radix[k]
+            rest //= self._radix[k]
+        return out
+
+    def _rebuild_sorted(self):
+        """Reconstruct the general path's sorted row view from `_arr`
+        after the fast path retires (its inserts never ran)."""
+        view = _row_bytes_view(self._arr)
+        order = np.argsort(view, kind="stable")
+        self._sorted_rows = view[order]
+        self._sorted_ids = order.astype(np.int64)
+
+    def key_column(self, k: int):
+        """(values, validity) of key position k across all groups, in
+        group-id order; validity None when no group has a NULL key."""
+        vals = self._arr[:, 2 * k].copy()
+        isnull = self._arr[:, 2 * k + 1] != 0
+        return vals, (None if not isnull.any() else ~isnull)
+
+
+class _Slot:
+    """One deduplicated accumulator column.
+
+    kind: "sum" (also serves AVG), "cnt" (non-null count of one arg),
+    "min"/"max", "smin"/"smax" (Utf8 via dictionary ranks).
+    """
+
+    __slots__ = ("kind", "arg", "fn", "acc_dtype", "arg_index")
+
+    def __init__(self, kind, arg, fn, acc_dtype, arg_index=None):
+        self.kind = kind
+        self.arg = arg
+        self.fn = fn
+        self.acc_dtype = acc_dtype
+        self.arg_index = arg_index  # column index for string slots
+
+    @property
+    def is_string(self) -> bool:
+        return self.kind in ("smin", "smax")
+
+
+class AggregateSpec:
+    """One aggregate function, resolved to its accumulator slots."""
+
+    def __init__(self, expr: AggregateFunction, input_schema: Schema):
+        self.name = expr.name.lower()
+        if self.name not in ("sum", "count", "min", "max", "avg"):
+            raise NotSupportedError(f"unknown aggregate {expr.name!r}")
+        if len(expr.args) != 1:
+            raise ExecutionError(f"{expr.name} takes one argument")
+        self.arg = expr.args[0]
+        self.return_type = expr.return_type
+        self.count_star = self.name == "count" and expr.count_star
+        self.arg_type = self.arg.get_type(input_schema)
+        # MIN/MAX over Utf8: the accumulator is the best dictionary
+        # *code* per group; comparison rides per-version rank tables
+        # (codes are append-ordered, ranks are lexicographic)
+        self.is_string = self.arg_type == DataType.UTF8 and self.name in ("min", "max")
+        if self.is_string and not isinstance(self.arg, Column):
+            raise NotSupportedError(
+                f"{expr.name} over a computed Utf8 expression is not supported"
+            )
+        if self.name in ("sum", "avg") and self.arg_type == DataType.UTF8:
+            raise NotSupportedError(f"{expr.name} over Utf8 is not supported")
+        # slot references, filled by AggregateRelation._build_slots
+        self.sum_slot: Optional[int] = None
+        self.cnt_slot: Optional[int] = None  # None => per-group row count
+        self.minmax_slot: Optional[int] = None
+
+    @property
+    def sum_dtype(self) -> np.dtype:
+        npd = self.arg_type.np_dtype
+        if self.arg_type.is_signed_integer:
+            return np.dtype(np.int64)
+        if self.arg_type.is_unsigned_integer:
+            return np.dtype(np.uint64)
+        return npd
+
+
+def _min_identity(dtype: np.dtype):
+    if dtype.kind == "f":
+        return np.asarray(np.inf, dtype)
+    if dtype.kind in "iu":
+        return np.asarray(np.iinfo(dtype).max, dtype)
+    if dtype.kind == "b":
+        return np.asarray(True, dtype)
+    raise ExecutionError(f"MIN unsupported for {dtype}")
+
+
+def _max_identity(dtype: np.dtype):
+    if dtype.kind == "f":
+        return np.asarray(-np.inf, dtype)
+    if dtype.kind in "iu":
+        return np.asarray(np.iinfo(dtype).min, dtype)
+    if dtype.kind == "b":
+        return np.asarray(False, dtype)
+    raise ExecutionError(f"MAX unsupported for {dtype}")
+
+
+class _AggregateCore:
+    """The compiled, shareable part of an aggregation: specs, slots
+    (with their compiled argument closures), the predicate closure, and
+    the jitted kernel.  Cached process-wide by plan fingerprint
+    (SURVEY §7 recompilation control): a fresh operator tree for a
+    semantically identical GROUP BY reuses the already-built jit and
+    every executable in its cache."""
+
+    def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions,
+                 param_slots=None):
+        for g in group_expr:
+            if not isinstance(g, Column):
+                raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
+            if in_schema.field(g.index).data_type.np_dtype.kind == "O":
+                raise NotSupportedError("struct columns cannot be GROUP BY keys")
+        self.key_cols = [g.index for g in group_expr]
+        self.specs = []
+        for a in aggr_expr:
+            if not isinstance(a, AggregateFunction):
+                raise ExecutionError(f"non-aggregate expression {a!r} in aggr_expr")
+            self.specs.append(AggregateSpec(a, in_schema))
+
+        compiler = ExprCompiler(in_schema, functions, param_slots)
+        self._pred_fn = compiler.compile(predicate) if predicate is not None else None
+        self.slots = self._build_slots(compiler)
+        self.aux_specs = compiler.aux_specs
+        # ship only the columns the kernel reads (group keys travel as
+        # dense ids; a host-routed predicate never reaches this ctor,
+        # so its inputs don't appear here and never cross H2D); Env's
+        # col_map translates schema indices to subset positions
+        used: set[int] = set()
+        if predicate is not None:
+            predicate.collect_columns(used)
+        for a in aggr_expr:
+            a.collect_columns(used)
+        self.used_cols = sorted(used)
+        self.col_map = {c: i for i, c in enumerate(self.used_cols)}
+        self.sub_schema = in_schema.select(self.used_cols)
+        # per-column codec memory for put_compressed (persists across
+        # cold re-runs of the same query shape — see batch.py)
+        self.wire_hints: dict = {}
+        self.jit = jax.jit(self._kernel)
+        self.fused_jit = jax.jit(self._fused_kernel)
+
+    def _fused_kernel(self, chunk, state, params):
+        """Fold `_kernel` over a chunk of prepared batches in ONE device
+        launch.  Tunneled/remote devices charge a round trip per
+        executable launch (often 15-500 ms here), so a warm in-memory
+        scan collapses from one launch per batch to one per chunk."""
+        for cols, valids, aux, num_rows, mask, ids, str_aux in chunk:
+            state = self._kernel(
+                cols, valids, aux, num_rows, mask, ids, state, str_aux, params
+            )
+        return state
+
+    @staticmethod
+    def param_exprs(predicate, aggr_expr):
+        """Exprs compiled into the device kernel, in slot order."""
+        return ([] if predicate is None else [predicate]) + list(aggr_expr)
+
+    @staticmethod
+    def build(in_schema, group_expr, aggr_expr, predicate, functions):
+        from datafusion_tpu.exec.kernels import (
+            cached_kernel,
+            functions_fingerprint,
+            parameterize_exprs,
+            schema_fingerprint,
+        )
+
+        elig = _AggregateCore.param_exprs(predicate, aggr_expr)
+        fps, slot_by_id, _ = parameterize_exprs(elig)
+        n_pred = 0 if predicate is None else 1
+        key = (
+            "aggregate",
+            schema_fingerprint(in_schema),
+            tuple(group_expr),
+            fps[n_pred:],
+            fps[0] if n_pred else None,
+            functions_fingerprint(functions),
+        )
+        return cached_kernel(
+            key,
+            lambda: _AggregateCore(
+                in_schema, group_expr, aggr_expr, predicate, functions,
+                slot_by_id,
+            ),
+        )
+
+    def _build_slots(self, compiler: ExprCompiler) -> list[_Slot]:
+        """Deduplicate aggregates into accumulator slots.  SUM(x) and
+        AVG(x) share one sum slot; their validity counts (and any
+        COUNT(x)) share one cnt slot per distinct argument; COUNT(*)
+        rides the per-group row count (slot None).  A cnt slot whose
+        argument carries no validity further aliases the row-count
+        reduction at trace time (see _dense_update/_sortmerge_update)."""
+        slots: list[_Slot] = []
+        index: dict[tuple, int] = {}
+
+        def get(kind, arg, acc_dtype, arg_index=None):
+            key = (kind, arg)
+            hit = index.get(key)
+            if hit is not None:
+                return hit
+            index[key] = len(slots)
+            slots.append(_Slot(kind, arg, compiler.compile(arg), acc_dtype, arg_index))
+            return index[key]
+
+        for s in self.specs:
+            if s.is_string:
+                kind = "smin" if s.name == "min" else "smax"
+                s.minmax_slot = get(kind, s.arg, np.dtype(np.int32), s.arg.index)
+            elif s.name in ("sum", "avg"):
+                s.sum_slot = get("sum", s.arg, s.sum_dtype)
+                s.cnt_slot = get("cnt", s.arg, np.dtype(np.int64))
+            elif s.name == "count":
+                # COUNT(*) counts rows; COUNT(x) counts non-null x
+                s.cnt_slot = None if s.count_star else get(
+                    "cnt", s.arg, np.dtype(np.int64)
+                )
+            else:
+                s.minmax_slot = get(
+                    s.name, s.arg, np.dtype(s.arg_type.np_dtype)
+                )
+        return slots
+
+    # -- accumulator state: (counts, tuple(per-slot accumulators)) --
+    def _slot_identity(self, sl: _Slot):
+        if sl.kind == "smin" or sl.kind == "smax":
+            return np.asarray(-1, np.int32)
+        if sl.kind in ("sum", "cnt"):
+            return np.asarray(0, sl.acc_dtype)
+        if sl.kind == "min":
+            return _min_identity(sl.acc_dtype)
+        return _max_identity(sl.acc_dtype)
+
+    def _init_state(self, capacity: int):
+        # cached per capacity: creating the state costs one tiny device
+        # launch per slot, which a repeated query would otherwise pay
+        # every run (round trips dominate on tunneled links); states are
+        # functionally consumed, never mutated, so sharing is safe
+        cache = getattr(self, "_init_states", None)
+        if cache is None:
+            cache = self._init_states = {}
+        hit = cache.get(capacity)
+        if hit is None:
+            accs = tuple(
+                jnp.full(capacity, jnp.asarray(self._slot_identity(sl)))
+                for sl in self.slots
+            )
+            hit = cache[capacity] = (jnp.zeros(capacity, jnp.int64), accs)
+        return hit
+
+    def _grow_state(self, state, new_capacity: int):
+        """Dense group ids are stable: growth is identity padding."""
+        counts, accs = state
+        pad = new_capacity - counts.shape[0]
+
+        def grow(a, fill):
+            return jnp.concatenate([a, jnp.full(pad, jnp.asarray(fill, a.dtype))])
+
+        new_accs = tuple(
+            grow(acc, self._slot_identity(sl)) for sl, acc in zip(self.slots, accs)
+        )
+        return grow(counts, 0), new_accs
+
+    def _kernel(self, cols, valids, aux, num_rows, base_mask, ids, state,
+                str_aux=(), params=()):
+        env = Env(cols, valids, aux, self.col_map, params)
+        capacity = cols[0].shape[0] if cols else ids.shape[0]
+        mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if base_mask is not None:
+            mask = mask & base_mask
+        if self._pred_fn is not None:
+            pv, pvalid = self._pred_fn(env)
+            pv = jnp.broadcast_to(pv, (capacity,))
+            if pvalid is not None:
+                pv = pv & jnp.broadcast_to(pvalid, (capacity,))
+            mask = mask & pv
+
+        counts, accs = state
+        group_cap = counts.shape[0]
+        if group_cap <= DENSE_GROUP_MAX:
+            return self._dense_update(env, capacity, mask, ids, counts, accs, str_aux)
+        return self._sortmerge_update(env, capacity, mask, ids, counts, accs, str_aux)
+
+    def _slot_inputs(self, env, capacity, mask):
+        """(value, ok-mask) per slot, masking padding/filtered/null
+        rows.  `ok is mask` when the argument has no validity — update
+        paths use that identity to alias the row-count reduction."""
+        out = []
+        for sl in self.slots:
+            v, valid = sl.fn(env)
+            v = jnp.broadcast_to(v, (capacity,))
+            if valid is None:
+                ok = mask
+            else:
+                ok = mask & jnp.broadcast_to(valid, (capacity,))
+            out.append((v, ok))
+        return out
+
+    # -- string MIN/MAX rank arithmetic (codes are stable across
+    # batches; ranks are valid only within one dictionary version) --
+    @staticmethod
+    def _rank_sentinel(kind):
+        """Identity element in rank space: +inf-like for smin (any real
+        rank beats it under minimum), -1 for smax."""
+        return jnp.int32(2**31 - 1) if kind == "smin" else jnp.int32(-1)
+
+    @classmethod
+    def _codes_to_ranks(cls, kind, codes, str_aux_k):
+        """Best-code accumulator -> rank space (-1 = empty -> sentinel)."""
+        ranks, _ = str_aux_k
+        cap = ranks.shape[0]
+        return jnp.where(
+            codes >= 0,
+            ranks[jnp.clip(codes, 0, cap - 1)],
+            cls._rank_sentinel(kind),
+        )
+
+    @classmethod
+    def _ranks_to_codes(cls, kind, best, str_aux_k):
+        """Winning rank -> stable code (-1 when the group is empty)."""
+        _, order = str_aux_k
+        cap = order.shape[0]
+        alive = best != cls._rank_sentinel(kind)
+        return jnp.where(alive, order[jnp.clip(best, 0, cap - 1)], -1).astype(
+            jnp.int32
+        )
+
+    @classmethod
+    def _string_combine(cls, kind, acc, batch_best_rank, str_aux_k):
+        """Merge a per-group best-rank candidate into a best-code
+        accumulator."""
+        old_rank = cls._codes_to_ranks(kind, acc, str_aux_k)
+        if kind == "smin":
+            best = jnp.minimum(batch_best_rank, old_rank)
+        else:
+            best = jnp.maximum(batch_best_rank, old_rank)
+        return cls._ranks_to_codes(kind, best, str_aux_k)
+
+    @staticmethod
+    def _seg_scan(vals, start, combine):
+        """Segmented inclusive scan: `start` marks segment heads; the
+        value at each segment's last row is the segment reduction."""
+
+        def op(a, b):
+            av, af = a
+            bv, bf = b
+            flag = bf if bv.ndim == bf.ndim else bf[..., None]
+            return jnp.where(flag, bv, combine(av, bv)), af | bf
+
+        out, _ = jax.lax.associative_scan(op, (vals, start))
+        return out
+
+    def _sortmerge_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
+        """High-cardinality path (group capacity > DENSE_GROUP_MAX):
+        sort-merge aggregation, the scatter-free XLA shape.
+
+        XLA scatter executes serially on TPU (~50ms per 512k updates),
+        so instead: concatenate the dense state (implicit keys 0..G-1)
+        with the batch rows, `lax.sort` by group id (sorts are fast,
+        ~2.5ms at 1M rows), reduce runs of equal ids with segmented
+        associative scans, and compact segment totals back to the dense
+        layout with a second sort.  Every key in [0, G) appears at
+        least once (the state contributes all of them), so the first G
+        entries of the compaction sort are exactly groups 0..G-1.
+        """
+        G = counts.shape[0]
+        SENT = jnp.int64(jnp.iinfo(jnp.int64).max)
+        inputs = self._slot_inputs(env, capacity, mask)
+
+        state_keys = jnp.arange(G, dtype=jnp.int64)
+        batch_keys = jnp.where(mask, ids.astype(jnp.int64), SENT)
+        keys = jnp.concatenate([state_keys, batch_keys])
+
+        # payload columns: row count first, then one per non-aliased slot
+        payloads = [jnp.concatenate([counts, mask.astype(jnp.int64)])]
+        payload_of: dict[int, int] = {}
+        for i, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+            if sl.kind == "cnt" and ok is mask:
+                continue  # aliases the row count payload
+            if sl.is_string:
+                # merge by lexicographic rank under the current dict
+                # version; state codes convert to ranks on entry
+                ranks, _ = str_aux[i]
+                cap = ranks.shape[0]
+                acc_rank = self._codes_to_ranks(sl.kind, acc, str_aux[i])
+                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
+                contrib = jnp.where(ok, r, self._rank_sentinel(sl.kind))
+            elif sl.kind == "sum":
+                acc_rank = acc
+                contrib = jnp.where(ok, v, 0).astype(acc.dtype)
+            elif sl.kind == "cnt":
+                acc_rank = acc
+                contrib = ok.astype(jnp.int64)
+            else:
+                ident = (
+                    _min_identity(sl.acc_dtype)
+                    if sl.kind == "min"
+                    else _max_identity(sl.acc_dtype)
+                )
+                acc_rank = acc
+                contrib = jnp.where(ok, v.astype(acc.dtype), ident)
+            payload_of[i] = len(payloads)
+            payloads.append(jnp.concatenate([acc_rank, contrib]))
+
+        sorted_ops = jax.lax.sort([keys] + payloads, num_keys=1)
+        skeys = sorted_ops[0]
+        svals = list(sorted_ops[1:])
+
+        start = jnp.concatenate(
+            [jnp.ones(1, bool), skeys[1:] != skeys[:-1]]
+        )
+        reduced = [None] * len(payloads)
+        reduced[0] = self._seg_scan(svals[0], start, jnp.add)
+        for i, sl in enumerate(self.slots):
+            p = payload_of.get(i)
+            if p is None:
+                continue
+            if sl.kind in ("sum", "cnt"):
+                reduced[p] = self._seg_scan(svals[p], start, jnp.add)
+            elif sl.kind == "min" or sl.kind == "smin":
+                reduced[p] = self._seg_scan(svals[p], start, jnp.minimum)
+            else:
+                reduced[p] = self._seg_scan(svals[p], start, jnp.maximum)
+
+        last = jnp.concatenate([skeys[1:] != skeys[:-1], jnp.ones(1, bool)])
+        dead = (~last) | (skeys == SENT)
+        ckeys = jnp.where(dead, SENT, skeys)
+        comp = jax.lax.sort(
+            [ckeys] + [jnp.where(last, r, jnp.zeros((), r.dtype)) for r in reduced],
+            num_keys=1,
+        )
+        new_counts = comp[1][:G]
+        out = list(comp[2:])
+
+        new_accs = []
+        for i, (sl, acc) in enumerate(zip(self.slots, accs)):
+            p = payload_of.get(i)
+            if p is None:  # cnt aliased to the row count
+                new_accs.append(acc + (new_counts - counts))
+                continue
+            val = out[p - 1][:G]
+            if sl.is_string:
+                new_accs.append(self._ranks_to_codes(sl.kind, val, str_aux[i]))
+            else:
+                new_accs.append(val)
+        return new_counts, tuple(new_accs)
+
+    def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
+        """Small-group path: segment reduction against a one-hot
+        [rows, G] membership matrix.  Float sums and all counts stack
+        into one [rows, S] block and reduce through a single masked
+        broadcast-reduce (the fused-reduction spelling below — NOT a
+        literal f64 dot, which TPU emulates catastrophically); int sums
+        and min/max are fused broadcast-reduces over [rows, G].  Count
+        columns whose ok-mask IS the row mask alias the row-count
+        reduction row instead of duplicating it.  No scatter anywhere."""
+        G = counts.shape[0]
+        onehot_b = ids[:, None] == jnp.arange(G, dtype=ids.dtype)[None, :]
+        inputs = self._slot_inputs(env, capacity, mask)
+
+        # -- one fused reduction for every f-dtype sum slot + count column --
+        mat_cols = [mask.astype(jnp.float64)]  # row 0: row count
+        mat_row_of: dict[int, int] = {}  # slot index -> stacked-reduce row
+        for i, (sl, (v, ok)) in enumerate(zip(self.slots, inputs)):
+            if sl.kind == "sum" and sl.acc_dtype.kind == "f":
+                mat_row_of[i] = len(mat_cols)
+                mat_cols.append(jnp.where(ok, v, 0.0).astype(jnp.float64))
+            elif sl.kind == "cnt":
+                if ok is mask:
+                    mat_row_of[i] = 0  # alias the row-count column
+                else:
+                    mat_row_of[i] = len(mat_cols)
+                    mat_cols.append(ok.astype(jnp.float64))
+        stacked = jnp.stack(mat_cols, axis=1)  # [rows, S]
+        # [S, G] segment sums via a masked broadcast-reduce.  This IS
+        # the one-hot contraction, but spelled so XLA fuses it as a
+        # reduction: the literal f64 dot_general lowers on TPU to a
+        # multi-pass bf16-split emulation through while-loops over
+        # [rows, G]-sized scratch (~150 ms per fused launch on v5e for
+        # the TPC-H Q1 shape vs ~1 ms for this form; HLO at
+        # jit(_kernel)/dot_general pins it)
+        sums = jnp.sum(
+            jnp.where(onehot_b[:, None, :], stacked[:, :, None], 0.0),
+            axis=0,
+        )  # [S, G]
+
+        new_counts = counts + sums[0].astype(jnp.int64)
+        new_accs = []
+        for i, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+            if sl.is_string:
+                ranks, _ = str_aux[i]
+                cap = ranks.shape[0]
+                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
+                sentinel = self._rank_sentinel(sl.kind)
+                cell = jnp.where(onehot_b & ok[:, None], r[:, None], sentinel)
+                batch_best = (
+                    jnp.min(cell, axis=0)
+                    if sl.kind == "smin"
+                    else jnp.max(cell, axis=0)
+                )
+                new_accs.append(self._string_combine(sl.kind, acc, batch_best, str_aux[i]))
+            elif sl.kind == "sum":
+                if i in mat_row_of:
+                    contrib = sums[mat_row_of[i]].astype(acc.dtype)
+                else:
+                    # integer sums: exact int64 broadcast-reduce (an
+                    # f64 reduction would round above 2^53)
+                    contrib = jnp.sum(
+                        jnp.where(
+                            onehot_b & ok[:, None], v[:, None].astype(acc.dtype), 0
+                        ),
+                        axis=0,
+                    )
+                new_accs.append(acc + contrib)
+            elif sl.kind == "cnt":
+                new_accs.append(acc + sums[mat_row_of[i]].astype(jnp.int64))
+            else:
+                ident = (
+                    _min_identity(np.dtype(acc.dtype))
+                    if sl.kind == "min"
+                    else _max_identity(np.dtype(acc.dtype))
+                )
+                cell = jnp.where(
+                    onehot_b & ok[:, None], v[:, None].astype(acc.dtype), ident
+                )
+                red = jnp.min(cell, axis=0) if sl.kind == "min" else jnp.max(cell, axis=0)
+                new_accs.append(
+                    jnp.minimum(acc, red) if sl.kind == "min" else jnp.maximum(acc, red)
+                )
+        return new_counts, tuple(new_accs)
+
+
+# host throughput assumed by the placement cost model: one grouped
+# pass (numpy eval + bincount) over a column on one core.  Measured
+# ~100-150 M rows/s here; the constant only needs order-of-magnitude
+# accuracy — link rates differ from it by 50x in either direction.
+_HOST_AGG_SECONDS_PER_ROW = 8e-9
+
+
+class _Placement:
+    """Outcome of the link-aware slot split: which SELECT-list specs
+    compute on host, and the (smaller) device core for the rest."""
+
+    __slots__ = ("host_idx", "core", "params")
+
+    def __init__(self, host_idx, core, params):
+        self.host_idx = host_idx  # frozenset of spec positions
+        self.core = core          # _AggregateCore or None (full host)
+        self.params = params
+
+
+class _HostPartials:
+    """Grouped partial aggregation on the host for link-expensive
+    slots: per-batch numpy eval of the slot argument + np.bincount per
+    group.  Arithmetic is plain IEEE f64 — the same number class as
+    the engine's CPU path.  Only float SUM/AVG and COUNT route here
+    (integer sums keep exact int64 accumulation on device; bincount
+    weights are f64)."""
+
+    __slots__ = ("rel", "sum_exprs", "cnt_exprs", "sums", "cnts", "rowcounts")
+
+    def __init__(self, rel, host_idx):
+        self.rel = rel
+        self.sum_exprs: dict[str, Expr] = {}
+        self.cnt_exprs: dict[str, Expr] = {}
+        for j in host_idx:
+            s = rel.specs[j]
+            k = repr(s.arg)
+            if s.name in ("sum", "avg"):
+                self.sum_exprs[k] = s.arg
+                self.cnt_exprs[k] = s.arg
+            elif s.name == "count" and not s.count_star:
+                self.cnt_exprs[k] = s.arg
+        self.sums: dict[str, np.ndarray] = {}
+        self.cnts: dict[str, np.ndarray] = {}
+        self.rowcounts: Optional[np.ndarray] = None
+
+    @staticmethod
+    def _grown(arr, n, dtype):
+        if arr is None:
+            return np.zeros(n, dtype)
+        if len(arr) < n:
+            return np.pad(arr, (0, n - len(arr)))
+        return arr
+
+    def update(self, batch, ids_np, live, track_rowcounts):
+        from datafusion_tpu.exec.hostfn import eval_host_expr
+
+        n = max(self.rel.encoder.num_groups, 1) if self.rel.key_cols else 1
+        if track_rowcounts:
+            self.rowcounts = self._grown(self.rowcounts, n, np.int64)
+            rc = np.bincount(ids_np[live], minlength=n)
+            self.rowcounts[: len(rc)] += rc
+        for k in set(self.sum_exprs) | set(self.cnt_exprs):
+            e = self.sum_exprs.get(k)
+            count_only = e is None
+            if count_only:
+                e = self.cnt_exprs[k]
+            if count_only and isinstance(e, Column):
+                # COUNT(col): only the validity matters — never decode
+                # or materialize the values (Utf8 columns would build
+                # an object array per batch just to be discarded)
+                v = None
+                valid = batch.validity[e.index]
+                valid = None if valid is None else np.asarray(valid)
+            else:
+                v, valid = eval_host_expr(e, batch, {})
+            ok = live if valid is None else (live & np.asarray(valid, bool))
+            idsk = ids_np[ok]
+            if k in self.sum_exprs:
+                vv = np.broadcast_to(
+                    np.asarray(v, np.float64), (batch.capacity,)
+                )
+                s = np.bincount(idsk, weights=vv[ok], minlength=n)
+                self.sums[k] = self._grown(self.sums.get(k), n, np.float64)
+                self.sums[k][: len(s)] += s
+            if k in self.cnt_exprs:
+                c = np.bincount(idsk, minlength=n)
+                self.cnts[k] = self._grown(self.cnts.get(k), n, np.int64)
+                self.cnts[k][: len(c)] += c
+
+
+class AggregateRelation(Relation):
+    """Executes [Selection +] Aggregate over a child relation in one
+    fused kernel; emits a single result batch.
+
+    Group expressions must be column references over the child schema
+    (the planner produces exactly that shape today).  The compiled
+    core — specs, slots, predicate closure, jitted kernel — is shared
+    process-wide across relations with the same plan fingerprint.
+    """
+
+    def __init__(
+        self,
+        child: Relation,
+        group_expr: list[Expr],
+        aggr_expr: list[Expr],
+        out_schema: Schema,
+        predicate: Optional[Expr] = None,
+        functions=None,
+        device=None,
+    ):
+        self.child = child
+        self._schema = out_schema
+        self.device = device
+        from datafusion_tpu.exec.hostfn import host_evaluable
+        from datafusion_tpu.exec.relation import _is_accelerator
+
+        # On accelerators a numpy-evaluable predicate runs on the host:
+        # its mask travels bit-packed, its input columns don't travel at
+        # all (the Q1 shipdate filter drops ~12 MB of dict codes per
+        # SF-1 scan to a 0.75 MB mask).  The predicate — literals and
+        # all — lives on THIS relation; the core is built as if there
+        # were no predicate, so every host-filtered query shape shares
+        # one device kernel regardless of literal values.  No function
+        # metas reach this ctor, so predicates containing UDFs
+        # conservatively stay on device ({} finds no host_fn).
+        host_pred = (
+            predicate is not None
+            and _is_accelerator(device)
+            and host_evaluable(predicate, {}, child.schema)
+        )
+        self._host_pred_expr = predicate if host_pred else None
+        core_pred = None if host_pred else predicate
+        self._core_pred = core_pred
+        self._group_expr = list(group_expr)
+        self._aggr_expr = list(aggr_expr)
+        self._functions = functions
+        # link-aware slot placement (decided lazily from the first
+        # batch; see _decide_placement).  Workers disable it: their
+        # partial-state wire protocol ships device accumulators.
+        self._placement = None
+        self._allow_host_split = True
+        self.core = _AggregateCore.build(
+            child.schema, list(group_expr), list(aggr_expr), core_pred,
+            functions,
+        )
+        # THIS query's literal values for the shared core's parameter
+        # slots (identical fingerprints guarantee identical slot order)
+        from datafusion_tpu.exec.kernels import parameterize_exprs
+
+        self._params = parameterize_exprs(
+            _AggregateCore.param_exprs(core_pred, list(aggr_expr))
+        )[2]
+        self.key_cols = self.core.key_cols
+        self.specs = self.core.specs
+        self.slots = self.core.slots
+        self._aux_specs = self.core.aux_specs
+        self._jit = self.core.jit
+        self._aux_cache: dict = {}
+        self.encoder = GroupKeyEncoder(len(self.key_cols))
+        self._key_dicts: dict[int, StringDictionary] = {}
+        self._str_dicts: dict[int, StringDictionary] = {}
+        self._str_aux_cache: dict = {}
+        # serializes GroupKeyEncoder mutation: normally only the staging
+        # producer encodes, but a cache-pin miss (another relation
+        # scanning the same batches overwrote the group_ids slot) makes
+        # the consumer re-encode concurrently with the producer
+        import threading
+
+        self._ids_lock = threading.Lock()
+
+    # -- delegates into the shared core (the partitioned subclass and
+    # the multi-host coordinator call these by name) --
+    def _kernel(self, *args):
+        return self.core._kernel(*args)
+
+    def _slot_identity(self, sl: _Slot):
+        return self.core._slot_identity(sl)
+
+    @staticmethod
+    def _codes_to_ranks(kind, codes, str_aux_k):
+        return _AggregateCore._codes_to_ranks(kind, codes, str_aux_k)
+
+    @staticmethod
+    def _ranks_to_codes(kind, best, str_aux_k):
+        return _AggregateCore._ranks_to_codes(kind, best, str_aux_k)
+
+    def _init_state(self, capacity: int):
+        return self.core._init_state(capacity)
+
+    def _grow_state(self, state, new_capacity: int):
+        return self.core._grow_state(state, new_capacity)
+
+    def _compute_str_aux(self, batch: RecordBatch, slots=None):
+        """(ranks, rank->code) pair per string min/max slot, padded to a
+        bucketed capacity, cached per dictionary version."""
+        out = []
+        for k, sl in enumerate(self.slots if slots is None else slots):
+            if not sl.is_string:
+                out.append(None)
+                continue
+            d = batch.dicts[sl.arg_index]
+            if d is None:
+                raise ExecutionError(
+                    f"column {sl.arg_index} has no dictionary for {sl.kind}"
+                )
+            self._str_dicts[k] = d
+            key = (k, d.version)
+            hit = self._str_aux_cache.get(key)
+            if hit is None:
+                ranks = d.sort_ranks().astype(np.int32)
+                order = np.argsort(ranks).astype(np.int32)  # rank -> code
+                cap = bucket_capacity(max(len(ranks), 1))
+                pr = np.zeros(cap, np.int32)
+                pr[: len(ranks)] = ranks
+                po = np.zeros(cap, np.int32)
+                po[: len(order)] = order
+                hit = (pr, po)
+                self._str_aux_cache[key] = hit
+            out.append(hit)
+        return tuple(out)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _pick_capacity(self, current: int) -> int:
+        """Accumulator capacity for the observed group count.  Tight
+        power-of-two steps while the dense reduce path applies (small G
+        keeps the one-hot matrix small); once past DENSE_GROUP_MAX,
+        grow with 4x headroom jumps — each distinct capacity compiles a
+        fresh sort-merge kernel (two large sorts, expensive to build),
+        so the growth ladder must be short."""
+        n = max(self.encoder.num_groups, 1)
+        needed = group_capacity(n)
+        if needed <= max(current, DENSE_GROUP_MAX):
+            return max(needed, current)
+        return group_capacity(4 * n)
+
+    def _decide_placement(self, batch) -> Optional[_Placement]:
+        """Link-aware split of the SELECT-list aggregates between host
+        and device, decided once per query from the first batch.
+
+        Accelerator links vary by ~50x in both directions around the
+        break-even point, so placement must be measured, not assumed:
+        shipping a column costs wire_bytes/link_rate; computing its
+        grouped partials on the host costs ~rows * 8 ns per pass.  On
+        a slow link (tunneled chip) wide columns — or everything —
+        stay on the host; on real TPU interconnects everything ships
+        exactly as before.  Only float SUM/AVG and COUNT are eligible
+        (exact integer accumulation, MIN/MAX, and Utf8 slots keep
+        their device forms); in-memory (reusable) sources always ship
+        because their device copies amortize across queries.
+        """
+        from datafusion_tpu.exec.batch import (
+            _encode_wire,
+            _wire_enabled,
+            link_rate_mbps,
+        )
+        from datafusion_tpu.exec.hostfn import host_evaluable
+
+        if not self._allow_host_split or not _wire_enabled(self.device):
+            return None
+        # reusable sources: upload once, re-query forever — always ship
+        node = self.child
+        while node is not None:
+            ds = getattr(node, "datasource", None)
+            if ds is not None:
+                if getattr(ds, "reusable_batches", False):
+                    return None
+                break
+            node = getattr(node, "child", None)
+        # host slots need a host-visible mask
+        if batch.mask is not None and hasattr(batch.mask, "copy_to_host_async"):
+            return None
+        # ... and a host-evaluable predicate: host partials must apply
+        # the same row filter the device kernel would (a device-only
+        # predicate would silently include filtered rows in host sums)
+        if self._core_pred is not None and not host_evaluable(
+            self._core_pred, {}, self.child.schema
+        ):
+            return None
+        host_idx = set()
+        for j, s in enumerate(self.specs):
+            if s.is_string or s.name in ("min", "max") or s.count_star:
+                continue
+            if s.name in ("sum", "avg") and np.dtype(s.sum_dtype).kind != "f":
+                continue
+            # COUNT(col) needs only the column's validity, so any bare
+            # column reference (Utf8 included) is host-computable
+            count_of_col = s.name == "count" and isinstance(s.arg, Column)
+            if not count_of_col and not host_evaluable(
+                s.arg, {}, self.child.schema
+            ):
+                continue
+            host_idx.add(j)
+        if not host_idx:
+            return None
+        # bytes saved = wire bytes of columns used ONLY by host slots
+        host_cols: set[int] = set()
+        for j in host_idx:
+            self.specs[j].arg.collect_columns(host_cols)
+        kept: set[int] = set()
+        if self._core_pred is not None:
+            self._core_pred.collect_columns(kept)
+        for j, s in enumerate(self.specs):
+            if j not in host_idx:
+                s.arg.collect_columns(kept)
+        saved = host_cols - kept
+        if not saved:
+            return None
+        bytes_per_row = 0.0
+        for c in sorted(saved):
+            col = np.asarray(batch.data[c])
+            _, wires = _encode_wire(col, self.device)
+            bytes_per_row += sum(
+                w.nbytes for w in wires if isinstance(w, np.ndarray)
+            ) / max(batch.capacity, 1)
+        passes = len(set(
+            repr(self.specs[j].arg) for j in host_idx
+        ))
+        ship_s = bytes_per_row / (link_rate_mbps(self.device) * 1e6)
+        host_s = passes * _HOST_AGG_SECONDS_PER_ROW
+        if ship_s <= host_s:
+            return None
+        METRICS.add("aggregate.host_routed_slots", len(host_idx))
+        dev_idx = [j for j in range(len(self.specs)) if j not in host_idx]
+        if all(self.specs[j].count_star for j in dev_idx):
+            # only COUNT(*) would remain: its value is the host row
+            # counts — skip the device entirely
+            host_idx.update(dev_idx)
+            dev_idx = []
+        if dev_idx:
+            from datafusion_tpu.exec.kernels import parameterize_exprs
+
+            dev_exprs = [self._aggr_expr[j] for j in dev_idx]
+            core2 = _AggregateCore.build(
+                self.child.schema, self._group_expr, dev_exprs,
+                self._core_pred, self._functions,
+            )
+            params2 = parameterize_exprs(
+                _AggregateCore.param_exprs(self._core_pred, dev_exprs)
+            )[2]
+        else:
+            core2, params2 = None, ()
+        return _Placement(frozenset(host_idx), core2, params2)
+
+    def _host_live_mask(self, batch) -> np.ndarray:
+        """Numpy row-liveness for host-side slot updates: row bound +
+        upstream mask + the query predicate (whether it was routed to
+        the host or rides in the device core — _decide_placement
+        guarantees it is host-evaluable whenever this path runs)."""
+        live = np.zeros(batch.capacity, bool)
+        live[: batch.num_rows] = True
+        pred = self._host_pred_expr or self._core_pred
+        if pred is not None:
+            from datafusion_tpu.exec.hostfn import host_pred_mask
+
+            live &= host_pred_mask(pred, batch, {})
+        if batch.mask is not None:
+            live &= np.asarray(batch.mask)
+        return live
+
+    def accumulate(self):
+        """Run the scan, returning the partial-aggregate device state
+        (or a ("hostsplit", device_state, partials) triple when the
+        link-aware placement routed slots to the host).
+
+        Partitioned mode calls this per shard and combines states with
+        collectives; single-device mode finalizes it directly.
+        """
+        import itertools
+
+        src = iter(self.child.batches())
+        first = next(src, None)
+        if first is None:
+            return self._init_state(group_capacity(1))
+        if self._placement is None:
+            self._placement = self._decide_placement(first) or False
+        placement = self._placement or None
+        batches = itertools.chain([first], src)
+        if placement is None:
+            return self._accumulate_core(
+                batches, self.core, self._params, host_partials=None
+            )
+        partials = _HostPartials(self, placement.host_idx)
+        state = self._accumulate_core(
+            batches, placement.core, placement.params, host_partials=partials
+        )
+        return ("hostsplit", state, partials)
+
+    def _accumulate_core(self, batches, core, params, host_partials):
+        """The scan loop over one device core (the full core, or the
+        placement's reduced core — None when every slot went host)."""
+        from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
+        from datafusion_tpu.exec.relation import device_scope
+
+        if pipeline_enabled(self.device):
+            # producer thread runs all host prep for batch N+1 (group-id
+            # encode, aux tables, wire encode + H2D dispatch) while the
+            # consumer below dispatches batch N's kernel; results land
+            # in batch.cache / relation caches and are re-read as hits
+            def _stage(b):
+                self._group_ids(
+                    b, upload=core is not None,
+                    keep_np=host_partials is not None,
+                )
+                if core is None:
+                    return
+                # pin the aux tables computed NOW on the batch: global
+                # dictionaries keep growing while later batches parse,
+                # so a consumer-side recompute could see a bigger table
+                # (correct, but a fresh padded shape => kernel recompile).
+                # The owning core rides in the entry (like group_ids'
+                # encoder pin) so another relation on the same long-
+                # lived batch can never consume this one's aux.
+                b.cache["staged_aux"] = (
+                    core,
+                    tuple(compute_aux_values(core.aux_specs, b, self._aux_cache)),
+                    self._compute_str_aux(b, core.slots),
+                )
+                device_inputs(self._device_view(b, core), self.device, core.wire_hints)
+
+            batches = staged_pipeline(batches, _stage)
+
+        from datafusion_tpu.exec.kernels import fuse_batch_count
+
+        # batches per device launch: prepared inputs accumulate host-
+        # side and dispatch as ONE fused kernel (launch round trips are
+        # the warm-path bottleneck on tunneled devices)
+        fuse = fuse_batch_count()
+
+        state = None
+        capacity = 0
+        chunk: list = []
+
+        def flush():
+            nonlocal state, capacity
+            if not chunk:
+                return
+            # capacity picked AFTER the whole chunk's keys are encoded,
+            # so every id in the chunk fits the accumulator
+            needed = self._pick_capacity(capacity)
+            if state is None:
+                capacity = needed
+                state = core._init_state(capacity)
+            elif needed > capacity:
+                state = core._grow_state(state, needed)
+                capacity = needed
+            with METRICS.timer("execute.aggregate"), device_scope(self.device):
+                if len(chunk) == 1:
+                    c = chunk[0]
+                    state = device_call(
+                        core.jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
+                        c[6], params,
+                    )
+                else:
+                    state = device_call(
+                        core.fused_jit, tuple(chunk), state, params
+                    )
+            chunk.clear()
+
+        for batch in batches:
+            for idx in self.key_cols:
+                if batch.dicts[idx] is not None:
+                    self._key_dicts[idx] = batch.dicts[idx]
+            ids = self._group_ids(
+                batch, upload=core is not None,
+                keep_np=host_partials is not None,
+            )
+            if host_partials is not None:
+                np_hit = batch.cache.get("group_ids_np")
+                ids_np = (
+                    np_hit[1]
+                    if np_hit is not None and np_hit[0] is self.encoder
+                    else self._group_ids(batch, upload=False)
+                )
+                host_partials.update(
+                    batch, ids_np, self._host_live_mask(batch),
+                    track_rowcounts=core is None,
+                )
+            if core is None:
+                continue
+            staged = batch.cache.get("staged_aux")
+            if staged is not None and staged[0] is core:
+                _, aux, str_aux = staged
+            else:
+                aux = compute_aux_values(core.aux_specs, batch, self._aux_cache)
+                str_aux = self._compute_str_aux(batch, core.slots)
+            with device_scope(self.device):
+                data, validity, mask = device_inputs(
+                    self._device_view(batch, core), self.device, core.wire_hints
+                )
+            chunk.append(
+                (data, validity, tuple(aux), np.int32(batch.num_rows), mask,
+                 ids, str_aux)
+            )
+            if len(chunk) >= fuse:
+                flush()
+        if core is None:
+            return None
+        flush()
+        if state is None:
+            state = core._init_state(group_capacity(1))
+        return state
+
+    def _device_view(self, batch: RecordBatch, core=None) -> RecordBatch:
+        """The batch as the device kernel sees it: only `used_cols`
+        (group keys travel as dense ids, host-predicate inputs not at
+        all), with the host-evaluated predicate folded into the mask.
+        Cached on the batch (relation+core-pinned) so re-scanned
+        in-memory batches keep their device copies across runs."""
+        if core is None:
+            core = self.core
+        if self._host_pred_expr is None and len(core.used_cols) == batch.num_columns:
+            return batch
+        key = "agg_view"
+        hit = batch.cache.get(key)
+        if hit is not None and hit[0] is self and hit[1] is core:
+            return hit[2]
+        mask = batch.mask
+        if self._host_pred_expr is not None:
+            from datafusion_tpu.exec.hostfn import host_pred_mask
+
+            pm = host_pred_mask(self._host_pred_expr, batch, {})
+            # an upstream device mask would need a D2H pull to combine
+            # host-side — rare (the planner fuses filters into the
+            # aggregate), and still correct when it happens
+            mask = pm if mask is None else (np.asarray(mask) & pm)
+        view = RecordBatch(
+            core.sub_schema,
+            [batch.data[c] for c in core.used_cols],
+            [batch.validity[c] for c in core.used_cols],
+            [batch.dicts[c] for c in core.used_cols],
+            num_rows=batch.num_rows,
+            mask=mask,
+        )
+        # pinned by RELATION (the host-predicate mask carries THIS
+        # query's literals) and by the specific core (full vs reduced)
+        batch.cache[key] = (self, core, view)
+        return view
+
+    def _group_ids(self, batch: RecordBatch, upload: bool = True,
+                   keep_np: bool = False):
+        """Dense group ids for one batch — the device array (plus,
+        under `keep_np`, the host `"group_ids_np"` cache entry the
+        host-partials path reads).  `upload=False` (full-host
+        placement) encodes without ever touching the device.  Cached on
+        the batch (keyed by this relation's encoder) so re-scanned
+        in-memory batches skip both the host encode and the H2D
+        transfer; pure-device runs keep only the device copy.
+
+        Serialized by `_ids_lock`: the staging producer thread normally
+        does all encoding, but a pin miss (another relation's encode
+        overwrote the batch's slot) routes the consumer thread here
+        concurrently, and GroupKeyEncoder mutation is not atomic."""
+        # single slot per batch (a different query's encoder overwrites
+        # it) so long-lived in-memory batches hold at most one ids array,
+        # not one per query ever run; the entry pins the encoder so the
+        # identity check can't hit a recycled object
+        key = "group_ids" if upload else "group_ids_np"
+        hit = batch.cache.get(key)
+        if hit is not None and hit[0] is self.encoder:
+            if not keep_np or batch.cache.get("group_ids_np") is not None:
+                return hit[1]
+        with self._ids_lock:
+            return self._group_ids_locked(batch, upload, keep_np)
+
+    def _group_ids_locked(self, batch: RecordBatch, upload: bool = True,
+                          keep_np: bool = False):
+        key = "group_ids" if upload else "group_ids_np"
+        hit = batch.cache.get(key)
+        if hit is not None and hit[0] is self.encoder:
+            if not keep_np or batch.cache.get("group_ids_np") is not None:
+                return hit[1]
+        np_hit = batch.cache.get("group_ids_np")
+        if np_hit is not None and np_hit[0] is self.encoder:
+            ids_np = np_hit[1]
+        elif self.key_cols:
+            key_cols = [np.asarray(batch.data[idx]) for idx in self.key_cols]
+            key_valids = [
+                None if batch.validity[idx] is None else np.asarray(batch.validity[idx])
+                for idx in self.key_cols
+            ]
+            ids_np = self.encoder.encode(key_cols, key_valids)
+        else:
+            ids_np = np.zeros(batch.capacity, dtype=np.int32)
+        if keep_np or not upload:
+            batch.cache["group_ids_np"] = (self.encoder, ids_np)
+        if not upload:
+            return ids_np
+        if hit is not None and hit[0] is self.encoder:
+            return hit[1]  # device copy already cached; np now kept too
+        # ship ids in the narrowest width that holds the group count and
+        # widen on device (H2D bytes 4x/2x smaller for the common small-
+        # cardinality GROUP BY); pointless when the target is the host
+        # platform itself (no link — see batch._wire_enabled)
+        from datafusion_tpu.exec.batch import _wire_enabled
+
+        wire = ids_np
+        n_groups = self.encoder.num_groups
+        if _wire_enabled(self.device):
+            if n_groups <= 127:
+                wire = ids_np.astype(np.int8)
+            elif n_groups <= 32767:
+                wire = ids_np.astype(np.int16)
+        dev_wire = (
+            jax.device_put(wire, self.device)
+            if self.device is not None
+            else jnp.asarray(wire)
+        )
+        ids = (
+            dev_wire
+            if wire.dtype == np.int32
+            else _WIDEN_IDS_JIT(dev_wire)
+        )
+        batch.cache["group_ids"] = (self.encoder, ids)
+        return ids
+
+    @staticmethod
+    def _numeric_output(s: AggregateSpec, sums, cnts, live_counts):
+        """(values, validity) for a SUM/AVG/COUNT spec from its summed
+        and counted per-group arrays — THE definition of these
+        aggregates' value/null semantics, shared by the device-pull and
+        host-partials finalize paths."""
+        if s.name in ("sum", "avg"):
+            if s.name == "sum":
+                vals = sums.astype(s.return_type.np_dtype)
+            else:
+                vals = (sums.astype(np.float64) / np.maximum(cnts, 1)).astype(
+                    s.return_type.np_dtype
+                )
+            valid = cnts > 0
+        else:  # count
+            raw = live_counts if cnts is None else cnts
+            vals = raw.astype(s.return_type.np_dtype)
+            valid = None
+        if valid is not None and bool(np.asarray(valid).all()):
+            valid = None
+        return vals, valid
+
+    @classmethod
+    def _spec_output(cls, s: AggregateSpec, slot_host, live_counts, str_dicts):
+        """(values, validity, dict) for one aggregate spec from pulled
+        per-slot live-group arrays — shared by the plain and the
+        host-split finalize paths."""
+        if s.is_string:
+            codes = slot_host[s.minmax_slot].astype(np.int32)
+            valid = codes >= 0
+            return (
+                np.where(valid, codes, 0).astype(np.int32),
+                None if bool(valid.all()) else valid,
+                str_dicts.get(s.minmax_slot),
+            )
+        if s.name in ("sum", "avg", "count"):
+            sums = None if s.sum_slot is None else slot_host[s.sum_slot]
+            cnts = None if s.cnt_slot is None else slot_host[s.cnt_slot]
+            vals, valid = cls._numeric_output(s, sums, cnts, live_counts)
+            return vals, valid, None
+        if s.name == "min":
+            raw = slot_host[s.minmax_slot]
+            vals = raw.astype(s.return_type.np_dtype)
+            valid = raw != _min_identity(np.dtype(raw.dtype))
+        else:
+            raw = slot_host[s.minmax_slot]
+            vals = raw.astype(s.return_type.np_dtype)
+            valid = raw != _max_identity(np.dtype(raw.dtype))
+        if bool(np.asarray(valid).all()):
+            valid = None
+        return vals, valid, None
+
+    def _key_outputs(self, live):
+        """Group-key output columns for the live groups, in key order."""
+        out_cols, out_valid, out_dicts = [], [], []
+        in_schema = self.child.schema
+        for k, idx in enumerate(self.key_cols):
+            keys, kvalid = self.encoder.key_column(k)
+            keys = keys[live]
+            f = in_schema.field(idx)
+            npd = np.dtype(f.data_type.np_dtype)
+            if npd.kind == "f":
+                # float keys were bit-cast into the encoder; bit-cast back
+                out_cols.append(keys.view(np.float64).astype(npd))
+            else:
+                out_cols.append(keys.astype(npd))
+            out_valid.append(None if kvalid is None else kvalid[live])
+            out_dicts.append(self._key_dicts.get(idx))
+        return out_cols, out_valid, out_dicts
+
+    def _pull_state(self, state):
+        """Pull a device accumulator state's live prefix to host.
+        Returns (counts, per-slot host arrays)."""
+        counts, accs = state
+        # transfer only the live prefix: dense ids mean groups occupy
+        # [0, num_groups) of the power-of-two capacity, so slicing on
+        # device before D2H cuts transferred bytes by the headroom
+        # factor (up to ~8x right after a capacity growth)
+        n_groups = self.encoder.num_groups if self.key_cols else 1
+        # slice length bucketed to a power of two: every distinct shape
+        # compiles a (tiny) slice kernel, so keep the shape set bounded
+        cut = min(group_capacity(n_groups), counts.shape[0])
+        if cut < counts.shape[0]:
+            counts = counts[:cut]
+            accs = tuple(a[:cut] for a in accs)
+        # ONE blob-packed transfer for the whole result state: each
+        # separate device->host copy costs a full link round trip
+        counts, accs = device_pull((counts, accs))
+        return np.asarray(counts), [np.asarray(a) for a in accs]
+
+    def finalize(self, state) -> RecordBatch:
+        if isinstance(state, tuple) and len(state) == 3 and state[0] == "hostsplit":
+            return self._finalize_split(state[1], state[2])
+        counts, accs = self._pull_state(state)
+        n_groups = self.encoder.num_groups if self.key_cols else 1
+        if self.key_cols:
+            live = np.nonzero(counts[:n_groups] > 0)[0]
+        else:
+            # global aggregate: always exactly one output row
+            live = np.array([0], dtype=np.int64)
+
+        out_cols, out_valid, out_dicts = self._key_outputs(live)
+        slot_host = [a[live] for a in accs]
+        live_counts = counts[live]
+        for s in self.specs:
+            vals, valid, d = self._spec_output(
+                s, slot_host, live_counts, self._str_dicts
+            )
+            out_cols.append(vals)
+            out_valid.append(valid)
+            out_dicts.append(d)
+
+        return make_host_batch(self._schema, out_cols, out_valid, out_dicts)
+
+    def _finalize_split(self, dev_state, partials: _HostPartials) -> RecordBatch:
+        """Merge device accumulators (reduced core) with host partials
+        into the SELECT-order output batch."""
+        placement = self._placement
+        core2 = placement.core
+        n_groups = max(self.encoder.num_groups, 1) if self.key_cols else 1
+        if core2 is not None and dev_state is not None:
+            counts, accs = self._pull_state(dev_state)
+        else:
+            counts = _HostPartials._grown(
+                partials.rowcounts, n_groups, np.int64
+            )
+            accs = []
+        if self.key_cols:
+            live = np.nonzero(counts[:n_groups] > 0)[0]
+        else:
+            live = np.array([0], dtype=np.int64)
+        out_cols, out_valid, out_dicts = self._key_outputs(live)
+        slot_host = [a[live] for a in accs]
+        live_counts = counts[live]
+        dev_pos = 0
+        grown = _HostPartials._grown
+        for j, s in enumerate(self.specs):
+            if j in placement.host_idx:
+                k = repr(s.arg)
+                sums = cnts = None
+                if s.name in ("sum", "avg"):
+                    sums = grown(partials.sums.get(k), n_groups, np.float64)[live]
+                if not s.count_star:
+                    cnts = grown(partials.cnts.get(k), n_groups, np.int64)[live]
+                vals, valid = self._numeric_output(s, sums, cnts, live_counts)
+                out_cols.append(vals)
+                out_valid.append(valid)
+                out_dicts.append(None)
+            else:
+                s2 = core2.specs[dev_pos]
+                dev_pos += 1
+                vals, valid, d = self._spec_output(
+                    s2, slot_host, live_counts, self._str_dicts
+                )
+                out_cols.append(vals)
+                out_valid.append(valid)
+                out_dicts.append(d)
+        return make_host_batch(self._schema, out_cols, out_valid, out_dicts)
+
+    def batches(self) -> Iterator[RecordBatch]:
+        yield self.finalize(self.accumulate())

@@ -1,0 +1,220 @@
+"""DataSource protocol + concrete sources.
+
+Mirrors the reference `DataSource` trait and `CsvDataSource`
+(`src/execution/datasource.rs:26-50`), plus the Parquet/NDJSON sources
+it declares but never implements (`dfparser.rs:33-34`).  A DataSource
+is re-iterable (each `batches()` call restarts the scan) and
+projection-aware — `with_projection` returns a source that parses only
+the needed columns, which is what the push-down optimizer targets.
+
+`DataSourceMeta` mirrors `datasource.rs:70-85`: the serializable
+description of a source that distributed mode ships to workers.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence
+
+from datafusion_tpu.datatypes import Schema
+from datafusion_tpu.errors import PlanError
+from datafusion_tpu.exec.batch import RecordBatch
+from datafusion_tpu.io.readers import (
+    DEFAULT_BATCH_SIZE,
+    CsvReader,
+    NdJsonReader,
+    ParquetReader,
+    infer_parquet_schema,
+)
+
+
+class DataSource:
+    """Base: schema + re-iterable batches (reference `datasource.rs:26-29`)."""
+
+    # True when re-scans hand out the SAME RecordBatch objects, so
+    # device copies cached on them amortize across queries (in-memory
+    # tables).  File scans parse fresh batches per query.  Operators
+    # use this for link-aware placement: shipping a reusable table to
+    # the accelerator pays once; shipping a stream pays every query.
+    reusable_batches = False
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def batches(self) -> Iterator[RecordBatch]:
+        raise NotImplementedError
+
+    def with_projection(self, projection: Sequence[int]) -> "DataSource":
+        raise NotImplementedError
+
+    def to_meta(self) -> dict:
+        raise PlanError(f"{type(self).__name__} is not serializable")
+
+
+class CsvDataSource(DataSource):
+    def __init__(
+        self,
+        path: str,
+        schema: Schema,
+        has_header: bool = True,
+        batch_size: int = DEFAULT_BATCH_SIZE,
+        projection: Optional[Sequence[int]] = None,
+        reader: Optional[str] = None,
+    ):
+        self.path = path
+        self.table_schema = schema
+        self.has_header = has_header
+        self.batch_size = batch_size
+        self.projection = list(projection) if projection is not None else None
+        # two parsers, both full-fidelity and parity-tested in CI:
+        # the native C++ one (the host hot loop — reference
+        # `datasource.rs:31-50` is native too) selected per-source via
+        # `reader="native"` or process-wide via
+        # DATAFUSION_TPU_CSV_READER=native, and the pyarrow SIMD parser
+        # with auto_dict_encode (measured ~2x the native reader), the
+        # default
+        import os
+
+        from datafusion_tpu.native import native_available
+
+        self.reader_choice = reader
+        choice = reader or os.environ.get("DATAFUSION_TPU_CSV_READER", "auto")
+        if choice == "native" and native_available():
+            from datafusion_tpu.native.csv import NativeCsvReader
+
+            self._reader = NativeCsvReader(
+                path, schema, has_header, batch_size, self.projection
+            )
+        else:
+            self._reader = CsvReader(
+                path, schema, has_header, batch_size, self.projection
+            )
+
+    @property
+    def schema(self) -> Schema:
+        return self._reader.out_schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return self._reader.batches()
+
+    def with_projection(self, projection: Sequence[int]) -> "CsvDataSource":
+        return CsvDataSource(
+            self.path, self.table_schema, self.has_header, self.batch_size,
+            projection, reader=self.reader_choice,
+        )
+
+    def to_meta(self) -> dict:
+        # wire format mirrors DataSourceMeta::CsvFile (datasource.rs:72-77)
+        return {
+            "CsvFile": {
+                "filename": self.path,
+                "schema": self.table_schema.to_json(),
+                "has_header": self.has_header,
+                "projection": self.projection,
+            }
+        }
+
+
+class NdJsonDataSource(DataSource):
+    def __init__(
+        self,
+        path: str,
+        schema: Schema,
+        batch_size: int = DEFAULT_BATCH_SIZE,
+        projection: Optional[Sequence[int]] = None,
+    ):
+        self.path = path
+        self.table_schema = schema
+        self.batch_size = batch_size
+        self.projection = list(projection) if projection is not None else None
+        self._reader = NdJsonReader(path, schema, batch_size, self.projection)
+
+    @property
+    def schema(self) -> Schema:
+        return self._reader.out_schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return self._reader.batches()
+
+    def with_projection(self, projection: Sequence[int]) -> "NdJsonDataSource":
+        return NdJsonDataSource(self.path, self.table_schema, self.batch_size, projection)
+
+    def to_meta(self) -> dict:
+        # same wire shape as the CSV/Parquet variants (datasource.rs:70-85);
+        # the reference declares NDJSON in DDL but never got this far
+        return {
+            "NdJsonFile": {
+                "filename": self.path,
+                "schema": self.table_schema.to_json(),
+                "projection": self.projection,
+            }
+        }
+
+
+class ParquetDataSource(DataSource):
+    def __init__(
+        self,
+        path: str,
+        schema: Optional[Schema] = None,
+        batch_size: int = DEFAULT_BATCH_SIZE,
+        projection: Optional[Sequence[int]] = None,
+    ):
+        self.path = path
+        self.table_schema = schema if schema is not None else infer_parquet_schema(path)
+        self.batch_size = batch_size
+        self.projection = list(projection) if projection is not None else None
+        self._reader = ParquetReader(path, self.table_schema, batch_size, self.projection)
+
+    @property
+    def schema(self) -> Schema:
+        return self._reader.out_schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return self._reader.batches()
+
+    def with_projection(self, projection: Sequence[int]) -> "ParquetDataSource":
+        return ParquetDataSource(
+            self.path, self.table_schema, self.batch_size, projection
+        )
+
+    def to_meta(self) -> dict:
+        # mirrors DataSourceMeta::ParquetFile (datasource.rs:79-84)
+        return {
+            "ParquetFile": {
+                "filename": self.path,
+                "schema": self.table_schema.to_json(),
+                "projection": self.projection,
+            }
+        }
+
+
+class MemoryDataSource(DataSource):
+    """In-memory source over prebuilt RecordBatches (test/bench helper)."""
+
+    reusable_batches = True
+
+    def __init__(self, schema: Schema, record_batches: list[RecordBatch]):
+        self._schema = schema
+        self._batches = list(record_batches)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return iter(self._batches)
+
+    def with_projection(self, projection: Sequence[int]) -> "DataSource":
+        out_schema = self._schema.select(list(projection))
+        projected = [
+            RecordBatch(
+                out_schema,
+                [b.data[i] for i in projection],
+                [b.validity[i] for i in projection],
+                [b.dicts[i] for i in projection],
+                num_rows=b.num_rows,
+                mask=b.mask,
+            )
+            for b in self._batches
+        ]
+        return MemoryDataSource(out_schema, projected)

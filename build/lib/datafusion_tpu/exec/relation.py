@@ -1,0 +1,553 @@
+"""Relation protocol and the fused pipeline operator.
+
+The reference's operator layer is a volcano-style pull iterator
+(`src/execution/relation.rs:27-32`) with separate Filter and Projection
+operators that interpret closures per batch.  Here a whole
+scan->filter->project fragment executes as **one jitted XLA kernel**
+(`PipelineRelation`): the predicate produces a selection mask that is
+carried in the batch instead of gathering rows (`filter.rs:80-111`'s
+per-column row loop), and projection expressions fuse with it.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.exec.batch import RecordBatch
+from datafusion_tpu.exec.expression import Env, ExprCompiler, compute_aux_values
+from datafusion_tpu.errors import NotSupportedError
+from datafusion_tpu.plan.expr import Column, Expr
+from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
+
+
+def device_scope(device):
+    """Context manager placing jax computations on `device` (no-op when
+    None: JAX's default device — the TPU when one is attached)."""
+    from contextlib import nullcontext
+
+    return jax.default_device(device) if device is not None else nullcontext()
+
+
+# tiny fused AND for combining a host predicate mask with a device-
+# resident upstream mask (built lazily; one jit for every shape pair)
+_MASK_AND_JIT = None
+
+
+def _is_accelerator(device) -> bool:
+    """True when batches execute on a non-CPU device (`device` is a jax
+    Device, or None = the JAX default backend)."""
+    if device is not None:
+        return getattr(device, "platform", "cpu") != "cpu"
+    return jax.default_backend() != "cpu"
+
+
+class Relation:
+    """Pull-based iterator of RecordBatches (reference `Relation` trait)."""
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def batches(self) -> Iterator[RecordBatch]:
+        raise NotImplementedError
+
+
+class DataSourceRelation(Relation):
+    """Adapts a DataSource into a Relation (reference `relation.rs:34-54`)."""
+
+    def __init__(self, datasource):
+        self.datasource = datasource
+
+    @property
+    def schema(self) -> Schema:
+        return self.datasource.schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return self.datasource.batches()
+
+
+def _host_routed(e, metas, in_schema, host_scalar: bool) -> bool:
+    """Should projection expr `e` evaluate on the host instead of inside
+    the device kernel?  Always for host-only functions; additionally,
+    under `host_scalar` (accelerator devices), for any numpy-evaluable
+    scalar expression — computing a+b on one CPU core costs
+    milliseconds, while shipping the computed column back over the
+    device link costs D2H bytes, the scarce resource (BASELINE.md: the
+    tunneled link moves D2H at ~0.01-0.025 GB/s)."""
+    from datafusion_tpu.exec.hostfn import contains_host_fn, host_evaluable
+
+    if contains_host_fn(e, metas):
+        return True
+    if not host_scalar or isinstance(e, Column):
+        return False
+    return host_evaluable(e, metas, in_schema)
+
+
+class _PipelineCore:
+    """The compiled, shareable part of a pipeline: expression closures
+    and the jitted kernel.  Cached process-wide by plan fingerprint
+    (SURVEY §7 recompilation control) so a fresh operator tree for a
+    semantically identical query reuses the already-built jit — and
+    with it every compiled executable in jit's cache."""
+
+    def __init__(self, in_schema, predicate, projections, functions, metas,
+                 param_slots=None, host_scalar=False):
+        from datafusion_tpu.exec.hostfn import contains_host_fn
+
+        compiler = ExprCompiler(in_schema, functions, param_slots)
+        if predicate is not None and contains_host_fn(predicate, metas):
+            raise NotSupportedError(
+                "host-only functions are not supported in WHERE predicates"
+            )
+        self.pred_fn = compiler.compile(predicate) if predicate is not None else None
+        # projections containing host-only functions (string/struct
+        # producers) are evaluated post-kernel against the input batch;
+        # bare column references bypass the kernel entirely — the host
+        # array passes through untouched.  That keeps Float64 columns
+        # EXACT on TPU (f64 is emulated there: even an identity kernel
+        # round-trip perturbs values by ~1e-14) and removes their D2H
+        # transfer — only computed columns and the mask cross the link.
+        # Under `host_scalar` (accelerator devices) scalar arithmetic
+        # projections are host-routed too (_host_routed above): the
+        # device kernel shrinks to the predicate mask, and no computed
+        # column ever crosses D2H.
+        self.host_scalar = host_scalar
+        self.host_proj: dict[int, Expr] = {}
+        self.identity_proj: dict[int, int] = {}
+        self.proj_fns = None
+        if projections is not None:
+            self.proj_fns = []
+            for j, e in enumerate(projections):
+                if _host_routed(e, metas, in_schema, host_scalar):
+                    self.host_proj[j] = e
+                    self.proj_fns.append(None)
+                elif isinstance(e, Column):
+                    self.identity_proj[j] = e.index
+                    self.proj_fns.append(None)
+                else:
+                    self.proj_fns.append(compiler.compile(e))
+        self.aux_specs = compiler.aux_specs
+        # map projection outputs to source dictionaries (Utf8 passthrough)
+        self.out_dict_sources: list[Optional[int]] = []
+        if projections is not None:
+            for e in projections:
+                if (
+                    isinstance(e, Column)
+                    and in_schema.field(e.index).data_type == DataType.UTF8
+                ):
+                    self.out_dict_sources.append(e.index)
+                else:
+                    self.out_dict_sources.append(None)
+
+        # no predicate and nothing to compute on device => the batch
+        # never touches the device at all (pure column selection)
+        self.needs_kernel = self.pred_fn is not None or (
+            self.proj_fns is not None
+            and any(f is not None for f in self.proj_fns)
+        )
+        # ship only the columns the kernel actually reads (jit transfers
+        # every argument, used or not — H2D bytes are the scarce
+        # resource on remote links); Env's col_map translates schema
+        # indices to subset positions
+        used: set[int] = set()
+        if predicate is not None:
+            predicate.collect_columns(used)
+        if projections is not None:
+            for j, e in enumerate(projections):
+                if j in self.identity_proj or j in self.host_proj:
+                    continue
+                e.collect_columns(used)
+        if self.needs_kernel and not used and len(in_schema):
+            used.add(0)  # constant predicate: one column carries capacity
+        self.used_cols = sorted(used)
+        self.col_map = {c: i for i, c in enumerate(self.used_cols)}
+        self.sub_schema = in_schema.select(self.used_cols)
+        # per-column codec memory for put_compressed; the core persists
+        # across cold re-runs of the same query shape, so batch 2+ of
+        # every scan skips the encode probe ladder
+        self.wire_hints: dict = {}
+        self.jit = jax.jit(self._kernel)
+
+    @staticmethod
+    def param_exprs(predicate, projections, metas, in_schema=None,
+                    host_scalar=False):
+        """The exprs that compile into the device kernel, in slot-
+        assignment order.  Host-routed projections are excluded: their
+        exprs (with each query's own literal values) live on the
+        relation (`PipelineRelation._host_proj`), and the cache key
+        carries their literal-parameterized fingerprints."""
+        elig = [] if predicate is None else [predicate]
+        if projections is not None:
+            elig.extend(
+                e for e in projections
+                if not _host_routed(e, metas or {}, in_schema, host_scalar)
+            )
+        return elig
+
+    @staticmethod
+    def build(in_schema, predicate, projections, functions, metas,
+              host_scalar=False):
+        from datafusion_tpu.exec.kernels import (
+            cached_kernel,
+            functions_fingerprint,
+            parameterize_exprs,
+            schema_fingerprint,
+        )
+
+        elig = _PipelineCore.param_exprs(
+            predicate, projections, metas, in_schema, host_scalar
+        )
+        fps, slot_by_id, _ = parameterize_exprs(elig)
+        fp_of = dict(zip((id(e) for e in elig), fps))
+        proj_key = None
+        if projections is not None:
+            # host-routed exprs key by literal-parameterized fingerprint
+            # (their literal VALUES live on each relation, so numeric-
+            # literal variants share one compiled core exactly like
+            # device-routed exprs do)
+            proj_key = tuple(
+                ("host", parameterize_exprs([e])[0][0])
+                if _host_routed(e, metas or {}, in_schema, host_scalar)
+                else fp_of[id(e)]
+                for e in projections
+            )
+        key = (
+            "pipeline",
+            host_scalar,
+            schema_fingerprint(in_schema),
+            None if predicate is None else fp_of[id(predicate)],
+            proj_key,
+            functions_fingerprint(functions),
+            tuple(sorted(n for n, m in (metas or {}).items() if m.host_fn)),
+        )
+        return cached_kernel(
+            key,
+            lambda: _PipelineCore(
+                in_schema, predicate, projections, functions, metas,
+                slot_by_id, host_scalar,
+            ),
+        )
+
+    def _kernel(self, cols, valids, aux, num_rows, base_mask, params=()):
+        env = Env(cols, valids, aux, self.col_map, params)
+        if cols:
+            capacity = cols[0].shape[0]
+        elif base_mask is not None:
+            capacity = base_mask.shape[0]  # zero-column EmptyRelation batch
+        else:
+            capacity = 1
+        mask = base_mask
+        if mask is None:
+            mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        else:
+            mask = mask & (jnp.arange(capacity, dtype=jnp.int32) < num_rows)
+        if self.pred_fn is not None:
+            pv, pvalid = self.pred_fn(env)
+            pv = jnp.broadcast_to(pv, (capacity,))
+            if pvalid is not None:
+                # SQL: NULL predicate drops the row
+                pv = pv & jnp.broadcast_to(pvalid, (capacity,))
+            mask = mask & pv
+        if self.proj_fns is None:
+            # filter-only: columns pass through on the host; the kernel
+            # produces just the selection mask
+            return [], [], mask
+        out_cols, out_valids = [], []
+        for f in self.proj_fns:
+            if f is None:  # host-evaluated or identity: filled in later
+                continue
+            v, valid = f(env)
+            out_cols.append(jnp.broadcast_to(v, (capacity,)))
+            out_valids.append(
+                None if valid is None else jnp.broadcast_to(valid, (capacity,))
+            )
+        return out_cols, out_valids, mask
+
+
+class PipelineRelation(Relation):
+    """Fused [filter +] [projection] over a child relation.
+
+    One `jax.jit`-compiled function evaluates the predicate and all
+    projection expressions in a single fused XLA computation per batch.
+    The compiled core is shared process-wide by plan fingerprint
+    (`_PipelineCore.build`); jit's own cache handles per-(capacity,
+    dtypes) specialization and capacity bucketing (exec/batch.py)
+    bounds how many variants ever compile.
+    """
+
+    def __init__(
+        self,
+        child: Relation,
+        predicate: Optional[Expr],
+        projections: Optional[list[Expr]],
+        out_schema: Optional[Schema] = None,
+        functions: Optional[dict[str, Callable]] = None,
+        device=None,
+        function_metas=None,
+    ):
+        self.child = child
+        self.predicate = predicate
+        self.projections = projections
+        self._schema = out_schema if out_schema is not None else child.schema
+        self.device = device
+        self._metas = function_metas or {}
+        host_scalar = _is_accelerator(device)
+        # On accelerators a numpy-evaluable predicate runs on the host
+        # (mirroring AggregateRelation's host predicate): its input
+        # columns never cross H2D and — with projections host-routed
+        # under host_scalar — the whole batch often never touches the
+        # device.  Predicates containing host-only UDFs keep going to
+        # the core so it raises its NotSupportedError contract.
+        from datafusion_tpu.exec.hostfn import contains_host_fn, host_evaluable
+
+        host_pred = (
+            predicate is not None
+            and host_scalar
+            and not contains_host_fn(predicate, self._metas)
+            and host_evaluable(predicate, self._metas, child.schema)
+        )
+        self._host_pred_expr = predicate if host_pred else None
+        core_pred = None if host_pred else predicate
+        self.core = _PipelineCore.build(
+            child.schema, core_pred, projections, functions, self._metas,
+            host_scalar,
+        )
+        # THIS query's host-routed exprs (with its literal values) —
+        # the shared core only records which positions are host-routed
+        self._host_proj: dict[int, Expr] = {
+            j: e
+            for j, e in enumerate(projections or [])
+            if _host_routed(e, self._metas, child.schema, host_scalar)
+        }
+        # THIS query's literal values for the shared core's parameter
+        # slots (identical fingerprints guarantee identical slot order)
+        from datafusion_tpu.exec.kernels import parameterize_exprs
+
+        self._params = parameterize_exprs(
+            _PipelineCore.param_exprs(
+                core_pred, projections, self._metas, child.schema, host_scalar
+            )
+        )[2]
+        self._host_dicts: dict[int, "StringDictionary"] = {}
+        self._aux_cache: dict = {}
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
+
+        core = self.core
+        batches = self.child.batches()
+        if core.needs_kernel and pipeline_enabled(self.device):
+            # host prep for batch N+1 (aux tables, wire encode, H2D
+            # dispatch) runs on the producer thread while batch N's
+            # kernel dispatches below; aux is pinned on the batch so the
+            # consumer can't see a later (grown) dictionary version
+            def _stage(b):
+                # owning core pinned in the entry so no other relation
+                # on a shared batch can consume this aux (see the
+                # group_ids encoder pin in aggregate.py)
+                b.cache["staged_aux"] = (
+                    core,
+                    tuple(compute_aux_values(core.aux_specs, b, self._aux_cache)),
+                )
+                device_inputs(
+                    self._subset_view(b), self.device, core.wire_hints
+                )
+                if self._host_pred_expr is not None:
+                    self._device_mask(b)
+
+            batches = staged_pipeline(batches, _stage)
+
+        for batch in batches:
+            if not core.needs_kernel:
+                # pure column selection: yield a STABLE output batch per
+                # child batch (cached, core-pinned like group_ids) so a
+                # re-scan of an in-memory source hands downstream
+                # operators the same RecordBatch objects — their device
+                # copies (device_inputs cache) survive across runs
+                # instead of re-shipping every column per query run
+                # pinned by RELATION when host-routed exprs exist (their
+                # literal values — and the host predicate's — are
+                # per-query; the core is shared across literals), by
+                # core otherwise
+                pin = (
+                    self if (self._host_proj or self._host_pred_expr is not None)
+                    else core
+                )
+                hit = batch.cache.get("pipeline_out")
+                if hit is not None and hit[0] is pin:
+                    yield hit[1]
+                    continue
+                cols, valids, mask = [], [], self._effective_mask(batch)
+            else:
+                staged = batch.cache.get("staged_aux")
+                if staged is not None and staged[0] is core:
+                    aux = staged[1]
+                else:
+                    aux = tuple(
+                        compute_aux_values(core.aux_specs, batch, self._aux_cache)
+                    )
+                with METRICS.timer("execute.pipeline"), device_scope(self.device):
+                    data, validity, mask_in = device_inputs(
+                        self._subset_view(batch), self.device, core.wire_hints
+                    )
+                    if self._host_pred_expr is not None:
+                        # the shared subset view keeps the column device
+                        # copies literal-independent; only this query's
+                        # predicate mask uploads per relation
+                        mask_in = self._device_mask(batch)
+                    cols, valids, mask = device_call(
+                        core.jit,
+                        data,
+                        validity,
+                        aux,
+                        np.int32(batch.num_rows),
+                        mask_in,
+                        self._params,
+                    )
+            if core.proj_fns is None:
+                # filter-only: the input columns, untouched
+                cols, valids, dicts = batch.data, batch.validity, batch.dicts
+            else:
+                dicts = [
+                    batch.dicts[src] if src is not None else None
+                    for src in core.out_dict_sources
+                ]
+                cols, valids, dicts = self._assemble_outputs(
+                    batch, list(cols), list(valids), list(dicts)
+                )
+            out = RecordBatch(
+                self._schema,
+                list(cols),
+                list(valids),
+                dicts,
+                num_rows=batch.num_rows,
+                mask=mask,
+            )
+            if not core.needs_kernel:
+                batch.cache["pipeline_out"] = (
+                    self
+                    if (self._host_proj or self._host_pred_expr is not None)
+                    else core,
+                    out,
+                )
+            yield out
+
+    def _host_pred_mask(self, batch) -> np.ndarray:
+        """This query's host-routed predicate over one batch, as a
+        numpy bool mask (cached on the batch, pinned by relation — the
+        predicate carries per-query literals).  Predicate inputs are
+        host arrays in every shape the planner emits (scans pass host
+        columns through; device-computed columns only come from
+        non-host-evaluable projections, whose consumers can't route
+        here) — a device-resident input would still be correct, at the
+        cost of a per-batch pull."""
+        hit = batch.cache.get("pipe_pred_mask")
+        if hit is not None and hit[0] is self:
+            return hit[1]
+        from datafusion_tpu.exec.hostfn import host_pred_mask
+
+        pm = host_pred_mask(self._host_pred_expr, batch, self._metas)
+        batch.cache["pipe_pred_mask"] = (self, pm)
+        return pm
+
+    def _effective_mask(self, batch):
+        """The batch's selection mask with this query's host-routed
+        predicate folded in.  A device-resident upstream mask combines
+        ON DEVICE (one tiny fused AND) rather than being pulled to the
+        host — D2H round trips are the scarce resource."""
+        if self._host_pred_expr is None:
+            return batch.mask
+        pm = self._host_pred_mask(batch)
+        if batch.mask is None:
+            return pm
+        if hasattr(batch.mask, "copy_to_host_async"):  # device mask
+            global _MASK_AND_JIT
+            if _MASK_AND_JIT is None:
+                _MASK_AND_JIT = jax.jit(lambda a, b: a & b)
+            with device_scope(self.device):
+                return _MASK_AND_JIT(jax.device_put(pm), batch.mask)
+        return np.asarray(batch.mask) & pm
+
+    def _device_mask(self, batch):
+        """Device copy of the effective mask for the kernel path
+        (cached on the batch, pinned by relation — per-query literals).
+        Travels bit-packed through put_compressed; the kernel's input
+        columns keep riding the literal-independent subset-view cache."""
+        hit = batch.cache.get("pipe_pred_dev_mask")
+        if hit is not None and hit[0] is self:
+            return hit[1]
+        m = self._effective_mask(batch)
+        if m is not None and not hasattr(m, "copy_to_host_async"):
+            from datafusion_tpu.exec.batch import put_compressed
+
+            with device_scope(self.device):
+                m = put_compressed([m], self.device)[0]
+        batch.cache["pipe_pred_dev_mask"] = (self, m)
+        return m
+
+    def _subset_view(self, batch) -> RecordBatch:
+        """A view batch holding only the kernel's input columns (shared
+        helper; caching on the parent keeps device copies alive across
+        re-scans of in-memory sources)."""
+        from datafusion_tpu.exec.batch import subset_view
+
+        return subset_view(batch, self.core.used_cols)
+
+    def _assemble_outputs(self, batch, dev_cols, dev_valids, dicts):
+        """Interleave identity passthroughs (the input arrays, exact)
+        and post-kernel host-evaluated projections (string / struct
+        producers) with the device kernel's computed outputs."""
+        from datafusion_tpu.exec.batch import StringDictionary
+        from datafusion_tpu.exec.hostfn import eval_host_expr
+
+        cols, valids = [], []
+        dev_i = 0
+        for j in range(len(self.projections)):
+            src = self.core.identity_proj.get(j)
+            if src is not None:
+                cols.append(batch.data[src])
+                valids.append(batch.validity[src])
+                continue
+            host_expr = self._host_proj.get(j)
+            if host_expr is None:
+                cols.append(dev_cols[dev_i])
+                valids.append(dev_valids[dev_i])
+                dev_i += 1
+                continue
+            v, valid = eval_host_expr(host_expr, batch, self._metas)
+            if self._schema.field(j).data_type == DataType.UTF8:
+                d = self._host_dicts.get(j)
+                if d is None:
+                    d = self._host_dicts[j] = StringDictionary()
+                v = d.encode(list(np.asarray(v, dtype=object)))
+                dicts[j] = d
+            elif isinstance(v, tuple):
+                # struct results materialize via their Display form
+                # "f1, f2" (the pre-rewrite Point UDT's printing — see
+                # golden test_sql_udf_udt.csv)
+                # broadcast first: literal args arrive as 0-d scalars
+                parts = np.broadcast_arrays(
+                    *[np.asarray(x) for x in v],
+                    np.empty(batch.capacity),
+                )[:-1]
+                v = np.asarray(
+                    [", ".join(str(x) for x in tup) for tup in zip(*parts)],
+                    dtype=object,
+                )
+            v = np.broadcast_to(np.asarray(v), (batch.capacity,))
+            cols.append(v)
+            valids.append(
+                None if valid is None else np.broadcast_to(valid, (batch.capacity,))
+            )
+        return cols, valids, dicts

@@ -1,0 +1,1170 @@
+"""ORDER BY / LIMIT operators.
+
+The reference planned Sort/Limit but left them `unimplemented!()`
+(`context.rs:161`).  TPU design, two device paths:
+
+- **Streaming TopK** (`ORDER BY ... LIMIT k`, k <= TOPK_MAX): one
+  fused kernel per batch transforms sort keys *on device* (DESC =
+  negation / bit-complement, NULLs and padding to max sentinels, Utf8
+  via host rank tables passed as aux), sorts the batch together with
+  the carried top-k state, and keeps the best k rows as GLOBAL ROW
+  IDS — payload columns never travel to the device; the host gathers
+  them from the source batches at the end (bit-exact f64 even on
+  emulated-f64 backends).  Device state is O(k).  Host-side, scanned
+  batches pin until an asynchronously-pulled state snapshot confirms
+  they hold no surviving candidates (never blocking on the link), so
+  host memory stays bounded near the scan window in the steady state.
+- **Run sort + host merge** (full ORDER BY): each batch-bucket-sized
+  run sorts on device (multi-key `lax.sort`, stable), and the sorted
+  runs merge on the host with a vectorized structured-array
+  `searchsorted` merge.  No single all-rows device allocation; the
+  device sort buffer is bounded by the run size.
+
+Key transforms (shared by both paths):
+- Every ORDER BY key lowers to a (dead, value) operand pair: `dead`
+  is True for NULL keys and padding (nulls sort last, as a *separate*
+  leading key — a value sentinel would collide with real extremes:
+  ~int64.min == int64.max, -(-inf) == +inf), and dead rows' values are
+  zeroed so they compare equal among themselves.
+- DESC numeric keys sort by their negation (signed ints by bitwise
+  complement: -int64.min overflows), so every key is ascending for the
+  one fused sort.
+- Utf8 keys sort by host-computed rank tables
+  (`StringDictionary.sort_ranks`): rank[code] is the value's position
+  in sorted order, so code-ranked ascending == lexicographic.
+
+LIMIT over a sort slices the sorted permutation; a bare LIMIT just
+stops pulling batches early (no device work at all).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.errors import NotSupportedError
+from datafusion_tpu.exec.batch import (
+    RecordBatch,
+    bucket_capacity,
+    make_host_batch,
+)
+from datafusion_tpu.exec.materialize import compact_batch, iter_with_mask_prefetch
+from datafusion_tpu.exec.relation import Relation, device_scope as _device_scope
+from datafusion_tpu.plan.expr import Column, SortExpr
+from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import device_call
+
+# LIMIT at or below this rides the streaming device TopK; above it the
+# query is effectively a full sort and takes the run-merge path.
+TOPK_MAX = 65536
+
+
+def _np_sort_key(
+    values: np.ndarray,
+    validity: Optional[np.ndarray],
+    kind: str,
+    asc: bool,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side transformed key (run-merge path): a (dead, value)
+    operand pair, ascending, nulls last via the dead flag."""
+    n = len(values)
+    dead = np.zeros(n, bool) if validity is None else ~validity
+    if kind == "f":
+        k = values.astype(np.float64)
+        if not asc:
+            k = -k
+        k = np.where(dead, 0.0, k)
+    else:
+        k = values.astype(np.int64)
+        if not asc:
+            k = ~k  # complement, not negation: -int64.min overflows
+        k = np.where(dead, np.int64(0), k)
+    return dead, k
+
+
+# host throughput assumed by the sort placement cost model: np.lexsort
+# of one key pair over one core (order-of-magnitude constant, like
+# aggregate._HOST_AGG_SECONDS_PER_ROW)
+_HOST_SORT_SECONDS_PER_ROW = 1.5e-7
+
+
+class _KeyPlan:
+    """How one ORDER BY key lowers onto a column: which column, its
+    transform kind, direction, source width, and (for Utf8) a
+    rank-table aux slot."""
+
+    __slots__ = ("index", "kind", "asc", "rank_slot", "width")
+
+    def __init__(self, index: int, kind: str, asc: bool,
+                 rank_slot: Optional[int], width: int = 64):
+        self.index = index
+        self.kind = kind  # "f" | "i" | "u64" | "str"
+        self.asc = asc
+        self.rank_slot = rank_slot
+        self.width = width
+
+
+class _TopKCore:
+    """The compiled, shareable part of a streaming TopK: the key
+    transform and the jitted merge kernel, cached process-wide by the
+    key-plan fingerprint (SURVEY §7 recompilation control) so repeated
+    ORDER BY ... LIMIT shapes reuse compiled executables."""
+
+    def __init__(self, key_plans: list[_KeyPlan]):
+        self._key_plans = key_plans
+        # the kernels see ONLY the key columns (payloads never touch
+        # the device — the state carries winning global row ids and the
+        # host gathers payloads from the source batches, bit-exactly);
+        # _sub_of maps schema column index -> position in the subset
+        self.key_cols = sorted({kp.index for kp in key_plans})
+        self._sub_of = {c: i for i, c in enumerate(self.key_cols)}
+        # single-key fast path: `lax.top_k` on an exact int64 score
+        # image (orders of magnitude faster than a multi-operand sort
+        # on TPU).  Eligible when the whole key order embeds in int64
+        # scores with no collision against the sentinels: float32
+        # (bit-image via s32 bitcast; NaNs clamped to "worst"), ints
+        # <= 32 bits, string ranks.  float64 keys stay on the sort
+        # path — TPU emulates f64 and its bitcast doesn't lower — as do
+        # full-width int64/uint64, whose complement image can collide
+        # with the sentinels at the extremes.
+        kp = key_plans[0] if len(key_plans) == 1 else None
+        self.single = kp is not None and (
+            (kp.kind == "f" and kp.width == 32)
+            or kp.kind == "str"
+            # width 33 admits uint32 (SortRelation budgets unsigned
+            # sources one extra signed bit)
+            or (kp.kind == "i" and kp.width <= 33)
+        )
+        # wide single-key fast path: float64 / int64 / uint64 keys — the
+        # default SQL numeric types — take `lax.top_k` on a FULL-WIDTH
+        # int64 score (no index-tiebreak bits: lax.top_k is index-stable
+        # on every XLA backend, ties keep ascending row order).  The
+        # sentinel ladder lives at int64.min..min+2; a real int key CAN
+        # collide there, so the kernel carries a collision flag and the
+        # caller replays the scan through the exact sort path when it
+        # fires (f64 images can't reach the ladder: the NaN payload
+        # bands keep real bit-images > min + 2^51).
+        self.wide = (
+            kp is not None
+            and not self.single
+            and (
+                (kp.kind == "f" and kp.width == 64)
+                or kp.kind == "i"
+                or kp.kind == "u64"
+            )
+        )
+        if self.single:
+            self.jit = jax.jit(self._topk1_kernel, static_argnums=(0,))
+        elif self.wide:
+            self.jit = jax.jit(self._topk_wide_kernel, static_argnums=(0,))
+        else:
+            self.jit = jax.jit(self._topk_kernel, static_argnums=(0,))
+        self.fused_jit = jax.jit(self._fused_topk, static_argnums=(0,))
+        # per-column codec memory for put_compressed (see batch.py)
+        self.wire_hints: dict = {}
+
+    def _fused_topk(self, k, state, chunk):
+        """Fold the per-batch merge over a chunk of prepared batches in
+        ONE device launch (launch round trips dominate warm scans on
+        tunneled devices)."""
+        for cols, valids, mask, num_rows, row_base, rank_tables, img in chunk:
+            if self.single:
+                state = self._topk1_kernel(
+                    k, state, cols, valids, mask, num_rows, row_base,
+                    rank_tables,
+                )
+            elif self.wide:
+                state = self._topk_wide_kernel(
+                    k, state, cols, valids, mask, num_rows, row_base,
+                    rank_tables, img,
+                )
+            else:
+                state = self._topk_kernel(
+                    k, state, cols, valids, mask, num_rows, row_base,
+                    rank_tables,
+                )
+        return state
+
+    @staticmethod
+    def build(
+        key_plans: list[_KeyPlan], force_general: bool = False
+    ) -> "_TopKCore":
+        from datafusion_tpu.exec.kernels import cached_kernel
+
+        key = (
+            "topk",
+            force_general,
+            tuple(
+                (kp.index, kp.kind, kp.asc, kp.rank_slot, kp.width)
+                for kp in key_plans
+            ),
+        )
+
+        def make():
+            core = _TopKCore(list(key_plans))
+            if force_general and (core.single or core.wide):
+                core.single = False
+                core.wide = False
+                core.jit = jax.jit(core._topk_kernel, static_argnums=(0,))
+            return core
+
+        return cached_kernel(key, make)
+
+    # -- single-key score image (device, traced) --
+    # base-score ladder, higher = better: real values > NaN values >
+    # live NULL-key rows > padding/empty slots.  Real base scores fit
+    # 34 signed bits (f32 bit-images and <=32-bit int complements fit
+    # 33; string ranks fit 31), so the ladder constants sit safely
+    # below them and the per-batch index tiebreak fits alongside in
+    # int64.
+    _NAN_BASE = -(1 << 34)
+    _NULL_BASE = -(1 << 34) - 1
+    _DEAD_BASE = -(1 << 34) - 2
+
+    def _score(self, v, valid, row_mask, rank_tables):
+        kp = self._key_plans[0]
+        if kp.kind == "f":  # float32 only (see eligibility note)
+            b = jax.lax.bitcast_convert_type(
+                v.astype(jnp.float32), jnp.int32
+            )
+            # monotone unsigned image in [0, 2^32): negatives flip to
+            # [0, 2^31), positives shift ABOVE them (sign-magnitude ->
+            # total order; the naive where(b>=0, b, ~b) overlaps signs)
+            img = jnp.where(
+                b >= 0,
+                b.astype(jnp.int64) + jnp.int64(1 << 31),
+                (~b).astype(jnp.int64),
+            )
+            score = ~img if kp.asc else img
+            score = jnp.where(jnp.isnan(v), jnp.int64(self._NAN_BASE), score)
+        elif kp.kind == "str":
+            table = rank_tables[kp.rank_slot]
+            cap = table.shape[0]
+            rank = table[jnp.clip(v.astype(jnp.int32), 0, cap - 1)].astype(
+                jnp.int64
+            )
+            score = ~rank if kp.asc else rank
+        else:  # "i", width <= 32
+            k64 = v.astype(jnp.int64)
+            score = ~k64 if kp.asc else k64
+        if valid is not None:
+            score = jnp.where(valid, score, jnp.int64(self._NULL_BASE))
+        return jnp.where(row_mask, score, jnp.int64(self._DEAD_BASE))
+
+    def _topk1_kernel(self, k, state, cols, valids, mask, num_rows, row_base,
+                      rank_tables):
+        """Single-key merge: `lax.top_k` picks the batch's kb best rows,
+        then a tiny 2*kb-row stable sort merges them with the carried
+        state.  `top_k` tie order is backend-defined, so the row index
+        rides in the score's low bits — earlier rows strictly outrank
+        later equal-key rows on every backend; the carried state stores
+        only the base score (index bits are per-batch).  Payloads never
+        enter the state: the winning rows travel as global row ids
+        (`row_base` + local index) and the host gathers values."""
+        capacity = cols[0].shape[0]
+        shift = max(capacity - 1, 1).bit_length()
+        assert shift <= 27, "batch capacity too large for the score image"
+        row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if mask is not None:
+            row_mask = row_mask & mask
+        kp = self._key_plans[0]
+        sub = self._sub_of[kp.index]
+        base = self._score(cols[sub], valids[sub], row_mask, rank_tables)
+        idx_bits = jnp.int64(capacity - 1) - jnp.arange(capacity, dtype=jnp.int64)
+        full = base * jnp.int64(1 << shift) + idx_bits
+        # top_k requires k <= capacity: small batches contribute only
+        # their kk rows — the merge below works on any k + kk >= k
+        kk = min(k, capacity)
+        cs, ci = lax.top_k(full, kk)
+        cand_base = cs >> shift  # arithmetic shift recovers the base
+        cand_live = row_mask[ci]
+
+        skeys, slive, srows = state
+        all_score = jnp.concatenate([skeys[0], cand_base])
+        all_live = jnp.concatenate([slive, cand_live])
+        all_rows = jnp.concatenate([srows, row_base + ci.astype(jnp.int64)])
+        iota = jnp.arange(k + kk, dtype=jnp.int32)
+        out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
+        perm = out[1][:k]
+        return (all_score[perm],), all_live[perm], all_rows[perm]
+
+    # -- wide single-key path (f64 / int64 / uint64) --
+    # full-width int64 scores; sentinel ladder at the very bottom:
+    # real values > NaN > live NULL-key rows > padding/empty slots.
+    _W_DEAD = np.int64(-(2**63))
+    _W_NULL = np.int64(-(2**63) + 1)
+    _W_NAN = np.int64(-(2**63) + 2)
+
+    def _topk_wide_kernel(
+        self, k, state, cols, valids, mask, num_rows, row_base, rank_tables,
+        img
+    ):
+        """Single wide-key merge.  `img` is the host-computed monotone
+        int64 bit-image of a float64 key (TPU won't lower the f64
+        bitcast; None for integer keys, whose image computes on device).
+        Scores use all 64 bits, so a real integer key can land on the
+        sentinel ladder — `flag` records that and the caller replays
+        the scan through the exact sort path (state threads the flag).
+        """
+        capacity = cols[0].shape[0]
+        row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if mask is not None:
+            row_mask = row_mask & mask
+        kp = self._key_plans[0]
+        sub = self._sub_of[kp.index]
+        v = cols[sub]
+        valid = valids[sub]
+        if kp.kind == "f":
+            raw = img
+        elif kp.kind == "u64":
+            raw = lax.bitcast_convert_type(
+                v.astype(jnp.uint64) ^ jnp.uint64(1 << 63), jnp.int64
+            )
+        else:
+            raw = v.astype(jnp.int64)
+        score = ~raw if kp.asc else raw
+        live_real = row_mask if valid is None else (row_mask & valid)
+        if kp.kind == "f":
+            isnan = jnp.isnan(v)
+            collide = live_real & ~isnan & (score <= self._W_NAN)
+            score = jnp.where(isnan, self._W_NAN, score)
+        else:
+            collide = live_real & (score <= self._W_NAN)
+        if valid is not None:
+            score = jnp.where(valid, score, self._W_NULL)
+        score = jnp.where(row_mask, score, self._W_DEAD)
+
+        kk = min(k, capacity)
+        cs, ci = lax.top_k(score, kk)  # index-stable ties on all backends
+        cand_live = row_mask[ci]
+
+        skeys, slive, srows, flag = state
+        all_score = jnp.concatenate([skeys[0], cs])
+        all_live = jnp.concatenate([slive, cand_live])
+        all_rows = jnp.concatenate([srows, row_base + ci.astype(jnp.int64)])
+        iota = jnp.arange(k + kk, dtype=jnp.int32)
+        out = lax.sort((~all_score, iota), num_keys=1, is_stable=True)
+        perm = out[1][:k]
+        return (
+            (all_score[perm],),
+            all_live[perm],
+            all_rows[perm],
+            flag | collide.any(),
+        )
+
+    @staticmethod
+    def f64_image(values: np.ndarray) -> np.ndarray:
+        """Host-side monotone int64 image of a float64 column: v1 < v2
+        (as floats, NaNs excluded) implies img1 < img2 (as int64).  NaN
+        rows keep their natural extreme images; the kernel substitutes
+        the NaN sentinel via isnan(v) after applying direction."""
+        bits = np.ascontiguousarray(values, dtype=np.float64).view(np.int64)
+        u = bits.view(np.uint64)
+        flip = np.where(
+            bits < 0, ~np.uint64(0), np.uint64(1) << np.uint64(63)
+        )
+        return (u ^ flip ^ (np.uint64(1) << np.uint64(63))).view(np.int64)
+
+    # -- shared key transform (device, traced) --
+    def _device_keys(self, cols, valids, mask, capacity, rank_tables):
+        """Transformed ascending sort-key operands: a flat
+        [dead0, key0, dead1, key1, ...] list (dead = NULL/padded rows,
+        sorting last; their values zeroed so they tie)."""
+        keys = []
+        for kp in self._key_plans:
+            v = cols[self._sub_of[kp.index]]
+            valid = valids[self._sub_of[kp.index]]
+            if kp.kind == "str":
+                table = rank_tables[kp.rank_slot]
+                cap = table.shape[0]
+                k = table[jnp.clip(v.astype(jnp.int32), 0, cap - 1)].astype(
+                    jnp.int64
+                )
+                if not kp.asc:
+                    k = -k
+            elif kp.kind == "f":
+                k = v.astype(jnp.float64)
+                if not kp.asc:
+                    k = -k
+            elif kp.kind == "u64":
+                # uint64 doesn't fit int64: flip the sign bit and
+                # reinterpret — order-preserving and lossless
+                k = (v.astype(jnp.uint64) ^ jnp.uint64(1 << 63)).view(jnp.int64)
+                if not kp.asc:
+                    k = ~k
+            else:
+                k = v.astype(jnp.int64)
+                if not kp.asc:
+                    k = ~k  # complement, not negation: -int64.min overflows
+            dead = ~mask
+            if valid is not None:
+                dead = dead | ~valid
+            keys.append(dead)
+            keys.append(jnp.where(dead, jnp.zeros((), k.dtype), k))
+        return keys
+
+    # -- streaming TopK path --
+    def _topk_kernel(self, k, state, cols, valids, mask, num_rows, row_base,
+                     rank_tables):
+        """Merge one batch into the carried top-k state.
+
+        state = (keys..., live bits, global row ids) each length k;
+        returns the same structure.  The sort carries ONLY the key
+        operands plus a permutation iota; the winning rows travel as
+        global row ids and the HOST gathers payload values from the
+        source batches afterwards — bit-exact f64 payloads (an
+        emulated-f64 device round trip perturbs them ~1e-14), and no
+        payload bytes ever cross H2D.
+        """
+        capacity = cols[0].shape[0]
+        row_mask = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+        if mask is not None:
+            row_mask = row_mask & mask
+        bkeys = self._device_keys(cols, valids, row_mask, capacity, rank_tables)
+        skeys, slive, srows = state
+
+        ops = []
+        for sk, bk in zip(skeys, bkeys):
+            ops.append(jnp.concatenate([sk, bk.astype(sk.dtype)]))
+        live_col = jnp.concatenate([slive, row_mask])
+        rows_col = jnp.concatenate(
+            [srows, row_base + jnp.arange(capacity, dtype=jnp.int64)]
+        )
+        # tiebreak: among equal (dead) keys, real rows beat padding —
+        # NULL-key rows tie with empty state slots and must still fill
+        # a LIMIT larger than the non-null count
+        ops.append(~live_col)
+        n_keys = len(ops)
+        ops.append(jnp.arange(k + capacity, dtype=jnp.int32))  # permutation
+        out = lax.sort(tuple(ops), num_keys=n_keys, is_stable=True)
+        perm = out[n_keys][:k]
+
+        new_keys = tuple(o[:k] for o in out[:n_keys - 1])  # drop tiebreak
+        return new_keys, live_col[perm], rows_col[perm]
+
+
+
+class SortRelation(Relation):
+    def __init__(
+        self,
+        child: Relation,
+        sort_expr: list[SortExpr],
+        out_schema: Schema,
+        limit: Optional[int] = None,
+        device=None,
+    ):
+        self.child = child
+        self.sort_expr = sort_expr
+        self._schema = out_schema
+        self.limit = limit
+        self.device = device
+        for se in sort_expr:
+            if not isinstance(se.expr, Column):
+                raise NotSupportedError(
+                    f"ORDER BY supports column references, got {se.expr!r}"
+                )
+        in_schema = child.schema
+        self._key_plans: list[_KeyPlan] = []
+        rank_slots = 0
+        for se in sort_expr:
+            idx = se.expr.index
+            f = in_schema.field(idx)
+            if f.data_type == DataType.UTF8:
+                self._key_plans.append(_KeyPlan(idx, "str", se.asc, rank_slots))
+                rank_slots += 1
+                continue
+            kind = f.data_type.np_dtype.kind
+            if kind == "O":
+                raise NotSupportedError("struct columns cannot be ORDER BY keys")
+            width = f.data_type.width
+            if kind == "u" and width == 64:
+                kind = "u64"
+            elif kind in ("b", "i", "u"):
+                # unsigned 32-bit needs 33 bits as a signed image
+                width = width + 1 if kind == "u" else width
+                kind = "i"
+            else:
+                kind = "f"
+            self._key_plans.append(_KeyPlan(idx, kind, se.asc, None, width))
+        # TopK state capacity bucketed to a power of two (floor 128):
+        # every LIMIT in a bucket shares one compiled kernel per batch
+        # shape — compiles are the expensive resource on remote devices
+        self._kb = 128
+        while limit is not None and self._kb < min(limit, TOPK_MAX):
+            self._kb <<= 1
+        self.core = _TopKCore.build(self._key_plans)
+        self._topk_jit = self.core.jit
+        # device-resident sort-key operands per full-sort run, keyed by
+        # the run's source batch identities + dictionary versions: a
+        # warm re-query re-sorts the SAME device buffers instead of
+        # re-encoding + re-uploading the keys every run (the values pin
+        # the batch objects so ids stay valid).  Mirrors device_inputs'
+        # per-batch caching on the pipeline/aggregate paths.  FIFO-
+        # bounded: multi-run sorts and cold re-scans (fresh batch
+        # objects every scan, so their keys can never hit) must not
+        # accumulate device buffers without bound.
+        from collections import OrderedDict
+
+        self._run_ops_cache: OrderedDict = OrderedDict()
+        self._run_ops_cache_max = 4
+        # second-chance admission: a key must be SEEN twice before its
+        # device buffers are stored, so one-shot file scans (fresh batch
+        # objects every scan — their keys can never repeat) pin nothing.
+        # An id()-recycling false positive here merely admits an entry
+        # early; entries themselves pin their batches, so a stored key
+        # always identifies live objects.
+        self._run_seen: OrderedDict = OrderedDict()
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def _topk_init(self, k, in_schema, core=None):
+        core = core if core is not None else self.core
+        # cached on the core: building the empty state costs one tiny
+        # device launch per column, paid per RUN without the cache
+        # (launch round trips dominate warm scans on tunneled links);
+        # states are functionally consumed, never mutated
+        cache = getattr(core, "_init_states", None)
+        if cache is None:
+            cache = core._init_states = {}
+        sig = (k, tuple(str(in_schema.field(i).data_type.np_dtype)
+                        for i in range(len(in_schema))))
+        hit = cache.get(sig)
+        if hit is not None:
+            return hit
+        hit = self._topk_init_build(k, in_schema, core)
+        cache[sig] = hit
+        return hit
+
+    def _topk_init_build(self, k, in_schema, core):
+        if core.single or core.wide:
+            # empty slots carry the dead-sentinel base score (lose always)
+            sentinel = _TopKCore._W_DEAD if core.wide else _TopKCore._DEAD_BASE
+            keys = [jnp.full(k, sentinel, jnp.int64)]
+            base = (tuple(keys), jnp.zeros(k, bool), jnp.zeros(k, jnp.int64))
+            if core.wide:
+                return base + (jnp.zeros((), bool),)
+            return base
+        keys = []
+        for kp in self._key_plans:
+            keys.append(jnp.ones(k, bool))  # dead flag: empty slots last
+            keys.append(
+                jnp.zeros(k, jnp.float64 if kp.kind == "f" else jnp.int64)
+            )
+        return tuple(keys), jnp.zeros(k, bool), jnp.zeros(k, jnp.int64)
+
+    def _f64_image_input(self, batch, kp):
+        """Device copy of the host-computed f64 key image, cached on the
+        batch (re-scanned in-memory sources transfer it once).  Returns
+        None when the column is device-resident (no host bytes to
+        image) — the caller falls back to the exact sort core."""
+        col = batch.data[kp.index]
+        if not isinstance(col, np.ndarray):
+            return None
+        key = ("sort_img", kp.index, None if self.device is None else repr(self.device))
+        hit = batch.cache.get(key)
+        if hit is None:
+            img = _TopKCore.f64_image(col)
+            hit = (
+                jax.device_put(img, self.device)
+                if self.device is not None
+                else jnp.asarray(img)
+            )
+            batch.cache[key] = hit
+        return hit
+
+    def _topk_batches(self, core=None) -> Iterator[RecordBatch]:
+        from datafusion_tpu.exec.batch import device_inputs
+
+        from datafusion_tpu.exec.kernels import fuse_batch_count
+
+        if core is None:
+            core = self.core
+        topk_jit = core.jit
+        k = self._kb  # bucketed state size; self.limit rows come out
+        in_schema = self.child.schema
+        state = None
+        dicts = [None] * len(in_schema)
+        rank_cache: dict = {}
+        wide_f64 = core.wide and self._key_plans[0].kind == "f"
+        fuse = fuse_batch_count()
+        chunk: list = []
+
+        def flush():
+            nonlocal state
+            if not chunk:
+                return
+            with METRICS.timer("execute.sort"), _device_scope(self.device):
+                if len(chunk) == 1:
+                    c = chunk[0]
+                    args = [k, state, c[0], c[1], c[2], c[3], c[4], c[5]]
+                    if core.wide:
+                        args.append(c[6])
+                    state = device_call(topk_jit, *args)
+                else:
+                    state = device_call(core.fused_jit, k, state, tuple(chunk))
+            chunk.clear()
+            # bounded host memory: snapshot the survivors asynchronously
+            # and release batches that no longer hold candidates
+            try:
+                state[1].copy_to_host_async()
+                state[2].copy_to_host_async()
+                prune_q.append((state[1], state[2], len(bases)))
+            except AttributeError:  # non-jax arrays in tests
+                pass
+            try_prune()
+
+        # per-batch bases into one global row-id space; scanned batches
+        # pin until the final gather (payloads come from their host
+        # arrays, bit-exact — the device only ever sees the KEY
+        # columns).  To keep host memory bounded on long scans, each
+        # flush starts an ASYNC pull of the state's row ids; once a
+        # pull completes (checked non-blocking — never a sync on the
+        # link), batches holding no surviving candidates are released.
+        # Safe because the state is monotone: a row absent from the
+        # state at any snapshot can never re-enter it.
+        from collections import deque
+
+        src_batches: list = []
+        bases: list[int] = []
+        next_base = 0
+        prune_q: deque = deque()
+
+        def try_prune():
+            while prune_q:
+                live_a, rows_a, upto = prune_q[0]
+                if not (
+                    getattr(rows_a, "is_ready", lambda: False)()
+                    and getattr(live_a, "is_ready", lambda: False)()
+                ):
+                    return
+                prune_q.popleft()
+                live_h = np.asarray(live_a)
+                rows_h = np.asarray(rows_a)
+                win = rows_h[live_h]
+                keep: set = set()
+                if len(win):
+                    base_arr = np.asarray(bases[:upto], dtype=np.int64)
+                    hit = np.searchsorted(base_arr, win, side="right") - 1
+                    keep = {int(b) for b in np.unique(hit) if 0 <= b < upto}
+                for j in range(upto):
+                    if j not in keep:
+                        src_batches[j] = None
+
+        for batch in self.child.batches():
+            for i, d in enumerate(batch.dicts):
+                if d is not None:
+                    dicts[i] = d
+            rank_tables = []
+            for kp in self._key_plans:
+                if kp.kind != "str":
+                    continue
+                d = batch.dicts[kp.index]
+                ranks = (
+                    self._rank_table(d, rank_cache, kp.index)
+                    if d is not None
+                    else np.zeros(1, np.int32)
+                )
+                rank_tables.append(ranks)
+            img = None
+            if wide_f64:
+                img = self._f64_image_input(batch, self._key_plans[0])
+                if img is None:
+                    # device-resident f64 key: no host bytes to image —
+                    # replay everything through the exact sort core
+                    yield from self._topk_batches(
+                        _TopKCore.build(self._key_plans, force_general=True)
+                    )
+                    return
+            if state is None:
+                state = self._topk_init(k, in_schema, core)
+            with _device_scope(self.device):
+                data, validity, mask = device_inputs(
+                    self._key_view(batch, core), self.device, core.wire_hints
+                )
+            src_batches.append(batch)
+            bases.append(next_base)
+            chunk.append(
+                (data, validity, mask, np.int32(batch.num_rows),
+                 np.int64(next_base), tuple(rank_tables), img)
+            )
+            next_base += batch.capacity
+            if len(chunk) >= fuse:
+                flush()
+        flush()
+        if state is None:
+            yield self._empty_result(in_schema, dicts)
+            return
+        from datafusion_tpu.exec.batch import device_pull
+
+        if core.wide:
+            _, live, rows, flag = state
+            # ONE blob-packed transfer for the whole k-row result
+            live, rows, flag = device_pull((live, rows, flag))
+        else:
+            _, live, rows = state
+            live, rows = device_pull((live, rows))
+        if core.wide and bool(np.asarray(flag)):
+            # an integer key touched the sentinel ladder (values at the
+            # extreme two of the 2^64 range): replay the scan through
+            # the exact sort path — datasources are re-iterable
+            METRICS.add("sort.wide_fallbacks")
+            yield from self._topk_batches(
+                _TopKCore.build(self._key_plans, force_general=True)
+            )
+            return
+        # the live bit separates real rows from dead-key padding when
+        # the scan produced fewer than k rows; the state is bucket-sized,
+        # so slice down to the actual LIMIT
+        take = np.nonzero(np.asarray(live))[0][: self.limit]
+        win = np.asarray(rows)[take]
+        # host payload gather: global row id -> (source batch, local row)
+        base_arr = np.asarray(bases, dtype=np.int64)
+        b_idx = np.searchsorted(base_arr, win, side="right") - 1
+        local = win - base_arr[b_idx]
+        out_cols = []
+        out_valid = []
+        for i in range(len(in_schema)):
+            dt = in_schema.field(i).data_type.np_dtype
+            vals_i = np.empty(len(win), dtype=dt)
+            valid_i = np.ones(len(win), dtype=bool)
+            any_null = False
+            for b in np.unique(b_idx):
+                m = b_idx == b
+                src = src_batches[b]
+                vals_i[m] = np.asarray(src.data[i])[local[m]]
+                if src.validity[i] is not None:
+                    valid_i[m] = np.asarray(src.validity[i])[local[m]]
+                    any_null = True
+            out_cols.append(vals_i)
+            out_valid.append(
+                None if not any_null or bool(valid_i.all()) else valid_i
+            )
+        yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+
+    def _key_view(self, batch: RecordBatch, core) -> RecordBatch:
+        """The batch as TopK kernels see it: only the key columns (the
+        state carries global row ids; payload columns never travel)."""
+        from datafusion_tpu.exec.batch import subset_view
+
+        return subset_view(batch, core.key_cols, tag="topk_key_view")
+
+    def _empty_result(self, in_schema, dicts) -> RecordBatch:
+        cols = [
+            np.empty(0, dtype=in_schema.field(i).data_type.np_dtype)
+            for i in range(len(in_schema))
+        ]
+        return make_host_batch(
+            self._schema, cols, [None] * len(cols), dicts
+        )
+
+    @staticmethod
+    def _rank_table(d, cache: dict, idx: int) -> np.ndarray:
+        key = (idx, d.version)
+        hit = cache.get(key)
+        if hit is None:
+            ranks = d.sort_ranks().astype(np.int32)
+            cap = bucket_capacity(max(len(ranks), 1))
+            padded = np.zeros(cap, np.int32)
+            padded[: len(ranks)] = ranks
+            hit = padded
+            cache[key] = hit
+        return hit
+
+    # -- run sort + host merge path --
+    def _host_keys(self, columns, validity, dicts) -> list[np.ndarray]:
+        keys = []
+        in_schema = self.child.schema
+        for kp, se in zip(self._key_plans, self.sort_expr):
+            idx = kp.index
+            vals = columns[idx]
+            if kp.kind == "str":
+                d = dicts[idx]
+                vals = d.sort_ranks()[vals] if d is not None else vals
+                kind = "i"
+            elif kp.kind == "u64":
+                vals = (
+                    np.ascontiguousarray(vals.astype(np.uint64))
+                    ^ np.uint64(1 << 63)
+                ).view(np.int64)
+                kind = "i"
+            else:
+                kind = kp.kind
+            dead, k = _np_sort_key(vals, validity[idx], kind, se.asc)
+            keys.append(dead)
+            keys.append(k)
+        return keys
+
+    _SORT_RUN_JIT = None
+
+    def _host_run_sort(self, keys: list[np.ndarray], n: int):
+        """Host np.lexsort permutation when the link makes the device
+        round trip unprofitable, or None to use the device.
+
+        The device sort's D2H cost is the permutation itself
+        (~ceil(bits/8) incompressible bytes per row); on a slow link
+        that dwarfs a host lexsort of the same key operands.  Both
+        sorts are stable over identical operands, so the permutations
+        are identical — except for NaN float keys, where numpy (all
+        NaNs last) and XLA's total order (sign-respecting) disagree;
+        any NaN forces the device path."""
+        from datafusion_tpu.exec.batch import _wire_enabled, link_rate_mbps
+
+        if not _wire_enabled(self.device):
+            return None
+        cap = bucket_capacity(n)
+        perm_bytes = n * max(1, ((cap - 1).bit_length() + 7) >> 3)
+        dev_s = perm_bytes / (link_rate_mbps(self.device) * 1e6)
+        host_s = n * _HOST_SORT_SECONDS_PER_ROW * max(len(keys) // 2, 1)
+        if host_s >= dev_s:
+            return None
+        # NaN check last: it is an O(n) pass per float key, and on fast
+        # links the cost model above already routed to the device
+        for j in range(1, len(keys), 2):
+            if keys[j].dtype.kind == "f" and bool(np.isnan(keys[j][:n]).any()):
+                return None
+        METRICS.add("sort.host_routed_runs")
+        # significance: np.lexsort's LAST key is primary — reversing
+        # [dead0, val0, dead1, val1, ...] reproduces the device
+        # operand order (dead flag before value, key 0 outermost)
+        return np.lexsort(tuple(k[:n] for k in reversed(keys))).astype(
+            np.int32
+        )
+
+    def _sorted_run(self, keys: list[np.ndarray], n: int, cache_key=None,
+                    pin=None) -> np.ndarray:
+        """Device-sort one run of n rows; returns the permutation.
+
+        Key operands travel through the compressed wire (one blob put);
+        all-false dead flags — the no-NULLs common case — drop out of
+        the sort entirely (a constant key never reorders anything).
+        The padding convention keeps the flag droppable: when a run has
+        no nulls, padding rows' VALUE keys are +max sentinels, so they
+        sort last without their flag.  `cache_key` stores the uploaded
+        operands in _run_ops_cache (`pin` holds the source batches
+        alive) so a warm re-query skips straight to _sort_ops."""
+        from datafusion_tpu.exec.batch import put_compressed
+
+        host_perm = self._host_run_sort(keys, n)
+        if host_perm is not None:
+            return host_perm
+        cap = bucket_capacity(n)
+        host_ops: list[np.ndarray] = []
+        # keys come as (dead-flag, value) pairs per ORDER BY key
+        for j in range(0, len(keys), 2):
+            dead, val = keys[j], keys[j + 1]
+            has_dead = bool(dead[:n].any())
+            # NaN values sort ABOVE +inf in XLA's total order, so a
+            # +inf padding sentinel cannot sink padding below real NaN
+            # rows — keep the flag in that case
+            nan_risk = val.dtype.kind == "f" and bool(
+                np.isnan(val[:n]).any()
+            )
+            if has_dead or nan_risk:
+                pflag = np.ones(cap, bool)  # padding rows: dead=True
+                pflag[:n] = dead[:n]
+                host_ops.append(pflag)
+                padded = np.zeros(cap, dtype=val.dtype)  # dead tie at 0
+                padded[:n] = val[:n]
+                host_ops.append(padded)
+                continue
+            # no NULLs and no NaNs: the all-false flag is a constant
+            # key — drop it and sink padding via a +max value sentinel
+            # (stability keeps real rows ahead of tying padding)
+            pad = (
+                np.asarray(np.inf, val.dtype)
+                if val.dtype.kind == "f"
+                else np.asarray(np.iinfo(val.dtype).max, val.dtype)
+            )
+            padded = np.full(cap, pad, dtype=val.dtype)
+            padded[:n] = val[:n]
+            host_ops.append(padded)
+        with _device_scope(self.device):
+            dev_ops = tuple(put_compressed(host_ops, self.device))
+        if cache_key is not None:
+            if cache_key in self._run_seen:
+                self._run_ops_cache[cache_key] = (dev_ops, pin)
+                while len(self._run_ops_cache) > self._run_ops_cache_max:
+                    self._run_ops_cache.popitem(last=False)
+            else:
+                self._run_seen[cache_key] = True
+                while len(self._run_seen) > 32:
+                    self._run_seen.popitem(last=False)
+        return self._sort_ops(dev_ops, n)
+
+    def _sort_ops(self, dev_ops, n: int) -> np.ndarray:
+        """Sort device-resident key operands; returns the permutation.
+
+        The permutation crosses D2H as byte planes — ceil(bits/8) bytes
+        per row instead of int32's four (a 1M-row capacity needs 20
+        bits, so 3 planes): D2H bandwidth is the scarce resource and a
+        permutation is incompressible, so shipping only its significant
+        bytes is the available win."""
+        from datafusion_tpu.exec.batch import device_pull
+
+        if SortRelation._SORT_RUN_JIT is None:
+            def run_sort(ops):
+                cap = ops[0].shape[0]
+                iota = jnp.arange(cap, dtype=jnp.int32)
+                out = lax.sort(
+                    tuple(ops) + (iota,), num_keys=len(ops), is_stable=True
+                )
+                perm = out[-1]
+                nbytes = max(1, ((int(cap) - 1).bit_length() + 7) >> 3)
+                return tuple(
+                    ((perm >> (8 * i)) & 0xFF).astype(jnp.uint8)
+                    for i in range(nbytes)
+                )
+
+            SortRelation._SORT_RUN_JIT = jax.jit(run_sort)
+        with _device_scope(self.device):
+            planes = SortRelation._SORT_RUN_JIT(tuple(dev_ops))
+            host_planes = device_pull(tuple(planes))
+        perm = host_planes[0].astype(np.int32)
+        for i in range(1, len(host_planes)):
+            perm |= host_planes[i].astype(np.int32) << np.int32(8 * i)
+        return perm[:n]
+
+    @staticmethod
+    def _merge_runs(run_keys: list[np.ndarray], run_perms: list[np.ndarray]):
+        """Merge sorted runs on host: vectorized two-way merges via
+        structured-array searchsorted (lexicographic on all keys)."""
+
+        def to_struct(keys):
+            # heterogeneous fields (bool dead flags, int64/f64 values);
+            # numpy sorts/searches structured dtypes lexicographically
+            dt = np.dtype([(f"f{i}", k.dtype) for i, k in enumerate(keys)])
+            arr = np.empty(len(keys[0]), dt)
+            for i, k in enumerate(keys):
+                arr[f"f{i}"] = k
+            return arr
+
+        items = [
+            (to_struct(k), p) for k, p in zip(run_keys, run_perms)
+        ]
+        while len(items) > 1:
+            merged = []
+            for i in range(0, len(items) - 1, 2):
+                (ka, pa), (kb, pb) = items[i], items[i + 1]
+                # position of each b-element among a (stable: a first)
+                posb = np.searchsorted(ka, kb, side="left")
+                out_len = len(ka) + len(kb)
+                idxb = posb + np.arange(len(kb))
+                keys = np.empty(out_len, dtype=ka.dtype)
+                perms = np.empty((out_len,) + pa.shape[1:], dtype=pa.dtype)
+                bmask = np.zeros(out_len, dtype=bool)
+                bmask[idxb] = True
+                keys[bmask] = kb
+                keys[~bmask] = ka
+                perms[bmask] = pb
+                perms[~bmask] = pa
+                merged.append((keys, perms))
+            if len(items) % 2:
+                merged.append(items[-1])
+            items = merged
+        return items[0][1]
+
+    def batches(self) -> Iterator[RecordBatch]:
+        if (
+            self.limit is not None
+            and 0 < self.limit <= TOPK_MAX
+        ):
+            yield from self._topk_batches()
+            return
+
+        # full sort: collect per-run host columns, device-sort each run,
+        # merge the runs' keys on host
+        in_schema = self.child.schema
+        run_cols, run_valids, run_perms = [], [], []
+        dicts = [None] * len(in_schema)
+        total = 0
+        pending_cols = None
+        pending_valids = None
+        pending_n = 0
+        run_rows = None
+        run_src: list = []
+
+        def flush_run():
+            nonlocal pending_cols, pending_valids, pending_n, run_src
+            if pending_n == 0:
+                return
+            cols = [np.concatenate(c) for c in pending_cols]
+            valids = [
+                None if all(v is None for v in vs) else np.concatenate(
+                    [
+                        np.ones(len(c), bool) if v is None else v
+                        for v, c in zip(vs, cs)
+                    ]
+                )
+                for vs, cs in zip(pending_valids, pending_cols)
+            ]
+            # cacheable run: unmasked source batches (their live rows
+            # are exactly their content) — key on object identity +
+            # dictionary versions so re-scans of in-memory sources skip
+            # the key encode + H2D entirely
+            cache_key = None
+            if run_src and all(b.mask is None for b in run_src):
+                versions = tuple(
+                    (
+                        dicts[kp.index].version
+                        if dicts[kp.index] is not None
+                        else -1
+                    )
+                    if kp.kind == "str"
+                    else -1
+                    for kp in self._key_plans
+                )
+                cache_key = (tuple(id(b) for b in run_src), versions, pending_n)
+            hit = (
+                self._run_ops_cache.get(cache_key)
+                if cache_key is not None
+                else None
+            )
+            with METRICS.timer("execute.sort"), _device_scope(self.device):
+                if hit is not None:
+                    perm = self._sort_ops(hit[0], len(cols[0]))
+                else:
+                    keys = self._host_keys(cols, valids, dicts)
+                    perm = self._sorted_run(
+                        keys, len(cols[0]), cache_key, tuple(run_src)
+                    )
+            run_cols.append(cols)
+            run_valids.append(valids)
+            run_perms.append(perm)
+            pending_cols = None
+            pending_valids = None
+            pending_n = 0
+            run_src = []
+
+        for batch in iter_with_mask_prefetch(self.child.batches()):
+            for i, d in enumerate(batch.dicts):
+                if d is not None:
+                    dicts[i] = d
+            cols, valids, _, n = compact_batch(batch)
+            if n == 0:
+                continue
+            run_src.append(batch)
+            if run_rows is None:
+                # run size: everything up to SORT_RUN_ROWS sorts in ONE
+                # device launch (a 16M-row 2-key sort buffer is ~350 MB
+                # of HBM — trivial), so the host merge only engages on
+                # scans too large for a single sort; one launch + one
+                # permutation pull beats per-batch-bucket runs on
+                # launch-latency-dominated links
+                import os
+
+                run_rows = max(
+                    bucket_capacity(batch.capacity),
+                    int(os.environ.get(
+                        "DATAFUSION_TPU_SORT_RUN_ROWS", str(1 << 24)
+                    )),
+                )
+            if pending_cols is None:
+                pending_cols = [[] for _ in cols]
+                pending_valids = [[] for _ in cols]
+            for i, c in enumerate(cols):
+                pending_cols[i].append(c[:n])
+                pending_valids[i].append(
+                    None if valids[i] is None else valids[i][:n]
+                )
+            pending_n += n
+            total += n
+            if pending_n >= run_rows:
+                flush_run()
+        flush_run()
+
+        if total == 0:
+            yield self._empty_result(in_schema, dicts)
+            return
+
+        take = total if self.limit is None else min(self.limit, total)
+        if len(run_cols) == 1:
+            perm = run_perms[0][:take]
+            out_cols = [c[perm] for c in run_cols[0]]
+            out_valid = [
+                None if v is None else v[perm] for v in run_valids[0]
+            ]
+            yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+            return
+
+        # multi-run: recompute each run's sorted key arrays under the
+        # FINAL dictionaries (a dictionary that grew mid-scan changes
+        # rank values, but within-run order is rank-version-invariant —
+        # ranks are order-isomorphic to the string values), then merge
+        run_keys = []
+        for ri in range(len(run_cols)):
+            perm = run_perms[ri]
+            sorted_cols = [c[perm] for c in run_cols[ri]]
+            sorted_valids = [
+                None if v is None else v[perm] for v in run_valids[ri]
+            ]
+            run_keys.append(self._host_keys(sorted_cols, sorted_valids, dicts))
+        merged = self._merge_runs(
+            run_keys,
+            [
+                np.stack([np.full(len(p), ri), np.arange(len(p))], axis=1)
+                for ri, p in enumerate(run_perms)
+            ],
+        )[:take]
+        runs = merged[:, 0]
+        rows = merged[:, 1]
+        out_cols = []
+        out_valid = []
+        for i in range(len(in_schema)):
+            parts = np.empty(take, dtype=run_cols[0][i].dtype)
+            vparts = np.ones(take, dtype=bool)
+            any_valid = any(rv[i] is not None for rv in run_valids)
+            for ri in range(len(run_cols)):
+                m = runs == ri
+                if not m.any():
+                    continue
+                sel = run_perms[ri][rows[m]]
+                parts[m] = run_cols[ri][i][sel]
+                if run_valids[ri][i] is not None:
+                    vparts[m] = run_valids[ri][i][sel]
+            out_cols.append(parts)
+            out_valid.append(vparts if any_valid else None)
+        yield make_host_batch(self._schema, out_cols, out_valid, dicts)
+
+
+class LimitRelation(Relation):
+    """Row-limit: stops pulling child batches as soon as enough rows
+    are materialized (reference `Limit` plan, `logicalplan.rs:310-315`)."""
+
+    def __init__(self, child: Relation, limit: int, out_schema: Schema):
+        self.child = child
+        self.limit = limit
+        self._schema = out_schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        remaining = self.limit
+        if remaining <= 0:
+            return
+        # NO mask prefetch here: the early return below exists to avoid
+        # pulling (parsing, dispatching) any batch past the limit, and a
+        # one-ahead prefetch would defeat exactly that
+        for batch in self.child.batches():
+            cols, valids, dicts, n = compact_batch(batch)
+            if n == 0:
+                continue
+            take = min(n, remaining)
+            remaining -= take
+            yield make_host_batch(
+                batch.schema,
+                [c[:take] for c in cols],
+                [None if v is None else v[:take] for v in valids],
+                dicts,
+            )
+            if remaining <= 0:
+                # stop before pulling (and parsing) another child batch
+                return

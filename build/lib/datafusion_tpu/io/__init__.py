@@ -1,0 +1,9 @@
+"""Host-side readers: CSV / NDJSON / Parquet -> padded columnar batches.
+
+The reference's readers came from the external Arrow crate
+(`Cargo.toml:37`; `src/execution/datasource.rs:31-50` wraps
+`arrow::csv::Reader`); here pyarrow plays that external role, with a
+native C++ fast-path reader under native/ replacing it on the hot path.
+Parquet and NDJSON are declared-but-unimplemented in the reference
+(`dfparser.rs:33-34`, README.md:22) — implemented for real here.
+"""

@@ -1,0 +1,140 @@
+"""Process-wide pyarrow confinement threads.
+
+pyarrow's C++ runtime (CSV readahead pool, compute-function registry,
+memory-pool thread caches) is initialised lazily by whichever thread
+first touches it and interacts badly with short-lived threads in this
+environment: scans issued from a churn of fresh threads — exactly what
+`socketserver.ThreadingTCPServer` handler threads are — intermittently
+SIGSEGV inside `pyarrow._csv.open_csv` / `dictionary_encode` after a
+few queries (reproduced under faulthandler; the crash site moves with
+timing, the signature of native state corrupted by thread death, not a
+bug at the faulting line).
+
+The fix is structural, not a retry: every pyarrow call in the process
+runs on a small pool of PERSISTENT IO threads that never die, with the
+pyarrow module imports performed on the pool so all lazy native init
+belongs to long-lived threads.  Each confined generator gets affinity
+to one pool thread (a scan never hops threads mid-stream); distinct
+scans land on distinct threads round-robin, so partitioned scans keep
+parsing in parallel.  Callers submit closures and block for the
+result — `confined_iter` is a synchronous pull, one queue round-trip
+per batch; parse-ahead overlap stays where it always lived, in the
+prefetch producer threads (`exec/prefetch.py`) that do the submitting.
+
+The reference has no analog — its scans are single-threaded Rust on the
+caller's thread (`datasource.rs:31-50`); this is the price of hosting a
+C++ parser runtime inside a threaded Python server.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, Iterator
+
+__all__ = ["run_on_io_thread", "confined_iter"]
+
+_POOL_SIZE = 4
+
+
+class _IoWorker:
+    """One persistent confinement thread with a task queue."""
+
+    def __init__(self, name: str) -> None:
+        self._q: queue.SimpleQueue = queue.SimpleQueue()
+        self._thread: threading.Thread | None = None
+        self._lock = threading.Lock()
+        self._name = name
+
+    def _ensure_started(self) -> None:
+        with self._lock:
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        # Perform the pyarrow imports HERE so every piece of its lazy
+        # native init (thread pools, compute registry, pandas shim)
+        # belongs to a persistent thread.
+        try:
+            import pyarrow  # noqa: F401
+            import pyarrow.compute  # noqa: F401
+            import pyarrow.csv  # noqa: F401
+            import pyarrow.parquet  # noqa: F401
+        except Exception:  # pragma: no cover — pyarrow-less installs
+            pass
+        while True:
+            fn, args, kwargs, done, out = self._q.get()
+            try:
+                out.append(fn(*args, **kwargs))
+                out.append(None)
+            except BaseException as e:  # noqa: BLE001 — re-raised in caller
+                out.append(None)
+                out.append(e)
+            done.set()
+
+    def submit(self, fn: Callable, *args: Any, **kwargs: Any) -> Any:
+        """Run fn(*args, **kwargs) on this worker, blocking for the
+        result.  Re-entrant: calls made FROM the worker run inline (a
+        confined generator may itself call confined helpers)."""
+        if threading.current_thread() is self._thread:
+            return fn(*args, **kwargs)
+        self._ensure_started()
+        done = threading.Event()
+        out: list = []
+        self._q.put((fn, args, kwargs, done, out))
+        done.wait()
+        if out[1] is not None:
+            raise out[1]
+        return out[0]
+
+    def close_quietly(self, gen: Iterator) -> None:
+        """Best-effort generator close on this worker.  Runs during
+        cleanup — possibly from GC at interpreter shutdown, when the
+        daemon thread may already be frozen — so it must never block
+        forever or raise: bounded wait, and skipped entirely when the
+        thread is not running."""
+        t = self._thread
+        if threading.current_thread() is t:
+            gen.close()
+            return
+        if t is None or not t.is_alive():
+            return
+        done = threading.Event()
+        out: list = []
+        self._q.put((gen.close, (), {}, done, out))
+        done.wait(timeout=5.0)
+
+
+_POOL = [_IoWorker(f"df-tpu-io-{i}") for i in range(_POOL_SIZE)]
+_rr = itertools.count()
+
+
+def run_on_io_thread(fn: Callable, *args: Any, **kwargs: Any) -> Any:
+    """One-shot pyarrow call on a confinement thread (round-robined so
+    it doesn't queue behind an in-flight scan step on one worker)."""
+    return _POOL[next(_rr) % _POOL_SIZE].submit(fn, *args, **kwargs)
+
+
+def confined_iter(gen: Iterator) -> Iterator:
+    """Iterate `gen` with every __next__ (and the final close) executed
+    on one pool thread (per-generator affinity; scans never hop threads
+    mid-stream).  One queue round-trip per batch — noise against a
+    100k-row parse."""
+    worker = _POOL[next(_rr) % _POOL_SIZE]
+    _SENTINEL = object()
+
+    def _step():
+        return next(gen, _SENTINEL)
+
+    try:
+        while True:
+            item = worker.submit(_step)
+            if item is _SENTINEL:
+                return
+            yield item
+    finally:
+        worker.close_quietly(gen)

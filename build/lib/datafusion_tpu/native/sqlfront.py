@@ -1,0 +1,148 @@
+"""Bindings for the native SQL front-end and plan IR
+(`native/sql_frontend.cpp` — the C++ equivalent of the reference's
+native parser `dfparser.rs:74` and serde plan IR `logicalplan.rs:133-345`).
+
+`native_parse_sql` returns the same `sql.ast` dataclass tree the Python
+parser builds, so the planner is front-end-agnostic; the numeric
+literal texts ride through JSON as raw strings and are converted here
+(Python ints are unbounded — the native side never narrows them).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+from typing import Optional
+
+from datafusion_tpu.errors import ParserError, PlanError
+from datafusion_tpu.native import load_library
+from datafusion_tpu.sql import ast
+
+
+def _call(lib, fn_name: str, arg: str) -> dict | str:
+    fn = getattr(lib, fn_name)
+    ptr = fn(arg.encode("utf-8"))
+    if not ptr:
+        raise MemoryError(f"{fn_name} returned NULL")
+    try:
+        return ctypes.string_at(ptr).decode("utf-8")
+    finally:
+        lib.dtf_free(ptr)
+
+
+def frontend_available() -> bool:
+    lib = load_library()
+    return lib is not None and hasattr(lib, "dtf_parse_sql")
+
+
+def native_parse_sql(sql: str) -> Optional[ast.SqlNode]:
+    """Parse via the C++ front-end; None when the library is absent or
+    the text needs Python's unicode character classification (the C++
+    tokenizer is byte-oriented: it cannot distinguish a unicode letter
+    from unicode whitespace or digits, so any non-ASCII statement takes
+    the Python parser — identical grammar, exact unicode semantics)."""
+    if not sql.isascii():
+        return None
+    lib = load_library()
+    if lib is None or not hasattr(lib, "dtf_parse_sql"):
+        return None
+    out = json.loads(_call(lib, "dtf_parse_sql", sql))
+    if "error" in out:
+        raise ParserError(out["error"])
+    return _stmt(out["ok"])
+
+
+def native_plan_roundtrip(plan_json: str) -> Optional[str]:
+    """Deserialize a plan into the C++ IR and re-serialize (the wire
+    contract proof); None when the library is absent."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "dtf_plan_roundtrip"):
+        return None
+    out = _call(lib, "dtf_plan_roundtrip", plan_json)
+    if out.startswith('{"error":'):
+        raise PlanError(json.loads(out)["error"])
+    return out
+
+
+def native_plan_repr(plan_json: str) -> Optional[str]:
+    """Pretty-print a serialized plan via the C++ IR (the golden-test
+    format); None when the library is absent."""
+    lib = load_library()
+    if lib is None or not hasattr(lib, "dtf_plan_repr"):
+        return None
+    out = _call(lib, "dtf_plan_repr", plan_json)
+    if out.startswith('{"error":'):
+        raise PlanError(json.loads(out)["error"])
+    return out
+
+
+# -- AST JSON -> sql.ast dataclasses --
+def _stmt(obj) -> ast.SqlNode:
+    ((tag, body),) = obj.items()
+    if tag == "Select":
+        sel = ast.SqlSelect()
+        sel.projection = [_expr(e) for e in body["projection"]]
+        if body["relation"] is not None:
+            sel.relation = ast.SqlIdentifier(body["relation"])
+        if body["selection"] is not None:
+            sel.selection = _expr(body["selection"])
+        sel.group_by = [_expr(e) for e in body["group_by"]]
+        if body["having"] is not None:
+            sel.having = _expr(body["having"])
+        sel.order_by = [
+            ast.SqlOrderByExpr(_expr(o["expr"]), o["asc"]) for o in body["order_by"]
+        ]
+        if body["limit"] is not None:
+            sel.limit = _expr(body["limit"])
+        return sel
+    if tag == "CreateExternalTable":
+        return ast.SqlCreateExternalTable(
+            body["name"],
+            [
+                ast.SqlColumnDef(
+                    c["name"], ast.SqlType(c["type"]), c["allow_null"]
+                )
+                for c in body["columns"]
+            ],
+            ast.FileType(body["file_type"]),
+            body["header_row"],
+            body["location"],
+        )
+    if tag == "Explain":
+        return ast.SqlExplain(_stmt(body))
+    raise ParserError(f"Unknown native AST statement {tag!r}")
+
+
+def _expr(obj) -> ast.SqlNode:
+    if obj == "Wildcard":
+        return ast.SqlWildcard()
+    if obj == "Null":
+        return ast.SqlNullLiteral()
+    ((tag, body),) = obj.items()
+    if tag == "Identifier":
+        return ast.SqlIdentifier(body)
+    if tag == "Long":
+        return ast.SqlLongLiteral(int(body))
+    if tag == "Double":
+        return ast.SqlDoubleLiteral(float(body))
+    if tag == "String":
+        return ast.SqlStringLiteral(body)
+    if tag == "Bool":
+        return ast.SqlBooleanLiteral(body)
+    if tag == "Binary":
+        return ast.SqlBinaryExpr(_expr(body["left"]), body["op"], _expr(body["right"]))
+    if tag == "Unary":
+        return ast.SqlUnary(body["op"], _expr(body["expr"]))
+    if tag == "Cast":
+        return ast.SqlCast(_expr(body["expr"]), ast.SqlType(body["type"]))
+    if tag == "IsNull":
+        return ast.SqlIsNull(_expr(body))
+    if tag == "IsNotNull":
+        return ast.SqlIsNotNull(_expr(body))
+    if tag == "Function":
+        return ast.SqlFunction(body["name"], [_expr(a) for a in body["args"]])
+    if tag == "Nested":
+        return ast.SqlNested(_expr(body))
+    if tag == "Aliased":
+        return ast.SqlAliased(_expr(body["expr"]), body["alias"])
+    raise ParserError(f"Unknown native AST expression {tag!r}")

@@ -1,0 +1,424 @@
+"""Multi-host coordinator: ships plan fragments to worker processes
+and merges their partial results.
+
+This is the distributed mode the reference sketched and disabled
+(etcd membership + HTTP/Arrow-IPC exchange, `scripts/smoketest.sh:30-66`,
+`README.md:33-35`) realized over the engine's own wire format: each
+partition becomes a `PlanFragment` (JSON logical plan +
+DataSourceMeta), a worker runs the fused scan+filter+aggregate kernel
+on its device and returns *partial aggregate state*, and the
+coordinator re-encodes every worker's group keys into its own dense id
+space and combines the accumulators (SUM/COUNT add, MIN/MAX meet, Utf8
+MIN/MAX via the actual strings — worker dictionary codes never leak
+across processes).
+
+Failure handling: the query is the recovery unit (SURVEY §5.3).  A
+fragment whose worker dies (connection refused/reset, mid-query EOF)
+is reassigned to the next live worker; the query fails only when no
+workers remain.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Iterator, Optional, Sequence
+
+import numpy as np
+
+from datafusion_tpu.datatypes import DataType, Schema
+from datafusion_tpu.errors import ExecutionError, PlanError
+from datafusion_tpu.exec.aggregate import AggregateRelation
+from datafusion_tpu.exec.batch import RecordBatch, StringDictionary, make_host_batch
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.relation import Relation
+from datafusion_tpu.parallel.partition import PartitionedDataSource
+from datafusion_tpu.plan.logical import Aggregate
+from datafusion_tpu.parallel.physical import PlanFragment
+from datafusion_tpu.parallel.wire import dec_array, recv_msg, send_msg
+from datafusion_tpu.plan.logical import (
+    LogicalPlan,
+    Projection,
+    Selection,
+    TableScan,
+)
+
+
+class WorkerHandle:
+    """One worker endpoint; lazily (re)connects per use."""
+
+    def __init__(self, host: str, port: int, request_timeout: Optional[float] = None):
+        self.host = host
+        self.port = port
+        self.alive = True
+        # None = wait for the fragment however long it takes; a slow
+        # worker is NOT a dead worker (marking it dead on a response
+        # timeout would replay the fragment elsewhere, time out again,
+        # and cascade to "all workers down")
+        self.request_timeout = request_timeout
+
+    def __repr__(self):
+        return f"worker({self.host}:{self.port}, {'up' if self.alive else 'down'})"
+
+    def request(self, msg: dict, timeout: Optional[float] = -1) -> dict:
+        if timeout == -1:
+            timeout = self.request_timeout
+        with socket.create_connection((self.host, self.port), timeout=10.0) as s:
+            s.settimeout(timeout)
+            send_msg(s, msg)
+            try:
+                out = recv_msg(s)
+            except TimeoutError:
+                # distinguish slow from dead: the connection succeeded,
+                # so surface the deadline instead of failing over
+                raise ExecutionError(
+                    f"worker {self.host}:{self.port} exceeded the "
+                    f"{timeout}s request timeout (raise request_timeout "
+                    "for long fragments)"
+                )
+        if out is None:
+            raise ConnectionError("worker closed the connection")
+        if out.get("type") == "error":
+            raise ExecutionError(f"worker {self.host}:{self.port}: {out['message']}")
+        return out
+
+    def ping(self) -> bool:
+        try:
+            self.alive = self.request({"type": "ping"}, timeout=5.0)["type"] == "pong"
+        except (ConnectionError, OSError, ExecutionError):
+            # unreachable, wedged past the probe deadline, or erroring:
+            # all report as not-healthy rather than crashing the probe
+            self.alive = False
+        return self.alive
+
+    def status(self) -> dict:
+        """Operator introspection: uptime, query/error counts, device,
+        metrics snapshot (the worker web UI the reference planned,
+        delivered over the fragment protocol instead)."""
+        return self.request({"type": "status"}, timeout=10.0)
+
+
+class _SchemaOnlyRelation(Relation):
+    """Zero-batch child used to instantiate the coordinator's template
+    AggregateRelation (it supplies slot/spec machinery + finalize; the
+    actual scanning happens on workers)."""
+
+    def __init__(self, schema: Schema):
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        return iter(())
+
+
+def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
+              request_type: str) -> list[dict]:
+    """Send the fragments to the workers concurrently (round-robin over
+    live workers; one thread per in-flight fragment, so N workers
+    genuinely run N fragments at once), reassigning on connection
+    failure.  Returns one response per fragment."""
+    import itertools
+    from concurrent.futures import ThreadPoolExecutor
+
+    if not workers:
+        raise ExecutionError("no workers configured")
+    rr = itertools.count()
+
+    def run(item):
+        fi, frag = item
+        attempts = 0
+        while True:
+            live = [w for w in workers if w.alive]
+            if not live:
+                raise ExecutionError(
+                    f"all {len(workers)} workers are down "
+                    f"(fragment {fi}/{len(fragments)})"
+                )
+            w = live[next(rr) % len(live)]
+            try:
+                return w.request(
+                    {"type": request_type, "fragment": frag.to_json_str()}
+                )
+            except (ConnectionError, OSError):
+                # connect refused/reset or mid-query EOF: the query is
+                # the recovery unit — mark the worker dead and replay
+                # this fragment elsewhere.  (A response *timeout* is an
+                # ExecutionError, not a failover: slow != dead.)
+                w.alive = False
+                attempts += 1
+                if attempts > len(workers):
+                    raise ExecutionError("fragment reassignment exhausted")
+
+    with ThreadPoolExecutor(max_workers=min(len(fragments) or 1, 32)) as ex:
+        return list(ex.map(run, enumerate(fragments)))
+
+
+class DistributedAggregateRelation(Relation):
+    """[Selection +] Aggregate over partitions executed by remote
+    workers; the coordinator merges partial states by *key*."""
+
+    def __init__(self, plan, agg, pred, scan, ds: PartitionedDataSource,
+                 workers: list[WorkerHandle], functions=None):
+        in_schema = scan.schema
+        self.template = AggregateRelation(
+            _SchemaOnlyRelation(in_schema),
+            agg.group_expr,
+            agg.aggr_expr,
+            agg.schema,
+            predicate=pred,
+            functions=functions,
+        )
+        self.plan = plan
+        self.ds = ds
+        self.workers = workers
+        self.in_schema = in_schema
+
+    @property
+    def schema(self) -> Schema:
+        return self.template.schema
+
+    def _fragments(self) -> list[PlanFragment]:
+        n = len(self.ds.partitions)
+        plan_json = self.plan.to_json()
+        return [
+            PlanFragment(i, n, plan_json, p.to_meta())
+            for i, p in enumerate(self.ds.partitions)
+        ]
+
+    def batches(self) -> Iterator[RecordBatch]:
+        t = self.template
+        responses = _dispatch(self.workers, self._fragments(), "execute_fragment")
+
+        n_keys = len(t.key_cols)
+        global_agg = n_keys == 0
+        counts = np.zeros(1 if global_agg else 0, np.int64)
+        accs = [
+            np.full(
+                1 if global_agg else 0,
+                t._slot_identity(sl),
+                dtype=np.dtype(t._slot_identity(sl).dtype),
+            )
+            for sl in t.slots
+        ]
+        # Utf8 MIN/MAX merges on the strings themselves (worker codes
+        # are process-local); best[s] holds the current best string per
+        # group, converted to coordinator codes at the end (length 1 up
+        # front for the global-aggregate single group)
+        best_str: dict[int, list] = {
+            i: ([None] if global_agg else [])
+            for i, sl in enumerate(t.slots)
+            if sl.is_string
+        }
+        key_dicts: dict[int, StringDictionary] = {}
+
+        def grow(n_groups: int):
+            nonlocal counts
+            pad = n_groups - len(counts)
+            if pad <= 0:
+                return
+            counts = np.concatenate([counts, np.zeros(pad, np.int64)])
+            for i, sl in enumerate(t.slots):
+                ident = t._slot_identity(sl)
+                accs[i] = np.concatenate(
+                    [accs[i], np.full(pad, ident, dtype=accs[i].dtype)]
+                )
+            for s in best_str:
+                best_str[s].extend([None] * pad)
+
+        for resp in responses:
+            g = resp["num_groups"]
+            if g == 0:
+                continue  # empty partition: nothing to merge
+            w_counts = dec_array(resp["counts"])
+            w_slots = [dec_array(s) for s in resp["slots"]]
+            if global_agg:
+                ids = np.zeros(g, np.int64)
+            else:
+                key_rows = dec_array(resp["key_rows"])  # (g, 2K) int64
+                cols, valids = [], []
+                for k, idx in enumerate(t.key_cols):
+                    vals = key_rows[:, 2 * k].copy()
+                    isnull = key_rows[:, 2 * k + 1] != 0
+                    wdict = resp["key_dicts"].get(str(k))
+                    if self.in_schema.field(idx).data_type == DataType.UTF8:
+                        d = key_dicts.setdefault(idx, StringDictionary())
+                        t._key_dicts[idx] = d
+                        if wdict:
+                            lut = np.fromiter(
+                                (d.add(s) for s in wdict), np.int64, len(wdict)
+                            )
+                            in_range = (vals >= 0) & (vals < len(lut))
+                            vals = np.where(in_range, lut[np.clip(vals, 0, len(lut) - 1)], 0)
+                    cols.append(vals)
+                    valids.append(None if not isnull.any() else ~isnull)
+                ids = t.encoder.encode(cols, valids).astype(np.int64)
+                grow(t.encoder.num_groups)
+
+            np.add.at(counts, ids, w_counts)
+            for i, sl in enumerate(t.slots):
+                w = w_slots[i]
+                if sl.kind in ("sum", "cnt"):
+                    np.add.at(accs[i], ids, w.astype(accs[i].dtype))
+                elif sl.kind == "min":
+                    np.minimum.at(accs[i], ids, w.astype(accs[i].dtype))
+                elif sl.kind == "max":
+                    np.maximum.at(accs[i], ids, w.astype(accs[i].dtype))
+                else:  # smin / smax: compare actual strings
+                    values = resp["slot_dicts"].get(str(i)) or []
+                    bl = best_str[i]
+                    for gi, code in zip(ids.tolist(), w.tolist()):
+                        if code < 0 or code >= len(values):
+                            continue
+                        s = values[code]
+                        cur = bl[gi]
+                        if cur is None or (
+                            s < cur if sl.kind == "smin" else s > cur
+                        ):
+                            bl[gi] = s
+
+        # convert best strings to coordinator dictionary codes so the
+        # standard finalize path decodes them
+        for i, bl in best_str.items():
+            d = StringDictionary()
+            t._str_dicts[i] = d
+            accs[i] = np.asarray(
+                [-1 if s is None else d.add(s) for s in bl], np.int32
+            )
+
+        yield t.finalize((counts, tuple(accs)))
+
+
+class DistributedUnionRelation(Relation):
+    """Projection/Selection fragments over partitions, executed by
+    workers; the coordinator unions the returned rows (parallel scans,
+    not only aggregates)."""
+
+    def __init__(self, plan, ds: PartitionedDataSource, workers: list[WorkerHandle]):
+        self.plan = plan
+        self.ds = ds
+        self.workers = workers
+        self._schema = plan.schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def batches(self) -> Iterator[RecordBatch]:
+        n = len(self.ds.partitions)
+        plan_json = self.plan.to_json()
+        fragments = [
+            PlanFragment(i, n, plan_json, p.to_meta())
+            for i, p in enumerate(self.ds.partitions)
+        ]
+        responses = _dispatch(self.workers, fragments, "execute_plan")
+        dicts: list[Optional[StringDictionary]] = [
+            StringDictionary() if f.data_type == DataType.UTF8 else None
+            for f in self._schema.fields
+        ]
+        for resp in responses:
+            if resp["num_rows"] == 0:
+                continue
+            cols = []
+            for i, f in enumerate(self._schema.fields):
+                c = resp["columns"][i]
+                if f.data_type == DataType.UTF8:
+                    # codes + value table (codes ride the binary frame);
+                    # remap the worker-local codes into OUR dictionary
+                    codes = dec_array(c["codes"])
+                    cols.append(dicts[i].merge_codes(codes, c["values"]))
+                else:
+                    cols.append(dec_array(c).astype(f.data_type.np_dtype))
+            valids = [
+                None if v is None else dec_array(v)
+                for v in resp["validity"]
+            ]
+            yield make_host_batch(self._schema, cols, valids, list(dicts))
+
+
+def _match_shippable_aggregate(plan: LogicalPlan, datasources: dict):
+    """Aggregate[(Selection)](TableScan over a partitioned table) —
+    the fragment shape workers execute wholesale."""
+    if not isinstance(plan, Aggregate):
+        return None, None, None
+    inner = plan.input
+    pred = None
+    if isinstance(inner, Selection):
+        pred = inner.expr
+        inner = inner.input
+    if not isinstance(inner, TableScan):
+        return None, None, None
+    if not isinstance(datasources.get(inner.table_name), PartitionedDataSource):
+        return None, None, None
+    return plan, pred, inner
+
+
+def _match_distributed_pipeline(plan: LogicalPlan, datasources: dict):
+    """Projection/Selection chains over a partitioned serializable
+    table — shippable as row-returning fragments."""
+    node = plan
+    while isinstance(node, (Projection, Selection)):
+        node = node.input
+    if not isinstance(node, TableScan):
+        return None
+    ds = datasources.get(node.table_name)
+    if not isinstance(ds, PartitionedDataSource):
+        return None
+    return ds
+
+
+class DistributedContext(ExecutionContext):
+    """ExecutionContext that executes partitioned queries on remote
+    worker processes (`python -m datafusion_tpu.worker`)."""
+
+    def __init__(
+        self,
+        workers: Sequence[tuple[str, int]],
+        batch_size: int = 131072,
+        request_timeout: Optional[float] = None,
+    ):
+        super().__init__(device=None, batch_size=batch_size)
+        self.workers = [WorkerHandle(h, p, request_timeout) for h, p in workers]
+
+    def ping_workers(self) -> dict[str, bool]:
+        """Liveness probe (the heartbeat the reference's etcd scheme
+        implied, `smoketest.sh:41-54`)."""
+        return {f"{w.host}:{w.port}": w.ping() for w in self.workers}
+
+    def worker_status(self) -> dict[str, Optional[dict]]:
+        """Per-worker introspection snapshot (None for unreachable
+        workers)."""
+        out: dict[str, Optional[dict]] = {}
+        for w in self.workers:
+            try:
+                out[f"{w.host}:{w.port}"] = w.status()
+            except (ConnectionError, OSError, ExecutionError):
+                out[f"{w.host}:{w.port}"] = None
+        return out
+
+    def execute(self, plan: LogicalPlan) -> Relation:
+        # unlike the single-host mesh matcher this one keeps Utf8
+        # MIN/MAX: the coordinator merges actual strings, so worker-local
+        # dictionary codes never need a shared rank table
+        agg, pred, scan = _match_shippable_aggregate(plan, self.datasources)
+        if agg is not None:
+            ds = self.datasources[scan.table_name]
+            if scan.projection is not None:
+                ds = ds.with_projection(scan.projection)
+            try:
+                ds.to_meta()  # fragments must be serializable
+            except PlanError:
+                return super().execute(plan)
+            return DistributedAggregateRelation(
+                plan, agg, pred, scan, ds, self.workers,
+                functions=self._jax_functions(),
+            )
+        ds = _match_distributed_pipeline(plan, self.datasources)
+        if ds is not None:
+            try:
+                ds.to_meta()
+            except PlanError:
+                return super().execute(plan)
+            return DistributedUnionRelation(plan, ds, self.workers)
+        return super().execute(plan)

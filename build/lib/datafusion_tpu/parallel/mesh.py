@@ -1,0 +1,60 @@
+"""Device-mesh construction and multi-host bring-up.
+
+Replaces the reference's planned etcd-based cluster membership
+(`scripts/smoketest.sh:41-54`): JAX's distributed runtime handles
+membership/liveness, and the mesh + named axis is the addressing scheme
+workers were going to get from etcd.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+MESH_AXIS = "shards"
+
+
+def mesh_axis() -> str:
+    return MESH_AXIS
+
+
+def make_mesh(n_devices: Optional[int] = None, devices=None):
+    """A 1-D mesh over the partition axis.
+
+    Queries are data-parallel over row partitions (the only parallelism
+    axis the reference's design has — SURVEY §2), so one named axis is
+    the right shape.  `n_devices=None` uses every visible device.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devs):
+            from datafusion_tpu.errors import ExecutionError
+
+            raise ExecutionError(
+                f"requested mesh of {n_devices} devices, only {len(devs)} visible"
+            )
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (MESH_AXIS,))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Multi-host bring-up: `jax.distributed.initialize` (the etcd
+    replacement).  After this, `jax.devices()` spans all hosts and
+    `make_mesh()` builds a global mesh whose collectives ride ICI
+    within a slice and DCN across slices.  No-op arguments defer to
+    JAX's environment auto-detection (TPU pods populate them)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,156 @@
+"""Coordinator<->worker wire protocol.
+
+The reference planned HTTP + Arrow IPC between console and worker nodes
+(`README.md:33`, worker image EXPOSE 8080 in
+`scripts/docker/worker/Dockerfile`); here the transport is a
+length-prefixed frame over TCP.  Control payloads (plan fragments)
+keep their JSON wire format (`logicalplan.rs:609-648`'s contract);
+bulk array payloads travel as RAW little-endian binary segments after
+the JSON — base64-in-JSON cost +33% bytes plus an encode/parse pass on
+the result-shipping path.
+
+Frame layouts (after the 8-byte big-endian frame length):
+- legacy:   UTF-8 JSON (first byte '{') — still accepted and still
+  emitted for messages carrying no bulk arrays.
+- binary:   0x01 | u32 json_len | JSON | raw segments back-to-back.
+  The JSON's "_bins" key lists segment byte lengths in order; array
+  nodes reference segments as {"dtype", "shape", "bin": i}.  Tiny
+  arrays stay inline base64 — a segment's framing overhead outweighs
+  its bytes below ~256 B.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import struct
+from typing import Optional
+
+import numpy as np
+
+from datafusion_tpu.errors import ExecutionError
+
+import os
+
+_LEN = struct.Struct(">Q")
+_U32 = struct.Struct(">I")
+_TAG_BIN = 0x01
+MAX_FRAME = 1 << 32
+# arrays at or under this many bytes stay inline base64 (segment
+# framing overhead outweighs the bytes); the env knob exists for
+# protocol A/B measurements
+INLINE_MAX = int(os.environ.get("DATAFUSION_TPU_WIRE_INLINE", 256))
+
+
+class BinWriter:
+    """Collects bulk array segments for one outgoing message as
+    zero-copy buffer views (the views pin their source arrays)."""
+
+    __slots__ = ("chunks",)
+
+    def __init__(self) -> None:
+        self.chunks: list = []  # buffer-protocol objects
+
+
+def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None) -> None:
+    if bw is not None and bw.chunks:
+        sizes = [memoryview(c).nbytes for c in bw.chunks]
+        obj = dict(obj)
+        obj["_bins"] = sizes
+        data = json.dumps(obj).encode("utf-8")
+        frame_len = 1 + _U32.size + len(data) + sum(sizes)
+        sock.sendall(
+            _LEN.pack(frame_len) + bytes([_TAG_BIN]) + _U32.pack(len(data)) + data
+        )
+        # segments stream straight from the source arrays — no
+        # intermediate frame buffer, no per-array tobytes copy
+        for c in bw.chunks:
+            sock.sendall(c)
+        return
+    data = json.dumps(obj).encode("utf-8")
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
+    # returns the bytearray itself (no bytes() copy): binary segments
+    # become writable zero-copy views into the frame buffer
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf.extend(chunk)
+    return buf
+
+
+def _attach_bins(node, bins: list) -> None:
+    """Resolve {"bin": i} array nodes to their binary segments (stored
+    under "_buf" for dec_array)."""
+    if isinstance(node, dict):
+        if "bin" in node and "dtype" in node:
+            node["_buf"] = bins[node["bin"]]
+            return
+        for v in node.values():
+            _attach_bins(v, bins)
+    elif isinstance(node, list):
+        for v in node:
+            _attach_bins(v, bins)
+
+
+def recv_msg(sock: socket.socket) -> Optional[dict]:
+    """One frame, or None on clean EOF."""
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    if n > MAX_FRAME:
+        raise ExecutionError(f"frame of {n} bytes exceeds protocol limit")
+    data = _recv_exact(sock, n)
+    if data is None:
+        # ConnectionError (not ExecutionError): a peer dying mid-frame
+        # is a transport failure, and the coordinator's failover
+        # handler keys on ConnectionError/OSError
+        raise ConnectionError("connection closed mid-frame")
+    if data[:1] == bytes([_TAG_BIN]):
+        (json_len,) = _U32.unpack(data[1 : 1 + _U32.size])
+        body_off = 1 + _U32.size
+        obj = json.loads(data[body_off : body_off + json_len].decode("utf-8"))
+        blob = memoryview(data)[body_off + json_len :]
+        bins = []
+        off = 0
+        for size in obj.get("_bins", []):
+            bins.append(blob[off : off + size])
+            off += size
+        _attach_bins(obj, bins)
+        return obj
+    return json.loads(data.decode("utf-8"))
+
+
+def enc_array(a: np.ndarray, bw: Optional[BinWriter] = None) -> dict:
+    a = np.ascontiguousarray(a)
+    if bw is not None and a.nbytes > INLINE_MAX:
+        idx = len(bw.chunks)
+        bw.chunks.append(memoryview(a).cast("B"))  # zero-copy, pins `a`
+        return {"dtype": a.dtype.str, "shape": list(a.shape), "bin": idx}
+    return {
+        "dtype": a.dtype.str,  # byte-order explicit ('<i8', '|b1', ...)
+        "shape": list(a.shape),
+        "data": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def dec_array(o: dict) -> np.ndarray:
+    if "bin" in o:
+        # zero-copy: a writable view into the received frame buffer
+        # (segments are disjoint, and the buffer lives as long as the
+        # arrays reference it)
+        return np.frombuffer(o["_buf"], dtype=np.dtype(o["dtype"])).reshape(
+            o["shape"]
+        )
+    raw = base64.b64decode(o["data"])
+    return (
+        np.frombuffer(raw, dtype=np.dtype(o["dtype"]))
+        .reshape(o["shape"])
+        .copy()  # frombuffer is read-only; combiners mutate
+    )

@@ -241,52 +241,12 @@ class WorkerServer(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
-def serve_http_status(state: WorkerState, host: str, port: int):
-    """Human-facing HTTP status endpoint: `GET /status` (also `/` and
-    `/healthz`) returns the same JSON the fragment protocol's
-    `{"type": "status"}` request does.  The reference's worker image
-    EXPOSEd 8080 for a web UI that never shipped
-    (`scripts/docker/worker/Dockerfile`); this is the working minimum —
-    curl-able by an operator, scrapeable by a probe."""
-    import json
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-
-    class _StatusHandler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            if self.path.split("?")[0] not in ("/", "/status", "/healthz"):
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = json.dumps(state.status()).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *args):  # quiet: one line per probe scrape
-            pass
-
-    srv = ThreadingHTTPServer((host, port), _StatusHandler)
-    threading.Thread(
-        target=srv.serve_forever, name="df-tpu-worker-http", daemon=True
-    ).start()
-    return srv
-
-
-def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
-          http_port: Optional[int] = None):
+def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072):
     """Run a worker; returns (server, thread) for embedding, or call
-    serve_forever via the CLI entry (python -m datafusion_tpu.worker).
-    `http_port` (non-zero) additionally serves GET /status on the same
-    host."""
+    serve_forever via the CLI entry (python -m datafusion_tpu.worker)."""
     host, _, port = bind.partition(":")
     server = WorkerServer((host, int(port or 0)), _Handler)
     server.worker_state = WorkerState(device=device, batch_size=batch_size)  # type: ignore[attr-defined]
-    if http_port:
-        server.http_server = serve_http_status(  # type: ignore[attr-defined]
-            server.worker_state, host, http_port
-        )
     return server
 
 
@@ -302,12 +262,6 @@ def main(argv=None) -> int:
     ap.add_argument("--device", default=None,
                     help="execution device: cpu | tpu (default: jax default)")
     ap.add_argument("--batch-size", type=int, default=131072)
-    # default OFF: several workers commonly share one host (tests, the
-    # compose cluster maps container-internal 8080s to distinct host
-    # ports); the worker image turns it on explicitly
-    ap.add_argument("--http-port", type=int, default=0,
-                    help="HTTP GET /status port (default 0 = disabled; "
-                         "the worker image passes 8080)")
     # multi-host accelerator bring-up (jax.distributed — the etcd
     # replacement, SURVEY §5.8): workers on a TPU pod join one global
     # mesh before serving fragments
@@ -340,12 +294,9 @@ def main(argv=None) -> int:
             f"{jax.process_count()}, global devices {jax.device_count()}",
             flush=True,
         )
-    server = serve(args.bind, device=args.device, batch_size=args.batch_size,
-                   http_port=args.http_port)
+    server = serve(args.bind, device=args.device, batch_size=args.batch_size)
     host, port = server.server_address[:2]
     print(f"worker listening on {host}:{port}", flush=True)
-    if args.http_port:
-        print(f"worker status: http://{host}:{args.http_port}/status", flush=True)
     from datafusion_tpu.native import native_available
 
     print(

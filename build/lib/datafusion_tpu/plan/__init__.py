@@ -1,0 +1,2 @@
+"""Logical/physical plan IR (mirror of reference `src/logicalplan.rs`
+and `src/execution/physicalplan.rs`, redesigned for a TPU backend)."""

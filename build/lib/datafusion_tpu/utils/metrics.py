@@ -1,0 +1,60 @@
+"""Per-stage timing and counters.
+
+The reference's only observability is a wall-clock `Instant` in the
+console (`src/bin/console/main.rs:133`) and a `println!` of the plan
+(`context.rs:104`).  Here every query records parse/plan/optimize/
+compile/execute stage timings plus engine counters (rows scanned,
+bytes H2D, jit cache activity) — queryable via
+`ExecutionContext.metrics()` and printed by the CLI's `\\timing` mode.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class Metrics:
+    def __init__(self):
+        self.timings: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+
+    def reset(self):
+        self.timings.clear()
+        self.counts.clear()
+
+    @contextmanager
+    def timer(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.timings[name] += time.perf_counter() - t0
+
+    def add(self, name: str, n: int = 1):
+        self.counts[name] += n
+
+    def timed_iter(self, name: str, it):
+        """Wrap a generator so time spent *producing* items (host parse,
+        encode) accrues to `name`, while consumer time doesn't."""
+        while True:
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                return
+            finally:
+                self.timings[name] += time.perf_counter() - t0
+            yield item
+
+    def snapshot(self) -> dict:
+        return {
+            "timings_s": dict(self.timings),
+            "counts": dict(self.counts),
+        }
+
+
+# process-wide registry (a query engine, not a training loop: contention
+# is nil and the reference used a global println anyway)
+METRICS = Metrics()

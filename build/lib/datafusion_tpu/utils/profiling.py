@@ -1,0 +1,36 @@
+"""XLA profiler integration (SURVEY §5.1).
+
+The reference's only observability is a console wall clock
+(`src/bin/console/main.rs:133`); this engine already records per-stage
+timers and counters (utils/metrics.py, CLI `\\timing`).  For
+kernel-level analysis, `trace(dir)` wraps a block in the JAX/XLA
+profiler — the resulting TensorBoard trace shows each fused query
+kernel, its device occupancy, and transfer timelines:
+
+    from datafusion_tpu.utils.profiling import trace
+    with trace("/tmp/q1_profile"):
+        ctx.sql_collect(sql)
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+
+@contextmanager
+def trace(log_dir: str):
+    """Profile a block; writes a TensorBoard-loadable XLA trace."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named sub-span inside a trace (shows up on the host timeline)."""
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,51 @@
+"""Transient device-failure retry.
+
+Tunneled/remote accelerators (and remote XLA compile services) can
+drop a request mid-flight; the reference never faced this (CPU-only),
+but SURVEY §5.3 names failure detection/recovery as a rebuild target
+and the query engine's natural recovery unit is the *device call*:
+dispatches are functionally pure (accumulator state in, state out), so
+a failed call simply replays.  Genuine programming errors (trace
+errors, shape mismatches) are not transient and re-raise immediately.
+"""
+
+from __future__ import annotations
+
+import time
+
+from datafusion_tpu.utils.metrics import METRICS
+
+_TRANSIENT_MARKERS = (
+    "read body",
+    "response body closed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "deadline exceeded",
+    "unavailable",
+    "socket closed",
+    "transport",
+    "remote_compile",
+)
+_ATTEMPTS = 3
+_BACKOFF_S = 2.0
+
+
+def is_transient(err: Exception) -> bool:
+    msg = str(err).lower()
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def device_call(fn, /, *args, **kwargs):
+    """Invoke a (pure) device computation, replaying on transient
+    runtime failures with linear backoff."""
+    for attempt in range(_ATTEMPTS):
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:  # jax.errors.JaxRuntimeError and kin
+            if type(e).__name__ not in (
+                "JaxRuntimeError", "XlaRuntimeError", "InternalError"
+            ) or not is_transient(e) or attempt == _ATTEMPTS - 1:
+                raise
+            METRICS.add("device.transient_retries")
+            time.sleep(_BACKOFF_S * (attempt + 1))

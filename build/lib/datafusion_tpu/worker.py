@@ -1,0 +1,11 @@
+"""`python -m datafusion_tpu.worker` — the worker-node entry point the
+reference planned but never built (worker binary commented out of
+`Cargo.toml:25-27`; its docker image expects `/opt/datafusion/bin/worker`,
+`scripts/docker/worker/Dockerfile`).  See parallel/worker.py."""
+
+import sys
+
+from datafusion_tpu.parallel.worker import main
+
+if __name__ == "__main__":
+    sys.exit(main())

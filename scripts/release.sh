@@ -27,4 +27,53 @@ for ex in examples/*.py; do
   python "${ex}" > /dev/null
 done
 
+# version in pyproject.toml must match the package (Cargo.toml keeps
+# these in one place; here there are two, so the script enforces it)
+PYPROJECT_VERSION=$(python - <<'EOF'
+import tomllib
+print(tomllib.load(open("pyproject.toml", "rb"))["project"]["version"])
+EOF
+)
+if [ "${VERSION}" != "${PYPROJECT_VERSION}" ]; then
+  echo "version mismatch: __version__=${VERSION} pyproject=${PYPROJECT_VERSION}" >&2
+  exit 1
+fi
+
+# build the wheel (the publish half of the reference's release.sh:
+# cargo package/publish -> pip wheel; the C++ runtime ships inside the
+# package when built)
+rm -rf dist
+# the package-local .so copy MUST be transient: the loader prefers it
+# over repo-root native/ builds, so a leftover would silently shadow
+# every future `make -C native` (cleanup runs even when pip fails)
+trap 'rm -f datafusion_tpu/native/libdatafusion_native.so' EXIT
+cp -f native/libdatafusion_native.so datafusion_tpu/native/ 2>/dev/null || true
+python -m pip wheel . --no-deps --no-build-isolation -w dist
+rm -f datafusion_tpu/native/libdatafusion_native.so
+WHEEL=$(ls dist/datafusion_tpu-*.whl)
+echo "Built ${WHEEL}"
+
+# smoke-install into a clean prefix and run a query OUTSIDE the repo
+# (proves the artifact stands alone: console script, readers, engine;
+# a --prefix install keeps the environment's jax/numpy visible without
+# network access, which a from-scratch venv would need)
+SMOKE=$(mktemp -d)
+python -m pip install --no-deps --no-index --prefix "${SMOKE}/prefix" "${WHEEL}" -q
+SITE=$(ls -d "${SMOKE}"/prefix/lib/python*/site-packages)
+cat > "${SMOKE}/q.sql" <<EOF
+CREATE EXTERNAL TABLE cities (city VARCHAR(100), lat DOUBLE, lng DOUBLE)
+STORED AS CSV WITHOUT HEADER ROW LOCATION '$(pwd)/test/data/uk_cities.csv';
+SELECT city, lat FROM cities WHERE lat > 54.0;
+EOF
+# `|| :`: grep -c exits 1 on zero matches, which under pipefail would
+# kill the script before the explicit row-count diagnostic below
+ROWS=$(cd "${SMOKE}" && JAX_PLATFORMS=cpu PYTHONPATH="${SITE}" \
+  "${SMOKE}/prefix/bin/datafusion-tpu" --script q.sql | { grep -c "UK\|the UK" || :; })
+if [ "${ROWS}" -ne 7 ]; then
+  echo "wheel smoke test: expected 7 rows, got ${ROWS}" >&2
+  exit 1
+fi
+rm -rf "${SMOKE}"
+echo "WHEEL SMOKE TEST PASSED"
+
 echo "RELEASE CHECKS PASSED (tag with: git tag ${VERSION})"

@@ -451,3 +451,117 @@ class TestWorkerStatus:
     def test_status_of_dead_worker_is_none(self):
         dctx = DistributedContext([("127.0.0.1", 1)])
         assert dctx.worker_status() == {"127.0.0.1:1": None}
+
+
+class TestWorkerHttpStatus:
+    """GET /status on the worker's HTTP port returns the same JSON the
+    fragment protocol's status request does (the human/probe surface;
+    reference worker image EXPOSEd 8080 for it)."""
+
+    def test_http_status_roundtrip(self):
+        import json
+        import threading
+        import urllib.request
+
+        from datafusion_tpu.parallel.worker import serve
+
+        server = serve("127.0.0.1:0", device="cpu", http_port=0)
+        # pick a free HTTP port by binding port 0 through the helper
+        from datafusion_tpu.parallel.worker import serve_http_status
+
+        http = serve_http_status(server.worker_state, "127.0.0.1", 0)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            port = http.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=10
+            ) as resp:
+                assert resp.status == 200
+                body = json.loads(resp.read())
+            assert body["type"] == "status"
+            assert body["queries"] == 0
+            assert "devices" in body and "metrics" in body
+            # healthz alias answers too; unknown paths 404
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10
+            ) as resp:
+                assert resp.status == 200
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10
+                )
+                raise AssertionError("expected 404")
+            except urllib.error.HTTPError as e:
+                assert e.code == 404
+        finally:
+            http.shutdown()
+            server.shutdown()
+            server.server_close()
+
+
+class TestThreadedRuntimeStress:
+    """Race-hammer the PYTHON-threaded host runtime — the layer the
+    native TSan job cannot see (scripts/tsan_check.sh covers only the
+    C++ reader/parser; ci.yml documents that scope).  Concurrently:
+    prefetch producer threads (DATAFUSION_TPU_PREFETCH=1 forces the
+    staged pipeline on CPU), the coordinator's dispatch pool, and the
+    workers' socketserver handler threads — many queries in flight from
+    many client threads, with faulthandler armed so a deadlock dumps
+    stacks instead of hanging CI."""
+
+    def test_concurrent_distributed_and_local_queries(
+        self, tmp_path, workers, monkeypatch
+    ):
+        import faulthandler
+        import threading
+
+        faulthandler.dump_traceback_later(240, exit=True)
+        try:
+            monkeypatch.setenv("DATAFUSION_TPU_PREFETCH", "1")
+            _, addrs = workers
+            paths = _write_partitions(tmp_path, n_parts=3, rows_per=400)
+            sqls = [
+                "SELECT region, SUM(v), COUNT(1), AVG(x) FROM t GROUP BY region",
+                "SELECT COUNT(1), SUM(v), MIN(x) FROM t WHERE v > 0",
+                "SELECT region, v + 1, x FROM t WHERE v > 500",
+                "SELECT MIN(city), MAX(city), COUNT(city) FROM t",
+            ]
+            # reference answers, computed single-threaded first
+            lctx_ref = _contexts(addrs, paths)[1]
+            want = {sql: _rows(lctx_ref, sql) for sql in sqls}
+
+            errors: list = []
+
+            def hammer(kind: str, rounds: int):
+                try:
+                    for i in range(rounds):
+                        dctx, lctx = _contexts(addrs, paths)
+                        ctx = dctx if kind == "dist" else lctx
+                        sql = sqls[i % len(sqls)]
+                        got = _rows(ctx, sql)
+                        if got != want[sql]:
+                            errors.append((kind, sql, "mismatch"))
+                except Exception as e:  # noqa: BLE001 — collected for the assert
+                    errors.append((kind, type(e).__name__, str(e)[:300]))
+
+            threads = [
+                threading.Thread(target=hammer, args=("dist", 6), daemon=True)
+                for _ in range(3)
+            ] + [
+                threading.Thread(target=hammer, args=("local", 6), daemon=True)
+                for _ in range(3)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=220)
+                assert not t.is_alive(), "stress thread hung"
+            assert not errors, errors
+            # workers survived the barrage and still answer
+            from datafusion_tpu.parallel.coordinator import WorkerHandle
+
+            for host, port in addrs:
+                assert WorkerHandle(host, port).ping()
+        finally:
+            faulthandler.cancel_dump_traceback_later()

@@ -1330,3 +1330,19 @@ class TestAdaptivePlacement:
         assert node._placement and node._placement.core is None
         want = self._rows(self._fresh_ctx(ctx), sql)
         self._assert_same(got, want)
+
+
+def test_package_version_in_sync():
+    """pyproject.toml's version must match datafusion_tpu.__version__
+    (two declarations where the reference's Cargo.toml has one)."""
+    import tomllib
+
+    import datafusion_tpu
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "pyproject.toml"), "rb") as fh:
+        meta = tomllib.load(fh)
+    assert meta["project"]["version"] == datafusion_tpu.__version__
+    scripts = meta["project"]["scripts"]
+    assert scripts["datafusion-tpu"] == "datafusion_tpu.cli:main"
+    assert scripts["datafusion-tpu-worker"] == "datafusion_tpu.parallel.worker:main"
