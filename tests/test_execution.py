@@ -1346,3 +1346,55 @@ def test_package_version_in_sync():
     scripts = meta["project"]["scripts"]
     assert scripts["datafusion-tpu"] == "datafusion_tpu.cli:main"
     assert scripts["datafusion-tpu-worker"] == "datafusion_tpu.parallel.worker:main"
+
+
+class TestHostPartialsGrowth:
+    """Host accumulators must grow as later batches introduce new
+    groups (aggregate._HostPartials._grown)."""
+
+    def test_group_growth_across_batches_host_partials(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "0.001")
+        # groups appearing only in later batches: host accumulators grow
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+
+        schema = Schema([Field("k", DataType.INT64, False),
+                         Field("v", DataType.FLOAT64, False)])
+        rng = np.random.default_rng(8)
+
+        class StreamSource(MemoryDataSource):
+            reusable_batches = False  # force the placement decision
+
+        batches = []
+        for lo in (0, 40, 90):  # later batches introduce new keys
+            k = rng.integers(lo, lo + 50, 4096)
+            v = np.round(rng.uniform(-10, 10, 4096), 2)
+            batches.append(make_host_batch(schema, [k, v], [None, None], [None, None]))
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+
+        src = StreamSource(schema, batches)
+        c = ExecutionContext(batch_size=4096)
+        c.register_datasource("t", src)
+        sql = "SELECT k, SUM(v), AVG(v), COUNT(1) FROM t GROUP BY k"
+        rel = c.sql(sql)
+        node = rel
+        while not isinstance(node, AggregateRelation):
+            node = node.child
+        got = sorted(collect(rel).to_rows())
+        # the point of this test is the HOST path's accumulator growth:
+        # fail loudly if placement ever stops routing this shape there
+        assert node._placement and node._placement.core is None
+        c2 = ExecutionContext(batch_size=4096)
+        c2.register_datasource("t", StreamSource(schema, batches))
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "1e9")
+        want = sorted(collect(c2.sql(sql)).to_rows())
+        assert len(got) == len(want)
+        for ra, rb in zip(got, want):
+            for va, vb in zip(ra, rb):
+                if isinstance(va, float):
+                    np.testing.assert_allclose(va, vb, rtol=1e-12)
+                else:
+                    assert va == vb

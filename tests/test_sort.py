@@ -598,3 +598,31 @@ class TestHostRoutedRunSort:
         METRICS.reset()
         self._run(self._src(nans=True), "SELECT a, b FROM t ORDER BY a DESC", slow, monkeypatch)
         assert not METRICS.snapshot()["counts"].get("sort.host_routed_runs")
+
+    def test_full_sort_with_large_limit_host_route(self, monkeypatch):
+        # LIMIT above TOPK_MAX takes the full-sort path; the host-routed
+        # permutation must honor the prefix take
+        import numpy as np
+
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.exec.sort import TOPK_MAX
+        from datafusion_tpu.utils.metrics import METRICS
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "0.001")
+        rng = np.random.default_rng(3)
+        n = TOPK_MAX + 4096
+        schema = Schema([Field("a", DataType.INT64, False)])
+        b = make_host_batch(schema, [rng.integers(0, 10**6, n)], [None], [None])
+        ctx = ExecutionContext(batch_size=n)
+        ctx.register_datasource("t", MemoryDataSource(schema, [b]))
+        METRICS.reset()
+        lim = TOPK_MAX + 1
+        out = collect(ctx.sql(f"SELECT a FROM t ORDER BY a LIMIT {lim}"))
+        assert METRICS.snapshot()["counts"].get("sort.host_routed_runs")
+        vals = [r[0] for r in out.to_rows()]
+        want = sorted(np.asarray(b.data[0])[: b.num_rows].tolist())[:lim]
+        assert vals == want
