@@ -1398,3 +1398,85 @@ class TestHostPartialsGrowth:
                     np.testing.assert_allclose(va, vb, rtol=1e-12)
                 else:
                     assert va == vb
+
+    def test_having_order_limit_over_placed_aggregate(self, monkeypatch):
+        # the aggregate's output batch feeds HAVING/ORDER BY/LIMIT
+        # downstream; the host-split result must be indistinguishable
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+
+        schema = Schema([Field("k", DataType.INT64, False),
+                         Field("v", DataType.FLOAT64, True)])
+        rng = np.random.default_rng(12)
+
+        class StreamSource(MemoryDataSource):
+            reusable_batches = False
+
+        k = rng.integers(0, 30, 8192)
+        v = np.round(rng.uniform(-5, 5, 8192), 2)
+        valid = rng.random(8192) > 0.15
+        batches = [make_host_batch(schema, [k[i:i+2048], v[i:i+2048]],
+                                   [None, valid[i:i+2048]], [None, None])
+                   for i in range(0, 8192, 2048)]
+        # predicate on the GROUP KEY: v stays exclusive to the host slots
+        # (a predicate on v would force v to ship and disable the split)
+        sql = ("SELECT k, SUM(v), COUNT(v) FROM t WHERE k < 25 GROUP BY k "
+               "HAVING COUNT(v) > 100 ORDER BY k LIMIT 10")
+        from datafusion_tpu.utils.metrics import METRICS
+
+        outs = {}
+        for mode, mbps in (("host", "0.001"), ("device", "1e9")):
+            monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", mbps)
+            METRICS.reset()
+            c = ExecutionContext(batch_size=2048)
+            c.register_datasource("t", StreamSource(schema, batches))
+            outs[mode] = collect(c.sql(sql)).to_rows()
+            routed = METRICS.snapshot()["counts"].get("aggregate.host_routed_slots")
+            assert bool(routed) == (mode == "host")
+        assert len(outs["host"]) == len(outs["device"]) > 0
+        for ra, rb in zip(outs["host"], outs["device"]):
+            assert ra[0] == rb[0] and ra[2] == rb[2]
+            np.testing.assert_allclose(ra[1], rb[1], rtol=1e-12)
+
+    def test_null_group_keys_host_partials(self, monkeypatch):
+        # NULL keys form their own group; host bincount must agree
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+
+        schema = Schema([Field("k", DataType.INT64, True),
+                         Field("v", DataType.FLOAT64, False)])
+        rng = np.random.default_rng(13)
+
+        class StreamSource(MemoryDataSource):
+            reusable_batches = False
+
+        k = rng.integers(0, 5, 4096)
+        kvalid = rng.random(4096) > 0.2
+        v = np.round(rng.uniform(0, 10, 4096), 2)
+        batches = [make_host_batch(schema, [k[i:i+1024], v[i:i+1024]],
+                                   [kvalid[i:i+1024], None], [None, None])
+                   for i in range(0, 4096, 1024)]
+        sql = "SELECT k, SUM(v), AVG(v), COUNT(1) FROM t GROUP BY k"
+        from datafusion_tpu.utils.metrics import METRICS
+
+        outs = {}
+        for mode, mbps in (("host", "0.001"), ("device", "1e9")):
+            monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", mbps)
+            METRICS.reset()
+            c = ExecutionContext(batch_size=1024)
+            c.register_datasource("t", StreamSource(schema, batches))
+            key = lambda r: tuple((x is None, 0 if x is None else x) for x in r)
+            outs[mode] = sorted(collect(c.sql(sql)).to_rows(), key=key)
+            routed = METRICS.snapshot()["counts"].get("aggregate.host_routed_slots")
+            assert bool(routed) == (mode == "host")
+        assert len(outs["host"]) == 6  # 5 keys + the NULL group
+        for ra, rb in zip(outs["host"], outs["device"]):
+            assert ra[0] == rb[0] and ra[3] == rb[3]
+            np.testing.assert_allclose(ra[1], rb[1], rtol=1e-12)
+            np.testing.assert_allclose(ra[2], rb[2], rtol=1e-12)
