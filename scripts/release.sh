@@ -8,8 +8,14 @@ cd "$(dirname "$0")/.."
 VERSION=$(python -c "import datafusion_tpu; print(datafusion_tpu.__version__)")
 echo "Version: ${VERSION}"
 
-# make sure there are no uncommitted changes (release.sh:10)
-git diff-index --quiet HEAD --
+# make sure there are no uncommitted changes (release.sh:10) —
+# PROGRESS.jsonl is exempt: the build driver appends telemetry to it
+# continuously and it never ships
+if [ -n "$(git status --porcelain --untracked-files=no -- . ':!PROGRESS.jsonl')" ]; then
+  echo "uncommitted changes present" >&2
+  git status --porcelain --untracked-files=no -- . ':!PROGRESS.jsonl' >&2
+  exit 1
+fi
 
 export JAX_PLATFORMS="${RELEASE_DEVICE:-cpu}"
 if [ "$JAX_PLATFORMS" = "cpu" ]; then
