@@ -341,7 +341,14 @@ def _q1_device_utilization(device_kind: str, mem_src, rows: int) -> dict:
     # lets us observe.
     from datafusion_tpu.exec.kernels import fuse_batch_count
 
-    n_batches = -(-rows // (1 << 19))
+    # count the source's REAL batches (they were built by an upstream
+    # scan whose batch size need not match this ctx): the launch
+    # correction must reflect the launches that actually happen, not a
+    # hardcoded batch-size assumption — it feeds BASELINE.md claims
+    try:
+        n_batches = sum(1 for _ in mem_src.batches())
+    except Exception:  # noqa: BLE001 — sources without cheap re-iteration
+        n_batches = -(-rows // ctx.batch_size)
     launches_per_pass = max(1, -(-n_batches // fuse_batch_count()))
     compute_per_pass = max(
         device_time / n_passes - launches_per_pass * launch_floor, 1e-9

@@ -34,3 +34,72 @@ class NotSupportedError(DataFusionError):
 
 class ExecutionError(DataFusionError):
     """Runtime failure while executing a plan (reference `error.rs:34`)."""
+
+
+class TransientError(DataFusionError):
+    """A failure that is expected to succeed on replay (retry taxonomy
+    root).  Recovery layers decide *by type*: anything under this class
+    is retryable, everything else re-raises immediately — no substring
+    matching in the retry hot path."""
+
+
+class DeviceTransientError(TransientError):
+    """A device dispatch failed for transport/session reasons (dropped
+    tunnel request, remote compile service hiccup).  Dispatches are
+    functionally pure, so the call simply replays."""
+
+
+class WorkerUnavailableError(TransientError):
+    """A worker endpoint is (currently) unreachable; its fragment can
+    be reassigned or retried after re-admission."""
+
+
+class QueryDeadlineError(ExecutionError):
+    """The caller's per-query time budget is exhausted.  Deliberately
+    NOT transient: retrying cannot create time."""
+
+
+# Status-code classification for JAX/XLA runtime errors.  The runtime
+# raises untyped `XlaRuntimeError`/`JaxRuntimeError` whose messages
+# lead with an absl status token ("UNAVAILABLE: socket closed"); the
+# token — not a free-text scan — decides retryability.  INTERNAL is
+# excluded on purpose: it covers genuine compiler/runtime bugs, and the
+# transport markers below catch the tunnel's INTERNAL-wrapped drops.
+_RETRYABLE_STATUS = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "ABORTED", "CANCELLED")
+_DEVICE_ERROR_TYPES = ("JaxRuntimeError", "XlaRuntimeError", "InternalError")
+# legacy fallback for tunneled transports whose failures surface as
+# INTERNAL/unprefixed or WRAPPED messages (the status token is not the
+# leading word); scanned once per *error* at the classification
+# boundary, never per retry decision
+_TRANSPORT_MARKERS = (
+    "read body",
+    "response body closed",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "deadline exceeded",
+    "unavailable",
+    "socket closed",
+    "transport",
+    "remote_compile",
+)
+
+
+def classify_transient(err: BaseException) -> "TransientError | None":
+    """Wrap a raw exception into the typed transient taxonomy, or
+    return None for permanent failures.  Called once at the dispatch
+    boundary where an error first surfaces; retry loops downstream
+    test `isinstance(e, TransientError)` only."""
+    if isinstance(err, TransientError):
+        return err
+    if isinstance(err, (ConnectionError, BrokenPipeError)):
+        return WorkerUnavailableError(str(err))
+    if type(err).__name__ in _DEVICE_ERROR_TYPES:
+        msg = str(err)
+        status = msg.split(":", 1)[0].strip().upper()
+        if status in _RETRYABLE_STATUS:
+            return DeviceTransientError(msg)
+        low = msg.lower()
+        if any(m in low for m in _TRANSPORT_MARKERS):
+            return DeviceTransientError(msg)
+    return None

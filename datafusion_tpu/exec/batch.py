@@ -337,18 +337,31 @@ def _dict_table(values_bits: np.ndarray) -> np.ndarray:
 _LINK_RATE: dict = {}
 
 
+def _link_cache_key(device, platform: str):
+    """Cache key for one measured link: the device's identity when one
+    is pinned (heterogeneous same-platform devices — e.g. a
+    direct-attached and a tunneled chip — must not inherit each other's
+    measured rate), the platform for the default-device case."""
+    if device is None:
+        return platform
+    ident = getattr(device, "id", None)
+    return (platform, repr(device) if ident is None else ident)
+
+
 def link_rate_mbps(device=None) -> float:
-    """Achieved H2D MB/s to `device`, measured once per platform.  The
-    probe first performs a small D2H so the measurement reflects the
-    steady session state (on tunneled transports the first D2H ends a
-    buffered-ack mode in which transfer timings are fiction)."""
+    """Achieved H2D MB/s to `device`, measured once per device (per
+    platform for the default device).  The probe first performs a small
+    D2H so the measurement reflects the steady session state (on
+    tunneled transports the first D2H ends a buffered-ack mode in which
+    transfer timings are fiction)."""
     knob = os.environ.get("DATAFUSION_TPU_LINK_MBPS")
     if knob:
         return float(knob)
     platform = _target_platform(device)
     if platform == "cpu":
         return float("inf")
-    hit = _LINK_RATE.get(platform)
+    key = _link_cache_key(device, platform)
+    hit = _LINK_RATE.get(key)
     if hit is None:
         import time
 
@@ -367,7 +380,7 @@ def link_rate_mbps(device=None) -> float:
             t0 = time.perf_counter()
             jax.block_until_ready(put(arr + np.uint8(1)))
             rates.append(arr.nbytes / 1e6 / max(time.perf_counter() - t0, 1e-9))
-        hit = _LINK_RATE[platform] = float(max(rates))
+        hit = _LINK_RATE[key] = float(max(rates))
         from datafusion_tpu.utils.metrics import METRICS
 
         METRICS.add("link.probe_mbps", int(hit))

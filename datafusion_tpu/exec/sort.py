@@ -499,15 +499,17 @@ class SortRelation(Relation):
             self._kb <<= 1
         self.core = _TopKCore.build(self._key_plans)
         self._topk_jit = self.core.jit
-        # device-resident sort-key operands per full-sort run, keyed by
-        # the run's source batch identities + dictionary versions: a
-        # warm re-query re-sorts the SAME device buffers instead of
-        # re-encoding + re-uploading the keys every run (the values pin
-        # the batch objects so ids stay valid).  Mirrors device_inputs'
-        # per-batch caching on the pipeline/aggregate paths.  FIFO-
-        # bounded: multi-run sorts and cold re-scans (fresh batch
-        # objects every scan, so their keys can never hit) must not
-        # accumulate device buffers without bound.
+        # warm-run artifacts per full-sort run, keyed by the run's
+        # source batch identities + dictionary versions: the device
+        # route stores its uploaded key operands (a warm re-query
+        # re-sorts the SAME device buffers instead of re-encoding +
+        # re-uploading), the host route stores the finished permutation
+        # (a warm re-query skips the np.lexsort outright); the values
+        # pin the batch objects so ids stay valid.  Mirrors
+        # device_inputs' per-batch caching on the pipeline/aggregate
+        # paths.  FIFO-bounded: multi-run sorts and cold re-scans
+        # (fresh batch objects every scan, so their keys can never hit)
+        # must not accumulate buffers without bound.
         from collections import OrderedDict
 
         self._run_ops_cache: OrderedDict = OrderedDict()
@@ -811,9 +813,11 @@ class SortRelation(Relation):
         (~ceil(bits/8) incompressible bytes per row); on a slow link
         that dwarfs a host lexsort of the same key operands.  Both
         sorts are stable over identical operands, so the permutations
-        are identical — except for NaN float keys, where numpy (all
-        NaNs last) and XLA's total order (sign-respecting) disagree;
-        any NaN forces the device path."""
+        are identical — except for two float-key cases where numpy
+        (IEEE compare) and XLA's total order disagree: NaNs (numpy
+        puts all NaNs last; XLA respects their sign) and signed zeros
+        (numpy ties -0.0 == +0.0, XLA orders -0.0 < +0.0).  Either
+        forces the device path."""
         from datafusion_tpu.exec.batch import _wire_enabled, link_rate_mbps
 
         if not _wire_enabled(self.device):
@@ -824,11 +828,22 @@ class SortRelation(Relation):
         host_s = n * _HOST_SORT_SECONDS_PER_ROW * max(len(keys) // 2, 1)
         if host_s >= dev_s:
             return None
-        # NaN check last: it is an O(n) pass per float key, and on fast
-        # links the cost model above already routed to the device
+        # NaN / signed-zero checks last: they are O(n) passes per float
+        # key, and on fast links the cost model above already routed to
+        # the device
         for j in range(1, len(keys), 2):
-            if keys[j].dtype.kind == "f" and bool(np.isnan(keys[j][:n]).any()):
+            if keys[j].dtype.kind != "f":
+                continue
+            vals = keys[j][:n]
+            if bool(np.isnan(vals).any()):
                 return None
+            # XLA's total order splits -0.0 < +0.0; np.lexsort ties
+            # them — with both present the permutations diverge
+            zero = vals == 0.0
+            if zero.any():
+                signs = np.signbit(vals[zero])
+                if bool(signs.any()) and not bool(signs.all()):
+                    return None
         METRICS.add("sort.host_routed_runs")
         # significance: np.lexsort's LAST key is primary — reversing
         # [dead0, val0, dead1, val1, ...] reproduces the device
@@ -846,13 +861,31 @@ class SortRelation(Relation):
         the sort entirely (a constant key never reorders anything).
         The padding convention keeps the flag droppable: when a run has
         no nulls, padding rows' VALUE keys are +max sentinels, so they
-        sort last without their flag.  `cache_key` stores the uploaded
-        operands in _run_ops_cache (`pin` holds the source batches
-        alive) so a warm re-query skips straight to _sort_ops."""
+        sort last without their flag.  `cache_key` stores the warm-run
+        artifact in _run_ops_cache (`pin` holds the source batches
+        alive): the uploaded device operands on the device route, the
+        finished permutation itself on the host route — either way a
+        warm re-query skips the key encode."""
         from datafusion_tpu.exec.batch import put_compressed
+
+        # second-chance admission (shared by both routes): a key must be
+        # SEEN twice before its artifact is stored, so one-shot file
+        # scans (fresh batch objects every scan) pin nothing
+        admit = False
+        if cache_key is not None:
+            if cache_key in self._run_seen:
+                admit = True
+            else:
+                self._run_seen[cache_key] = True
+                while len(self._run_seen) > 32:
+                    self._run_seen.popitem(last=False)
 
         host_perm = self._host_run_sort(keys, n)
         if host_perm is not None:
+            if admit:
+                self._run_ops_cache[cache_key] = ("perm", host_perm, pin)
+                while len(self._run_ops_cache) > self._run_ops_cache_max:
+                    self._run_ops_cache.popitem(last=False)
             return host_perm
         cap = bucket_capacity(n)
         host_ops: list[np.ndarray] = []
@@ -887,15 +920,10 @@ class SortRelation(Relation):
             host_ops.append(padded)
         with _device_scope(self.device):
             dev_ops = tuple(put_compressed(host_ops, self.device))
-        if cache_key is not None:
-            if cache_key in self._run_seen:
-                self._run_ops_cache[cache_key] = (dev_ops, pin)
-                while len(self._run_ops_cache) > self._run_ops_cache_max:
-                    self._run_ops_cache.popitem(last=False)
-            else:
-                self._run_seen[cache_key] = True
-                while len(self._run_seen) > 32:
-                    self._run_seen.popitem(last=False)
+        if admit:
+            self._run_ops_cache[cache_key] = ("ops", dev_ops, pin)
+            while len(self._run_ops_cache) > self._run_ops_cache_max:
+                self._run_ops_cache.popitem(last=False)
         return self._sort_ops(dev_ops, n)
 
     def _sort_ops(self, dev_ops, n: int) -> np.ndarray:
@@ -1027,8 +1055,14 @@ class SortRelation(Relation):
                 else None
             )
             with METRICS.timer("execute.sort"), _device_scope(self.device):
-                if hit is not None:
-                    perm = self._sort_ops(hit[0], len(cols[0]))
+                if hit is not None and hit[0] == "perm":
+                    # host-routed run cached whole: the permutation IS
+                    # the artifact (no device buffers to re-sort), so a
+                    # warm re-query skips the np.lexsort too
+                    METRICS.add("sort.host_perm_cache_hits")
+                    perm = hit[1]
+                elif hit is not None:
+                    perm = self._sort_ops(hit[1], len(cols[0]))
                 else:
                     keys = self._host_keys(cols, valids, dicts)
                     perm = self._sorted_run(

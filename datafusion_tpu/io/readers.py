@@ -20,6 +20,7 @@ from datafusion_tpu.datatypes import DataType, Schema
 from datafusion_tpu.errors import ExecutionError, IoError
 from datafusion_tpu.exec.batch import RecordBatch, StringDictionary, make_host_batch
 from datafusion_tpu.io.io_thread import confined_iter, run_on_io_thread
+from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.metrics import METRICS
 
 DEFAULT_BATCH_SIZE = 131072
@@ -178,6 +179,7 @@ class CsvReader:
             yield self._to_batch(pending)
 
     def _to_batch(self, tbl) -> RecordBatch:
+        faults.check("io.read", path=self.path, format="csv")
         cols = [tbl.column(i) for i in range(tbl.num_columns)]
         columns, validity = _arrow_to_columns(cols, self.out_schema, self.dicts)
         METRICS.add("scan.rows", tbl.num_rows)
@@ -236,6 +238,7 @@ class NdJsonReader:
                 yield self._rows_to_batch(rows)
 
     def _rows_to_batch(self, rows: list[dict]) -> RecordBatch:
+        faults.check("io.read", path=self.path, format="ndjson")
         METRICS.add("scan.rows", len(rows))
         columns: list[np.ndarray] = []
         validity: list[Optional[np.ndarray]] = []
@@ -301,6 +304,7 @@ class ParquetReader:
         # date/timestamp column (travels as ISO strings) keeps its type
         # and takes the cast path in _arrow_to_columns
         for arrow_batch in pf.iter_batches(batch_size=self.batch_size, columns=names):
+            faults.check("io.read", path=self.path, format="parquet")
             cols = [arrow_batch.column(j) for j in range(arrow_batch.num_columns)]
             import pyarrow as pa
 

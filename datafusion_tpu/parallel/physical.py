@@ -75,12 +75,22 @@ class PlanFragment:
     logical plan in JSON wire form.  A coordinator sends this to the
     host owning shard `shard`; locally we execute it on mesh device
     `shard`.
+
+    `query_id` scopes the fragment to one query execution; with it the
+    fragment's identity (`fragment_id`) is idempotent — a coordinator
+    that replays a fragment (worker died, response lost) can recognize
+    a duplicate response and merge each fragment exactly once.
     """
 
     shard: int
     num_shards: int
     plan: dict
     datasource_meta: dict
+    query_id: str = ""
+
+    @property
+    def fragment_id(self) -> str:
+        return f"{self.query_id}/{self.shard}"
 
     def to_json_str(self) -> str:
         return json.dumps(
@@ -89,13 +99,17 @@ class PlanFragment:
                 "num_shards": self.num_shards,
                 "plan": self.plan,
                 "datasource": self.datasource_meta,
+                "query_id": self.query_id,
             }
         )
 
     @staticmethod
     def from_json_str(s: str) -> "PlanFragment":
         o = json.loads(s)
-        return PlanFragment(o["shard"], o["num_shards"], o["plan"], o["datasource"])
+        return PlanFragment(
+            o["shard"], o["num_shards"], o["plan"], o["datasource"],
+            o.get("query_id", ""),
+        )
 
     def logical_plan(self) -> LogicalPlan:
         return LogicalPlan.from_json(self.plan)

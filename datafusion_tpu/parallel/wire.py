@@ -30,8 +30,18 @@ from typing import Optional
 import numpy as np
 
 from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.testing import faults
 
 import os
+
+
+class ProtocolError(ConnectionError):
+    """A frame arrived but cannot parse (corrupted stream, protocol
+    mismatch).  Subclasses ConnectionError on purpose: the stream is
+    unusable from here on, and the coordinator's failover handler keys
+    on ConnectionError/OSError — a garbled peer should fail over, not
+    crash the query."""
+
 
 _LEN = struct.Struct(">Q")
 _U32 = struct.Struct(">I")
@@ -54,6 +64,7 @@ class BinWriter:
 
 
 def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None) -> None:
+    faults.check("wire.send", type=obj.get("type"))
     if bw is not None and bw.chunks:
         sizes = [memoryview(c).nbytes for c in bw.chunks]
         obj = dict(obj)
@@ -100,6 +111,7 @@ def _attach_bins(node, bins: list) -> None:
 
 def recv_msg(sock: socket.socket) -> Optional[dict]:
     """One frame, or None on clean EOF."""
+    faults.check("wire.recv")
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
@@ -112,19 +124,28 @@ def recv_msg(sock: socket.socket) -> Optional[dict]:
         # is a transport failure, and the coordinator's failover
         # handler keys on ConnectionError/OSError
         raise ConnectionError("connection closed mid-frame")
-    if data[:1] == bytes([_TAG_BIN]):
-        (json_len,) = _U32.unpack(data[1 : 1 + _U32.size])
-        body_off = 1 + _U32.size
-        obj = json.loads(data[body_off : body_off + json_len].decode("utf-8"))
-        blob = memoryview(data)[body_off + json_len :]
-        bins = []
-        off = 0
-        for size in obj.get("_bins", []):
-            bins.append(blob[off : off + size])
-            off += size
-        _attach_bins(obj, bins)
-        return obj
-    return json.loads(data.decode("utf-8"))
+    data = faults.corrupt("wire.recv.payload", data)
+    try:
+        if data[:1] == bytes([_TAG_BIN]):
+            (json_len,) = _U32.unpack(data[1 : 1 + _U32.size])
+            body_off = 1 + _U32.size
+            obj = json.loads(data[body_off : body_off + json_len].decode("utf-8"))
+            blob = memoryview(data)[body_off + json_len :]
+            bins = []
+            off = 0
+            for size in obj.get("_bins", []):
+                if not isinstance(size, int) or size < 0 or off + size > len(blob):
+                    raise ValueError(f"bad binary segment length {size!r}")
+                bins.append(blob[off : off + size])
+                off += size
+            _attach_bins(obj, bins)
+            return obj
+        return json.loads(data.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError, struct.error) as e:
+        # a frame that cannot parse means the stream is garbage
+        # (corruption, desync, protocol mismatch) — every later frame
+        # boundary is suspect too, so surface a connection-level error
+        raise ProtocolError(f"unparseable frame ({len(data)} bytes): {e}")
 
 
 def enc_array(a: np.ndarray, bw: Optional[BinWriter] = None) -> dict:

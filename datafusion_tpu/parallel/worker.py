@@ -37,6 +37,8 @@ from datafusion_tpu.exec.materialize import collect_columns
 from datafusion_tpu.parallel.physical import PlanFragment
 from datafusion_tpu.parallel.wire import BinWriter, enc_array, recv_msg, send_msg
 from datafusion_tpu.plan.logical import TableScan
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.deadline import Deadline, deadline_scope
 
 
 def _find_scan(plan) -> TableScan:
@@ -109,7 +111,11 @@ class WorkerState:
 
     def execute_fragment(self, fragment_str: str, bw: Optional[BinWriter] = None) -> dict:
         """Partial-aggregate path: returns accumulator state + key table."""
-        rel, _plan = self._relation(PlanFragment.from_json_str(fragment_str))
+        frag = PlanFragment.from_json_str(fragment_str)
+        faults.check(
+            "worker.fragment", shard=frag.shard, fragment_id=frag.fragment_id
+        )
+        rel, _plan = self._relation(frag)
         if not isinstance(rel, AggregateRelation):
             raise ExecutionError(
                 "execute_fragment needs an Aggregate fragment; "
@@ -141,6 +147,7 @@ class WorkerState:
                 slot_dicts[str(slot_idx)] = [] if d is None else d.values
         return {
             "type": "partial_state",
+            "fragment_id": frag.fragment_id,
             "num_groups": n_groups,
             "counts": enc_array(counts, bw),
             "slots": [enc_array(s, bw) for s in slots],
@@ -157,7 +164,11 @@ class WorkerState:
     def execute_plan(self, fragment_str: str, bw: Optional[BinWriter] = None) -> dict:
         """Row-returning path (Projection/Selection fragments): scan,
         filter, project on-device, materialize and ship the rows."""
-        rel, plan = self._relation(PlanFragment.from_json_str(fragment_str))
+        frag = PlanFragment.from_json_str(fragment_str)
+        faults.check(
+            "worker.fragment", shard=frag.shard, fragment_id=frag.fragment_id
+        )
+        rel, plan = self._relation(frag)
         columns, validity, dicts, total = collect_columns(rel)
         self.queries += 1
         out_cols = []
@@ -185,6 +196,7 @@ class WorkerState:
                 out_cols.append(enc_array(c, bw))
         return {
             "type": "rows",
+            "fragment_id": frag.fragment_id,
             "num_rows": total,
             "columns": out_cols,
             "validity": [
@@ -206,14 +218,22 @@ class _Handler(socketserver.BaseRequestHandler):
             bw = BinWriter()
             try:
                 kind = msg.get("type")
+                # the coordinator ships the REMAINING per-query budget in
+                # seconds (absolute times don't transfer between hosts);
+                # re-anchor it here so device retries under this fragment
+                # never sleep past the caller's deadline
+                budget = msg.get("deadline_s")
+                deadline = None if budget is None else Deadline.after(float(budget))
                 if kind == "ping":
                     out = {"type": "pong", "queries": state.queries}
                 elif kind == "status":
                     out = state.status()
                 elif kind == "execute_fragment":
-                    out = state.execute_fragment(msg["fragment"], bw)
+                    with deadline_scope(deadline):
+                        out = state.execute_fragment(msg["fragment"], bw)
                 elif kind == "execute_plan":
-                    out = state.execute_plan(msg["fragment"], bw)
+                    with deadline_scope(deadline):
+                        out = state.execute_plan(msg["fragment"], bw)
                 elif kind == "shutdown":
                     send_msg(self.request, {"type": "bye"})
                     threading.Thread(
@@ -222,6 +242,11 @@ class _Handler(socketserver.BaseRequestHandler):
                     return
                 else:
                     out = {"type": "error", "message": f"unknown request {kind!r}"}
+            except faults.InjectedConnectionAbort:
+                # simulated worker death for in-process chaos tests:
+                # close the connection without a response (the peer
+                # sees a mid-query EOF, exactly like a killed process)
+                return
             except DataFusionError as e:
                 out = {"type": "error", "message": str(e)}
                 bw = BinWriter()  # a failed build may have partial segments
@@ -317,6 +342,7 @@ def main(argv=None) -> int:
     ap.add_argument("--num-processes", type=int, default=None)
     ap.add_argument("--process-id", type=int, default=None)
     args = ap.parse_args(argv)
+    faults.set_role("worker")  # role-scoped fault rules (testing/faults.py)
     # honor JAX_PLATFORMS even on hosts whose sitecustomize registers an
     # accelerator backend and overrides the env var at interpreter boot
     # (same re-pin as tests/conftest.py)

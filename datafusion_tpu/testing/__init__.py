@@ -1,0 +1,8 @@
+"""Test-support subsystems that ship with the engine.
+
+`faults` is the deterministic fault-injection layer: production code
+threads named injection sites through the wire/worker/device/IO paths,
+and a seedable process-global plan decides which sites fire.  It lives
+in the package (not under tests/) because worker *processes* must honor
+the same plan via the environment.
+"""

@@ -1,0 +1,74 @@
+"""Per-query deadline propagation.
+
+A caller's time budget must bound every retry loop beneath it —
+otherwise capped-backoff replays can multiply a "slow" query into an
+unbounded one.  The budget travels two ways:
+
+- in-process: a contextvar scope (`deadline_scope`) that `device_call`
+  and the coordinator's dispatch loop consult before sleeping;
+- across the wire: fragment requests carry the *remaining* budget in
+  seconds (absolute wall-clock times don't transfer between hosts);
+  the worker re-anchors it on receipt.
+
+Deadlines are monotonic-clock anchored, so NTP steps can't expire (or
+resurrect) a query.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from datafusion_tpu.errors import QueryDeadlineError
+
+
+class Deadline:
+    """An absolute point on the monotonic clock."""
+
+    __slots__ = ("at",)
+
+    def __init__(self, at: float):
+        self.at = at
+
+    @staticmethod
+    def after(seconds: float) -> "Deadline":
+        return Deadline(time.monotonic() + seconds)
+
+    def remaining(self) -> float:
+        return self.at - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "query") -> None:
+        rem = self.remaining()
+        if rem <= 0.0:
+            raise QueryDeadlineError(
+                f"{what} exceeded its deadline (over budget by {-rem:.3f}s)"
+            )
+
+    def __repr__(self):
+        return f"Deadline(remaining={self.remaining():.3f}s)"
+
+
+_CURRENT: contextvars.ContextVar[Optional[Deadline]] = contextvars.ContextVar(
+    "datafusion_tpu_deadline", default=None
+)
+
+
+def current_deadline() -> Optional[Deadline]:
+    return _CURRENT.get()
+
+
+@contextmanager
+def deadline_scope(deadline: Optional[Deadline]):
+    """Make `deadline` visible to retry loops in this (thread's) scope.
+    None is allowed and simply clears any outer scope."""
+    token = _CURRENT.set(deadline)
+    try:
+        yield deadline
+    finally:
+        _CURRENT.reset(token)

@@ -7,45 +7,90 @@ and the query engine's natural recovery unit is the *device call*:
 dispatches are functionally pure (accumulator state in, state out), so
 a failed call simply replays.  Genuine programming errors (trace
 errors, shape mismatches) are not transient and re-raise immediately.
+
+Policy: classification is typed (`errors.classify_transient` wraps raw
+JAX/XLA errors into the `TransientError` taxonomy once, at this
+boundary — the retry decision itself is an `isinstance`); backoff is
+capped exponential with FULL jitter (decorrelates a fleet of workers
+hammering a recovering transport — a deterministic ladder re-aligns
+every client on the same instant); and every sleep is bounded by the
+caller's deadline (`utils.deadline`), so retries can never exceed a
+query's budget.
+
+Tunables (env): DATAFUSION_TPU_RETRY_ATTEMPTS (default 4),
+DATAFUSION_TPU_RETRY_BASE_S (default 0.25),
+DATAFUSION_TPU_RETRY_CAP_S (default 5.0).
 """
 
 from __future__ import annotations
 
+import os
+import random
 import time
 
+from datafusion_tpu.errors import QueryDeadlineError, classify_transient
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.deadline import current_deadline
 from datafusion_tpu.utils.metrics import METRICS
 
-_TRANSIENT_MARKERS = (
-    "read body",
-    "response body closed",
-    "connection reset",
-    "connection refused",
-    "broken pipe",
-    "deadline exceeded",
-    "unavailable",
-    "socket closed",
-    "transport",
-    "remote_compile",
-)
-_ATTEMPTS = 3
-_BACKOFF_S = 2.0
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if not v else float(v)
+
+
+_ATTEMPTS = int(_env_float("DATAFUSION_TPU_RETRY_ATTEMPTS", 4))
+_BASE_S = _env_float("DATAFUSION_TPU_RETRY_BASE_S", 0.25)
+_CAP_S = _env_float("DATAFUSION_TPU_RETRY_CAP_S", 5.0)
+
+# module-level stream so tests can seed it (`seed_backoff`); full
+# jitter means the *sequence* is what a deterministic test pins down
+_RNG = random.Random()
+
+
+def seed_backoff(seed: int) -> None:
+    """Make the jitter stream deterministic (tests, chaos replays)."""
+    global _RNG
+    _RNG = random.Random(seed)
+
+
+def backoff_s(attempt: int, base: float = None, cap: float = None) -> float:
+    """Sleep length before retry `attempt` (1-based): full jitter over
+    a capped exponential — uniform in [0, min(cap, base * 2^(a-1))]."""
+    base = _BASE_S if base is None else base
+    cap = _CAP_S if cap is None else cap
+    ceiling = min(cap, base * (2.0 ** (attempt - 1)))
+    return _RNG.uniform(0.0, ceiling)
 
 
 def is_transient(err: Exception) -> bool:
-    msg = str(err).lower()
-    return any(m in msg for m in _TRANSIENT_MARKERS)
+    """Typed transient test (kept as the public name callers know)."""
+    return classify_transient(err) is not None
 
 
 def device_call(fn, /, *args, **kwargs):
     """Invoke a (pure) device computation, replaying on transient
-    runtime failures with linear backoff."""
-    for attempt in range(_ATTEMPTS):
+    runtime failures with capped exponential backoff + full jitter,
+    never sleeping past the ambient query deadline."""
+    attempt = 0
+    while True:
         try:
+            faults.check("device.call", attempt=attempt)
             return fn(*args, **kwargs)
         except Exception as e:  # jax.errors.JaxRuntimeError and kin
-            if type(e).__name__ not in (
-                "JaxRuntimeError", "XlaRuntimeError", "InternalError"
-            ) or not is_transient(e) or attempt == _ATTEMPTS - 1:
+            transient = classify_transient(e)
+            if transient is None:
                 raise
+            attempt += 1
+            if attempt >= _ATTEMPTS:
+                raise
+            delay = backoff_s(attempt)
+            deadline = current_deadline()
+            if deadline is not None and deadline.remaining() < delay:
+                raise QueryDeadlineError(
+                    f"transient device failure, but the query deadline "
+                    f"({deadline.remaining():.3f}s left) cannot cover the "
+                    f"{delay:.3f}s retry backoff"
+                ) from transient
             METRICS.add("device.transient_retries")
-            time.sleep(_BACKOFF_S * (attempt + 1))
+            time.sleep(delay)

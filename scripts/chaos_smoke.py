@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Chaos smoketest: a distributed GROUP BY under a seeded fault plan,
+in ONE process (hermetic, CPU backend, no subprocess spawns).
+
+Workers run in-process (`parallel.worker.serve` + threads) over real
+TCP sockets; the fault plan (testing/faults.py) injects, in order:
+
+1. a worker aborting its connection mid-fragment (the in-process stand-
+   in for a killed worker: the coordinator sees a mid-query EOF and
+   must fail the fragment over);
+2. a connection reset on a response recv (the fragment already ran —
+   the replay must not double-merge);
+3. two consecutive transient device errors (typed DeviceTransientError
+   through `device_call`'s jittered-backoff retry).
+
+The query's results must equal the fault-free single-process run, the
+down worker must be re-admitted by one heartbeat probation cycle, and a
+re-run on the healed cluster must agree again.  Exit non-zero on any
+divergence.  `scripts/smoketest.sh` runs this after the unit tests.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import threading
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# pin before any datafusion/jax import: hermetic CPU run, fast retries
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DATAFUSION_TPU_RETRY_BASE_S", "0.001")
+
+FAULT_PLAN = {
+    "seed": 42,
+    "rules": [
+        {"site": "worker.fragment", "op": "raise",
+         "exc": "InjectedConnectionAbort", "after": 1, "count": 1},
+        {"site": "wire.recv", "op": "raise", "exc": "ConnectionResetError",
+         "after": 4, "count": 1},
+        {"site": "device.call", "op": "raise", "exc": "DeviceTransientError",
+         "count": 2},
+    ],
+}
+
+
+def _write_partitions(tmpdir: str, n_parts: int = 3, rows_per: int = 800):
+    import numpy as np
+
+    rng = np.random.default_rng(13)
+    regions = ["north", "south", "east", "west"]
+    paths = []
+    for p in range(n_parts):
+        path = os.path.join(tmpdir, f"part{p}.csv")
+        with open(path, "w") as f:
+            f.write("region,v,x\n")
+            for _ in range(rows_per):
+                f.write(
+                    f"{regions[rng.integers(0, 4)]},"
+                    f"{rng.integers(-1000, 1000)},"
+                    f"{rng.uniform(-5, 5):.6f}\n"
+                )
+        paths.append(path)
+    return paths
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import (
+        DistributedContext,
+        HeartbeatMonitor,
+    )
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+    from datafusion_tpu.parallel.worker import serve
+    from datafusion_tpu.testing import faults
+    from datafusion_tpu.utils import retry
+
+    schema = Schema(
+        [
+            Field("region", DataType.UTF8, False),
+            Field("v", DataType.INT64, False),
+            Field("x", DataType.FLOAT64, True),
+        ]
+    )
+    sql = (
+        "SELECT region, COUNT(1), SUM(v), MIN(v), MAX(v), MIN(x), MAX(x) "
+        "FROM t GROUP BY region"
+    )
+
+    servers = []
+    tmpdir = tempfile.mkdtemp(prefix="dftpu_chaos_")
+    try:
+        paths = _write_partitions(tmpdir)
+
+        def make_pds():
+            return PartitionedDataSource(
+                [CsvDataSource(p, schema, True, 131072) for p in paths]
+            )
+
+        def rows(ctx):
+            return sorted(collect(ctx.sql(sql)).to_rows())
+
+        # fault-free baseline FIRST (the plan must not touch it)
+        lctx = ExecutionContext(device="cpu")
+        lctx.register_datasource("t", make_pds())
+        want = rows(lctx)
+
+        addrs = []
+        for _ in range(2):
+            server = serve("127.0.0.1:0", device="cpu")
+            threading.Thread(target=server.serve_forever, daemon=True).start()
+            servers.append(server)
+            addrs.append(server.server_address[:2])
+        print(f"in-process workers at {addrs}", flush=True)
+
+        retry.seed_backoff(42)
+        dctx = DistributedContext(addrs, query_deadline_s=300.0)
+        dctx.register_datasource("t", make_pds())
+        with faults.scoped(FAULT_PLAN) as plan:
+            got = rows(dctx)
+            fired = {r["site"]: r["fired"] for r in plan.snapshot()}
+        assert got == want, f"chaos result diverges:\n{got}\nvs\n{want}"
+        assert fired["worker.fragment"] == 1, fired
+        assert fired["device.call"] == 2, fired
+        print(f"chaos query matches fault-free run (fired: {fired})", flush=True)
+
+        # the aborted fragment marked its worker down; one heartbeat
+        # probation cycle must bring it back
+        down = [w for w in dctx.workers if not w.alive]
+        assert down, "expected the aborted worker to be marked down"
+        HeartbeatMonitor(dctx.workers, interval=0.05,
+                         probation_pings=1).poll_once()
+        assert all(w.alive for w in dctx.workers), dctx.workers
+        print("down worker re-admitted after one probation cycle", flush=True)
+
+        # healed cluster, no plan: agree again
+        assert rows(dctx) == want, "post-recovery result diverges"
+        print("CHAOS SMOKETEST PASSED", flush=True)
+        return 0
+    finally:
+        for s in servers:
+            s.shutdown()
+            s.server_close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,9 @@ else
   echo "== unit tests skipped (SMOKETEST_SKIP_TESTS=1; CI runs them in the test matrix) =="
 fi
 
+echo "== chaos smoke (distributed query under a seeded fault plan) =="
+python scripts/chaos_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

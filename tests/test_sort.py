@@ -599,6 +599,91 @@ class TestHostRoutedRunSort:
         self._run(self._src(nans=True), "SELECT a, b FROM t ORDER BY a DESC", slow, monkeypatch)
         assert not METRICS.snapshot()["counts"].get("sort.host_routed_runs")
 
+    def test_signed_zero_keys_stay_on_device(self, monkeypatch):
+        # XLA's total order splits -0.0 < +0.0; np.lexsort ties them —
+        # with both present the host route must bail (same contract as
+        # the NaN bail-out)
+        import numpy as np
+
+        from datafusion_tpu.exec.sort import SortRelation
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "0.001")
+        rel = object.__new__(SortRelation)
+        rel.device = None
+
+        def keys_for(vals):
+            v = np.asarray(vals, np.float64)
+            return [np.zeros(len(v), bool), v]
+
+        both = keys_for([3.0, -0.0, 1.0, 0.0])
+        assert rel._host_run_sort(both, 4) is None
+        only_pos = keys_for([3.0, 0.0, 1.0, 0.0])
+        assert rel._host_run_sort(only_pos, 4) is not None
+        only_neg = keys_for([3.0, -0.0, 1.0, -0.0])
+        assert rel._host_run_sort(only_neg, 4) is not None
+        no_zero = keys_for([3.0, 2.0, 1.0, 4.0])
+        assert rel._host_run_sort(no_zero, 4) is not None
+
+    def test_signed_zero_sort_matches_device(self, monkeypatch):
+        # end to end: a float key containing both signed zeros, with the
+        # cost model begging for the host route — output order must
+        # equal the device path's (payload column detects divergence)
+        import numpy as np
+
+        from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+
+        rng = np.random.default_rng(9)
+        n = 512
+        a = rng.uniform(-1, 1, n)
+        a[::7] = 0.0
+        a[::11] = -0.0
+        schema = Schema([
+            Field("a", DataType.FLOAT64, False),
+            Field("tag", DataType.INT64, False),
+        ])
+
+        def run(env):
+            for k, v in env.items():
+                monkeypatch.setenv(k, v)
+            b = make_host_batch(
+                schema, [a.copy(), np.arange(n, dtype=np.int64)],
+                [None, None], [None, None],
+            )
+            ctx = ExecutionContext(batch_size=n)
+            ctx.register_datasource("t", MemoryDataSource(schema, [b]))
+            return collect(ctx.sql("SELECT a, tag FROM t ORDER BY a")).to_rows()
+
+        slow = run({"DATAFUSION_TPU_WIRE": "always",
+                    "DATAFUSION_TPU_LINK_MBPS": "0.001"})
+        fast = run({"DATAFUSION_TPU_WIRE": "always",
+                    "DATAFUSION_TPU_LINK_MBPS": "1e9"})
+        assert slow == fast
+
+    def test_host_perm_cached_on_warm_requery(self, monkeypatch):
+        # satellite: the host-routed permutation joins the same warm
+        # cache as device key uploads — the third batches() pass on one
+        # relation (seen, admitted, hit) skips the np.lexsort
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.utils.metrics import METRICS
+
+        monkeypatch.setenv("DATAFUSION_TPU_WIRE", "always")
+        monkeypatch.setenv("DATAFUSION_TPU_LINK_MBPS", "0.001")
+        ctx = self._src(nulls=False)
+        rel = ctx.sql("SELECT a, b, s FROM t ORDER BY a, b")
+        METRICS.reset()
+        first = collect(rel).to_rows()
+        assert METRICS.snapshot()["counts"].get("sort.host_routed_runs")
+        collect(rel)  # second pass: key admitted to the cache
+        before = METRICS.snapshot()["counts"].get("sort.host_perm_cache_hits", 0)
+        third = collect(rel).to_rows()
+        after = METRICS.snapshot()["counts"].get("sort.host_perm_cache_hits", 0)
+        assert after > before
+        assert third == first
+
     def test_full_sort_with_large_limit_host_route(self, monkeypatch):
         # LIMIT above TOPK_MAX takes the full-sort path; the host-routed
         # permutation must honor the prefix take
