@@ -77,10 +77,20 @@ class Console:
 
     def handle_command(self, line: str) -> bool:
         """Backslash console commands; True when `line` was one."""
-        cmd = line.strip().lower()
+        stripped = line.strip()
+        cmd = stripped.lower()
         if cmd == "\\timing":
             self.timing = not self.timing
             self._print(f"Timing is {'on' if self.timing else 'off'}.")
+            return True
+        if cmd == "\\explain" or cmd.startswith("\\explain "):
+            # \explain SELECT ... — run EXPLAIN ANALYZE and render the
+            # annotated operator tree + span timeline (obs/explain.py)
+            arg = stripped[len("\\explain"):].strip().rstrip(";").strip()
+            if not arg:
+                self._print("Usage: \\explain <sql statement>")
+            else:
+                self.execute(f"EXPLAIN ANALYZE {arg}")
             return True
         return False
 
@@ -102,13 +112,19 @@ class Console:
             self._print(f"Error: {e}")
             return
         elapsed = time.perf_counter() - t0
+        from datafusion_tpu.exec.context import ExplainResult
         from datafusion_tpu.exec.materialize import ResultTable
+        from datafusion_tpu.obs.explain import ExplainAnalyzeResult
 
         if isinstance(result, ResultTable):
             for row in result.to_rows():
                 self._print(
                     "\t".join("NULL" if v is None else str(v) for v in row)
                 )
+        elif isinstance(result, (ExplainResult, ExplainAnalyzeResult)):
+            # the plan tree (EXPLAIN) or the annotated operator tree +
+            # span timeline (EXPLAIN ANALYZE / \explain)
+            self._print(repr(result))
         # "seconds" keeps this line inside the golden diff's -I filter
         self._print(f"Query executed in {elapsed:.3f} seconds")
         if self.timing:

@@ -1226,7 +1226,9 @@ class AggregateRelation(Relation):
         """
         import itertools
 
-        src = iter(self.child.batches())
+        from datafusion_tpu.obs.stats import iter_stats
+
+        src = iter(iter_stats(self.child))
         first = next(src, None)
         if first is None:
             return self._init_state(group_capacity(1))
@@ -1250,6 +1252,7 @@ class AggregateRelation(Relation):
         from datafusion_tpu.exec.batch import device_inputs
         from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
         from datafusion_tpu.exec.relation import device_scope
+        from datafusion_tpu.obs.stats import op_timer
 
         if pipeline_enabled(self.device):
             # producer thread runs all host prep for batch N+1 (group-id
@@ -1303,7 +1306,8 @@ class AggregateRelation(Relation):
             elif needed > capacity:
                 state = core._grow_state(state, needed)
                 capacity = needed
-            with METRICS.timer("execute.aggregate"), device_scope(self.device):
+            with METRICS.timer("execute.aggregate"), op_timer(self), \
+                    device_scope(self.device):
                 if len(chunk) == 1:
                     c = chunk[0]
                     state = device_call(
@@ -1631,6 +1635,14 @@ class AggregateRelation(Relation):
                 out_valid.append(valid)
                 out_dicts.append(d)
         return make_host_batch(self._schema, out_cols, out_valid, out_dicts)
+
+    def op_label(self) -> str:
+        pred = self._host_pred_expr or self._core_pred
+        return (
+            f"Aggregate[keys={len(self.key_cols)}, slots={len(self.slots)}"
+            + (", filtered" if pred is not None else "")
+            + "]"
+        )
 
     def batches(self) -> Iterator[RecordBatch]:
         yield self.finalize(self.accumulate())

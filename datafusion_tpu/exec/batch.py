@@ -27,8 +27,17 @@ import numpy as np
 
 from datafusion_tpu.datatypes import Schema
 from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.obs.stats import record_d2h as _op_d2h
+from datafusion_tpu.obs.stats import record_h2d as _op_h2d
 
 MIN_CAPACITY = 1024
+
+
+def _record_d2h(metrics, nbytes: int) -> None:
+    """Engine-wide D2H byte counter + ambient-operator attribution
+    (EXPLAIN ANALYZE); one counter add when no operator is ambient."""
+    metrics.add("d2h.bytes", nbytes)
+    _op_d2h(nbytes)
 
 
 def bucket_capacity(n: int) -> int:
@@ -763,14 +772,18 @@ class PendingPull:
     def finish(self):
         import jax
 
+        from datafusion_tpu.utils.metrics import METRICS
+
         out = list(self._leaves)
         for i in self._extra_direct:
             out[i] = np.asarray(out[i])
         if self._blob is None:
             for i in self._dev_idx:
                 out[i] = np.asarray(out[i])
+                _record_d2h(METRICS, out[i].nbytes)
             return jax.tree.unflatten(self._treedef, out)
         blob = np.asarray(self._blob)
+        _record_d2h(METRICS, blob.nbytes)
         off = 0
         split = self._strategy == "split"
         for i, (dtype_str, shape) in zip(self._dev_idx, self._sig):
@@ -879,6 +892,7 @@ def put_compressed(host_arrays, device=None, hints=None):
         for a in host_arrays:
             if isinstance(a, np.ndarray):
                 METRICS.add("h2d.bytes", a.nbytes)
+                _op_h2d(a.nbytes)
                 out.append(put(a))
             else:
                 out.append(a)
@@ -910,6 +924,7 @@ def put_compressed(host_arrays, device=None, hints=None):
         for w in wires:
             if isinstance(w, np.ndarray):
                 METRICS.add("h2d.bytes", w.nbytes)
+                _op_h2d(w.nbytes)
         wire_lists.append(wires)
 
     n_host = sum(

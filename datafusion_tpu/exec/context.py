@@ -203,7 +203,15 @@ class ExecutionContext:
         if isinstance(stmt, ast.SqlCreateExternalTable):
             return self._execute_ddl(stmt)
         if isinstance(stmt, ast.SqlExplain):
-            return ExplainResult(self._plan(stmt.stmt))
+            plan = self._plan(stmt.stmt)
+            if stmt.analyze:
+                # EXPLAIN ANALYZE executes the query under a trace
+                # session and annotates the operator tree with measured
+                # stats (obs/explain.py)
+                from datafusion_tpu.obs.explain import explain_analyze
+
+                return explain_analyze(self, plan)
+            return ExplainResult(plan)
         plan = self._plan(stmt)
         return self.execute(plan)
 
@@ -337,3 +345,10 @@ class ExecutionContext:
 
     def metrics(self) -> dict:
         return METRICS.snapshot()
+
+    def metrics_text(self) -> str:
+        """Engine counters/timings in Prometheus text exposition format
+        (obs/export.py; `METRICS` is the single counter backend)."""
+        from datafusion_tpu.obs.export import prometheus_text
+
+        return prometheus_text(METRICS)

@@ -48,7 +48,15 @@ def _is_accelerator(device) -> bool:
 
 
 class Relation:
-    """Pull-based iterator of RecordBatches (reference `Relation` trait)."""
+    """Pull-based iterator of RecordBatches (reference `Relation` trait).
+
+    Every relation doubles as a physical plan node for observability:
+    it lazily owns an `OperatorStats` (`.stats`), names itself
+    (`op_name`/`op_label`), and exposes its operator children
+    (`op_children`) so EXPLAIN ANALYZE can walk the executed tree.
+    """
+
+    _op_stats = None
 
     @property
     def schema(self) -> Schema:
@@ -56,6 +64,37 @@ class Relation:
 
     def batches(self) -> Iterator[RecordBatch]:
         raise NotImplementedError
+
+    @property
+    def stats(self):
+        """Per-operator runtime stats (populated only on instrumented
+        runs — EXPLAIN ANALYZE / DATAFUSION_TPU_TRACE=1)."""
+        st = self._op_stats
+        if st is None:
+            from datafusion_tpu.obs.stats import OperatorStats
+
+            st = self._op_stats = OperatorStats()
+        return st
+
+    def op_name(self) -> str:
+        name = type(self).__name__
+        for junk in ("Relation", "Exec", "_"):
+            name = name.replace(junk, "")
+        return name or type(self).__name__
+
+    def op_label(self) -> str:
+        """One-line description for the EXPLAIN ANALYZE tree."""
+        return self.op_name()
+
+    def op_children(self) -> list["Relation"]:
+        kids = getattr(self, "children", None)
+        if isinstance(kids, (list, tuple)):
+            return [k for k in kids if isinstance(k, Relation)]
+        for attr in ("child", "rel", "inner"):
+            c = getattr(self, attr, None)
+            if isinstance(c, Relation):
+                return [c]
+        return []
 
 
 class DataSourceRelation(Relation):
@@ -67,6 +106,13 @@ class DataSourceRelation(Relation):
     @property
     def schema(self) -> Schema:
         return self.datasource.schema
+
+    def op_label(self) -> str:
+        src = type(self.datasource).__name__.replace("DataSource", "")
+        path = getattr(self.datasource, "filename", None) or getattr(
+            self.datasource, "path", None
+        )
+        return f"Scan[{src}{f': {path}' if path else ''}]"
 
     def batches(self) -> Iterator[RecordBatch]:
         return self.datasource.batches()
@@ -341,12 +387,21 @@ class PipelineRelation(Relation):
     def schema(self) -> Schema:
         return self._schema
 
+    def op_label(self) -> str:
+        parts = []
+        if self.predicate is not None or self._host_pred_expr is not None:
+            parts.append("filter")
+        if self.projections is not None:
+            parts.append("project")
+        return f"Pipeline[{'+'.join(parts) or 'pass'}]"
+
     def batches(self) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.batch import device_inputs
         from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
+        from datafusion_tpu.obs.stats import iter_stats, op_timer
 
         core = self.core
-        batches = self.child.batches()
+        batches = iter_stats(self.child)
         if core.needs_kernel and pipeline_enabled(self.device):
             # host prep for batch N+1 (aux tables, wire encode, H2D
             # dispatch) runs on the producer thread while batch N's
@@ -397,7 +452,8 @@ class PipelineRelation(Relation):
                     aux = tuple(
                         compute_aux_values(core.aux_specs, batch, self._aux_cache)
                     )
-                with METRICS.timer("execute.pipeline"), device_scope(self.device):
+                with METRICS.timer("execute.pipeline"), op_timer(self), \
+                        device_scope(self.device):
                     data, validity, mask_in = device_inputs(
                         self._subset_view(batch), self.device, core.wire_hints
                     )

@@ -602,7 +602,10 @@ class SortRelation(Relation):
             nonlocal state
             if not chunk:
                 return
-            with METRICS.timer("execute.sort"), _device_scope(self.device):
+            from datafusion_tpu.obs.stats import op_timer
+
+            with METRICS.timer("execute.sort"), op_timer(self), \
+                    _device_scope(self.device):
                 if len(chunk) == 1:
                     c = chunk[0]
                     args = [k, state, c[0], c[1], c[2], c[3], c[4], c[5]]
@@ -659,7 +662,9 @@ class SortRelation(Relation):
                     if j not in keep:
                         src_batches[j] = None
 
-        for batch in self.child.batches():
+        from datafusion_tpu.obs.stats import iter_stats
+
+        for batch in iter_stats(self.child):
             for i, d in enumerate(batch.dicts):
                 if d is not None:
                     dicts[i] = d
@@ -998,6 +1003,15 @@ class SortRelation(Relation):
             items = merged
         return items[0][1]
 
+    def op_label(self) -> str:
+        keys = ", ".join(
+            f"#{se.expr.index} {'ASC' if se.asc else 'DESC'}"
+            for se in self.sort_expr
+        )
+        if self.limit is not None and 0 < self.limit <= TOPK_MAX:
+            return f"TopK[{keys}, limit={self.limit}]"
+        return f"Sort[{keys}]"
+
     def batches(self) -> Iterator[RecordBatch]:
         if (
             self.limit is not None
@@ -1054,7 +1068,10 @@ class SortRelation(Relation):
                 if cache_key is not None
                 else None
             )
-            with METRICS.timer("execute.sort"), _device_scope(self.device):
+            from datafusion_tpu.obs.stats import op_timer
+
+            with METRICS.timer("execute.sort"), op_timer(self), \
+                    _device_scope(self.device):
                 if hit is not None and hit[0] == "perm":
                     # host-routed run cached whole: the permutation IS
                     # the artifact (no device buffers to re-sort), so a
@@ -1076,7 +1093,9 @@ class SortRelation(Relation):
             pending_n = 0
             run_src = []
 
-        for batch in iter_with_mask_prefetch(self.child.batches()):
+        from datafusion_tpu.obs.stats import iter_stats
+
+        for batch in iter_with_mask_prefetch(iter_stats(self.child)):
             for i, d in enumerate(batch.dicts):
                 if d is not None:
                     dicts[i] = d
@@ -1180,14 +1199,19 @@ class LimitRelation(Relation):
     def schema(self) -> Schema:
         return self._schema
 
+    def op_label(self) -> str:
+        return f"Limit[{self.limit}]"
+
     def batches(self) -> Iterator[RecordBatch]:
         remaining = self.limit
         if remaining <= 0:
             return
+        from datafusion_tpu.obs.stats import iter_stats
+
         # NO mask prefetch here: the early return below exists to avoid
         # pulling (parsing, dispatching) any batch past the limit, and a
         # one-ahead prefetch would defeat exactly that
-        for batch in self.child.batches():
+        for batch in iter_stats(self.child):
             cols, valids, dicts, n = compact_batch(batch)
             if n == 0:
                 continue

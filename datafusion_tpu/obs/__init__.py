@@ -1,0 +1,42 @@
+"""End-to-end observability: hierarchical spans, per-operator runtime
+stats, EXPLAIN ANALYZE, and exporters.
+
+The reference engine's only observability is a console wall clock
+(`src/bin/console/main.rs:133`) and a `println!` of the plan; this
+package explains *where a query's time went* — per operator, per
+fragment, per worker:
+
+- `obs.trace` — Dapper-style hierarchical spans (`span(name, **attrs)`)
+  with a per-query `TraceContext` that rides fragment requests over the
+  wire so worker-side spans parent under the coordinator's dispatch
+  span.  Near-zero cost when disabled.
+- `obs.stats` — per-operator runtime stats (rows/batches out, device
+  execute vs XLA compile time, H2D/D2H bytes, transient retries)
+  attached to physical operators (`Relation.stats`).
+- `obs.explain` — `EXPLAIN ANALYZE <sql>`: runs the query under a trace
+  session and renders the annotated operator tree + span tree.
+- `obs.export` — Chrome-trace / Perfetto JSON (coordinator and worker
+  timelines merged by trace_id) and a Prometheus-style text dump of the
+  engine counters (`utils.metrics.METRICS` is the counter backend —
+  nothing is double-counted).
+
+Env knobs: `DATAFUSION_TPU_TRACE=1` enables span collection engine-wide;
+`DATAFUSION_TPU_TRACE_FILE=path.json` additionally writes a Chrome trace
+at process exit; `DATAFUSION_TPU_TRACE_BUF` bounds the in-memory span
+buffer (default 100000; overflow counts in `obs.spans_dropped`).
+"""
+
+from datafusion_tpu.obs.trace import (  # noqa: F401 — public API surface
+    TraceContext,
+    adopt,
+    current_span,
+    current_trace,
+    disable,
+    drain,
+    enable,
+    enabled,
+    ingest,
+    session,
+    span,
+    spans,
+)

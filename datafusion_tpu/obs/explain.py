@@ -1,0 +1,160 @@
+"""EXPLAIN ANALYZE: run the query under a trace session, annotate the
+physical operator tree with its measured runtime stats, and render the
+merged span timeline (coordinator + worker).
+
+The reference engine printed the logical plan and a wall clock and
+nothing else; DataFusion later grew `EXPLAIN ANALYZE` as the standard
+way to see per-operator rows and timings — this is that, for the TPU
+rebuild, with the distributed path's worker-side fragment spans folded
+into the same report.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from datafusion_tpu.obs import trace
+from datafusion_tpu.obs.stats import collect_tree, iter_stats
+
+
+def _fmt_bytes(n: int) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f}GiB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f}MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f}KiB"
+    return f"{n}B"
+
+
+def _fmt_s(s: float) -> str:
+    return f"{s * 1e3:.3f}ms" if s < 1.0 else f"{s:.3f}s"
+
+
+def _op_line(rel) -> str:
+    st = rel.stats
+    parts = [f"rows={st.rows_out}", f"batches={st.batches_out}",
+             f"time={_fmt_s(st.time_s)}"]
+    if st.execute_s:
+        parts.append(f"device={_fmt_s(st.execute_s)}")
+    if st.compile_s:
+        parts.append(f"compile={_fmt_s(st.compile_s)}")
+    if st.h2d_bytes:
+        parts.append(f"h2d={_fmt_bytes(st.h2d_bytes)}")
+    if st.d2h_bytes:
+        parts.append(f"d2h={_fmt_bytes(st.d2h_bytes)}")
+    if st.retries:
+        parts.append(f"retries={st.retries}")
+    for k, v in st.attrs.items():
+        parts.append(f"{k}={v}")
+    return f"{rel.op_label()}  [{', '.join(parts)}]"
+
+
+def _render_spans(span_dicts: list[dict]) -> list[str]:
+    """Indent spans under their parents (orphans — e.g. a prefetch
+    thread's — sit at the root level) in start-time order."""
+    by_id = {s["span_id"]: s for s in span_dicts}
+    children: dict[Optional[str], list[dict]] = {}
+    for s in span_dicts:
+        parent = s.get("parent_id")
+        if parent not in by_id:
+            parent = None
+        children.setdefault(parent, []).append(s)
+    for kids in children.values():
+        kids.sort(key=lambda s: s["start_ns"])
+    lines: list[str] = []
+
+    def walk(parent_id, depth):
+        for s in children.get(parent_id, ()):
+            dur = max(s["end_ns"] - s["start_ns"], 0) / 1e9
+            attrs = s.get("attrs") or {}
+            attr_txt = (
+                "{" + ", ".join(f"{k}={v}" for k, v in attrs.items()) + "}"
+                if attrs
+                else ""
+            )
+            lines.append(
+                "  " * depth
+                + f"{s['name']}{attr_txt}  {_fmt_s(dur)}  [{s.get('proc', '?')}]"
+            )
+            walk(s["span_id"], depth + 1)
+
+    walk(None, 0)
+    return lines
+
+
+class ExplainAnalyzeResult:
+    """The materialized result of `EXPLAIN ANALYZE <stmt>`: the logical
+    plan, the executed operator tree (stats attached), the query's rows
+    (`.result`), and the merged span list (`.spans`).  `repr()` renders
+    the annotated report; `chrome_trace()` exports the timeline."""
+
+    def __init__(self, plan, root, result, spans: list[dict],
+                 trace_id: str, wall_s: float):
+        self.plan = plan
+        self.root = root
+        self.result = result
+        self.spans = spans
+        self.trace_id = trace_id
+        self.wall_s = wall_s
+
+    def report(self) -> str:
+        lines = [f"EXPLAIN ANALYZE  (trace {self.trace_id}, "
+                 f"wall {_fmt_s(self.wall_s)}, rows {self.result.num_rows})"]
+        for depth, rel in collect_tree(self.root):
+            lines.append("  " * (depth + 1) + _op_line(rel))
+        worker_spans = sum(
+            1 for s in self.spans if str(s.get("proc", "")).startswith("worker")
+        )
+        lines.append(
+            f"Spans ({len(self.spans)} total, {worker_spans} worker-side):"
+        )
+        lines += ["  " + ln for ln in _render_spans(self.spans)]
+        return "\n".join(lines)
+
+    def chrome_trace(self) -> dict:
+        from datafusion_tpu.obs.export import chrome_trace
+
+        return chrome_trace(self.spans)
+
+    def write_chrome_trace(self, path: str) -> str:
+        from datafusion_tpu.obs.export import write_chrome_trace
+
+        return write_chrome_trace(path, self.spans)
+
+    def __repr__(self):
+        return self.report()
+
+
+class _RootTap:
+    """Relation facade whose batches() run through the instrumentation
+    seam — gives the ROOT operator its stats (interior operators are
+    instrumented by their consumers)."""
+
+    def __init__(self, rel):
+        self.rel = rel
+
+    @property
+    def schema(self):
+        return self.rel.schema
+
+    def batches(self):
+        return iter_stats(self.rel)
+
+
+def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
+    """Execute `plan` on `ctx` under a fresh trace session and package
+    the annotated result.  The query runs to completion (EXPLAIN
+    ANALYZE measures a real execution, not an estimate)."""
+    from datafusion_tpu.exec.materialize import collect
+
+    with trace.session() as tc:
+        t0 = time.perf_counter()
+        with trace.span("query", plan=type(plan).__name__):
+            rel = ctx.execute(plan)
+            table = collect(_RootTap(rel))
+        wall = time.perf_counter() - t0
+    spans = trace.drain(tc.trace_id)
+    spans.sort(key=lambda s: s["start_ns"])
+    return ExplainAnalyzeResult(plan, rel, table, spans, tc.trace_id, wall)

@@ -1,0 +1,106 @@
+"""Exporters: Chrome-trace / Perfetto JSON and Prometheus text.
+
+`chrome_trace(spans)` turns span dicts (local or worker-ingested — any
+mix; timelines merge by trace_id since both sides stamp the shared wall
+clock) into the Chrome `traceEvents` format loadable by
+`chrome://tracing` and https://ui.perfetto.dev.  `prometheus_text()`
+renders the engine's counter/timing registry (`utils.metrics.METRICS` —
+the single counter backend, nothing re-counted here) in the Prometheus
+text exposition format for scraping or ad-hoc dumps.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Optional
+
+from datafusion_tpu.utils.metrics import METRICS
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def chrome_trace(spans: list[dict]) -> dict:
+    """Complete-event (`ph: "X"`) Chrome trace from span dicts.  Each
+    distinct span `proc` becomes a trace process (with a process_name
+    metadata record), so coordinator and worker timelines render as
+    separate swimlanes of one merged trace."""
+    pids: dict[str, int] = {}
+    events: list[dict] = []
+    for sp in spans:
+        proc = str(sp.get("proc", "?"))
+        pid = pids.get(proc)
+        if pid is None:
+            pid = pids[proc] = len(pids) + 1
+            events.append({
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": proc},
+            })
+        args = dict(sp.get("attrs") or {})
+        args["trace_id"] = sp.get("trace_id")
+        args["span_id"] = sp.get("span_id")
+        if sp.get("parent_id"):
+            args["parent_id"] = sp["parent_id"]
+        events.append({
+            "ph": "X",
+            "name": sp["name"],
+            "cat": "datafusion_tpu",
+            "ts": sp["start_ns"] / 1e3,  # chrome wants microseconds
+            "dur": max(sp["end_ns"] - sp["start_ns"], 0) / 1e3,
+            "pid": pid,
+            "tid": int(sp.get("tid", 0)) % (1 << 31),
+            "args": args,
+        })
+    events.sort(key=lambda e: (e["ph"] != "M", e.get("ts", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(path: str, spans: list[dict]) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(chrome_trace(spans), f)
+    return path
+
+
+def _metric_name(name: str) -> str:
+    return _NAME_RE.sub("_", name)
+
+
+def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
+    """The engine counter registry in Prometheus text exposition format.
+
+    Timings render as `datafusion_tpu_timing_seconds_total{stage=...}`,
+    counters as `datafusion_tpu_events_total{name=...}`; `extra_gauges`
+    ({name: value}) lets callers add point-in-time gauges (queue depths,
+    buffered spans) without minting a second registry.
+    """
+    snap = (metrics if metrics is not None else METRICS).snapshot()
+    lines = [
+        "# HELP datafusion_tpu_timing_seconds_total cumulative engine "
+        "stage timings",
+        "# TYPE datafusion_tpu_timing_seconds_total counter",
+    ]
+    for k in sorted(snap["timings_s"]):
+        lines.append(
+            f'datafusion_tpu_timing_seconds_total{{stage="{_metric_name(k)}"}} '
+            f"{snap['timings_s'][k]:.9f}"
+        )
+    lines += [
+        "# HELP datafusion_tpu_events_total cumulative engine counters",
+        "# TYPE datafusion_tpu_events_total counter",
+    ]
+    for k in sorted(snap["counts"]):
+        lines.append(
+            f'datafusion_tpu_events_total{{name="{_metric_name(k)}"}} '
+            f"{snap['counts'][k]}"
+        )
+    if extra_gauges:
+        lines.append("# TYPE datafusion_tpu_gauge gauge")
+        for k in sorted(extra_gauges):
+            lines.append(
+                f'datafusion_tpu_gauge{{name="{_metric_name(k)}"}} '
+                f"{extra_gauges[k]}"
+            )
+    return "\n".join(lines) + "\n"

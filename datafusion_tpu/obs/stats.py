@@ -1,0 +1,183 @@
+"""Per-operator runtime statistics.
+
+Every physical operator (`exec.relation.Relation` and subclasses)
+lazily owns an `OperatorStats`; when observability is enabled
+(`obs.trace.enabled()`), consumers pull child batches through
+`iter_stats(child)`, which records per-operator rows/batches out and
+cumulative produce time, and — via a contextvar — makes the producing
+operator *ambient*, so the transfer layer (`exec/batch.py`), the retry
+layer (`utils/retry.py`), and the XLA compile listener attribute
+H2D/D2H bytes, transient retries, and compile seconds to the operator
+whose `batches()` body is actually running.  When disabled,
+`iter_stats` returns the raw iterator: the hot path pays one module
+flag read and nothing else.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import time
+from typing import Optional
+
+import numpy as np
+
+from datafusion_tpu.obs.trace import _NOOP, begin_span, enabled, finish_span
+
+_CUR_OP: contextvars.ContextVar[Optional["OperatorStats"]] = (
+    contextvars.ContextVar("datafusion_tpu_cur_op", default=None)
+)
+
+
+class OperatorStats:
+    """Counters for one physical operator in one (or more) runs.
+
+    `time_s` is cumulative wall time spent *producing* this operator's
+    output (its children's time included — the standard EXPLAIN ANALYZE
+    reading); `execute_s` is the slice spent inside this operator's own
+    device dispatches; `compile_s` is XLA compilation attributed while
+    this operator was ambient.
+    """
+
+    __slots__ = ("rows_out", "batches_out", "time_s", "execute_s",
+                 "compile_s", "h2d_bytes", "d2h_bytes", "retries", "attrs")
+
+    def __init__(self):
+        self.rows_out = 0
+        self.batches_out = 0
+        self.time_s = 0.0
+        self.execute_s = 0.0
+        self.compile_s = 0.0
+        self.h2d_bytes = 0
+        self.d2h_bytes = 0
+        self.retries = 0
+        self.attrs: dict = {}
+
+    def snapshot(self) -> dict:
+        out = {
+            "rows_out": self.rows_out,
+            "batches_out": self.batches_out,
+            "time_s": self.time_s,
+            "execute_s": self.execute_s,
+            "compile_s": self.compile_s,
+            "h2d_bytes": self.h2d_bytes,
+            "d2h_bytes": self.d2h_bytes,
+            "retries": self.retries,
+        }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        return out
+
+    def __repr__(self):
+        return f"OperatorStats({self.snapshot()})"
+
+
+def current_op() -> Optional[OperatorStats]:
+    """The ambient operator's stats (None outside instrumented runs)."""
+    return _CUR_OP.get()
+
+
+def record_h2d(nbytes: int) -> None:
+    st = _CUR_OP.get()
+    if st is not None:
+        st.h2d_bytes += nbytes
+
+
+def record_d2h(nbytes: int) -> None:
+    st = _CUR_OP.get()
+    if st is not None:
+        st.d2h_bytes += nbytes
+
+
+def record_retry() -> None:
+    st = _CUR_OP.get()
+    if st is not None:
+        st.retries += 1
+
+
+def live_rows(batch) -> int:
+    """Rows a batch actually contributes (mask- and padding-aware).
+    Pulls a device-resident mask to host — only ever called on
+    instrumented (EXPLAIN ANALYZE / traced) runs."""
+    mask = batch.mask
+    if mask is None:
+        return int(batch.num_rows)
+    m = np.asarray(mask)[: batch.capacity]
+    return int((m & (np.arange(m.shape[0]) < batch.num_rows)).sum())
+
+
+class _ExecTimer:
+    """Times a device dispatch into the operator's `execute_s` and makes
+    the operator ambient for the call (so retries/compiles inside the
+    dispatch attribute here rather than to the batch producer)."""
+
+    __slots__ = ("_st", "_t0", "_tok")
+
+    def __init__(self, st: OperatorStats):
+        self._st = st
+
+    def __enter__(self):
+        self._tok = _CUR_OP.set(self._st)
+        self._t0 = time.perf_counter()
+        return self._st
+
+    def __exit__(self, *exc_info):
+        self._st.execute_s += time.perf_counter() - self._t0
+        _CUR_OP.reset(self._tok)
+        return False
+
+
+def op_timer(relation):
+    """`with op_timer(self):` around an operator's device dispatch;
+    the shared no-op singleton (trace._NOOP) when observability is
+    off."""
+    if not enabled():
+        return _NOOP
+    return _ExecTimer(relation.stats)
+
+
+def iter_stats(relation, it=None):
+    """The instrumentation seam: wrap `relation.batches()` (or an
+    explicit iterator over its output) so the relation's OperatorStats
+    record rows/batches/time and the relation is ambient while its
+    batches are being produced.  Pass-through when disabled."""
+    if not enabled():
+        return relation.batches() if it is None else it
+    return _instrumented(relation, relation.batches() if it is None else it)
+
+
+def _instrumented(relation, it):
+    st = relation.stats
+    sp = begin_span(f"op.{relation.op_name()}")
+    try:
+        while True:
+            tok = _CUR_OP.set(st)
+            t0 = time.perf_counter()
+            try:
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+            finally:
+                st.time_s += time.perf_counter() - t0
+                _CUR_OP.reset(tok)
+            st.batches_out += 1
+            st.rows_out += live_rows(batch)
+            yield batch
+    finally:
+        if sp is not None:
+            sp.attrs.update(rows=st.rows_out, batches=st.batches_out)
+            finish_span(sp)
+
+
+def collect_tree(relation) -> list[tuple[int, "object"]]:
+    """Flatten an operator tree into (depth, relation) pairs, root
+    first (the EXPLAIN ANALYZE rendering order)."""
+    out: list[tuple[int, object]] = []
+
+    def walk(rel, depth):
+        out.append((depth, rel))
+        for child in rel.op_children():
+            walk(child, depth + 1)
+
+    walk(relation, 0)
+    return out

@@ -1,0 +1,444 @@
+"""Hierarchical span tracing (the Dapper model, sized for one engine).
+
+A *span* is a named, timed interval with attributes; spans nest via a
+contextvar, so `with span("a"): with span("b"): ...` records b with a
+as its parent.  A *trace* groups every span of one query under a shared
+`trace_id`; the coordinator ships `{trace_id, parent_span_id}` inside
+fragment requests (`parallel/wire.py` JSON region) and workers `adopt`
+it, so a worker's `worker.fragment` span parents under the
+coordinator's `coord.dispatch` span even across processes.  Workers
+return their finished spans in the response; the coordinator `ingest`s
+them — one merged timeline, no clock-sync machinery beyond sharing the
+wall clock (`time.time_ns`).
+
+Cost model: when disabled, `span(name)` returns a process-wide no-op
+singleton — one module-flag read, zero allocations; instrumentation
+that wants to pass attributes guards with `enabled()` first.  When
+enabled, finished spans append to a lock-protected bounded buffer
+(`DATAFUSION_TPU_TRACE_BUF`, default 100000; drops count in the
+`obs.spans_dropped` METRICS counter — the existing `Metrics` registry
+is the counter backend for the whole subsystem).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+from typing import Any, Optional
+
+from datafusion_tpu.utils.metrics import METRICS
+
+_TRUTHY = ("1", "true", "on", "yes")
+_ENABLED = os.environ.get("DATAFUSION_TPU_TRACE", "").lower() in _TRUTHY
+_SESSION_DEPTH = 0  # active trace sessions (EXPLAIN ANALYZE runs)
+_MAX_SPANS = int(os.environ.get("DATAFUSION_TPU_TRACE_BUF", "100000") or 100000)
+_ROLE = "main"  # worker entry points set "worker" (set_process_role)
+
+_lock = threading.Lock()
+_spans: list["Span"] = []
+_compile_listener_installed = False
+
+
+def _new_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class TraceContext:
+    """One query's trace identity: the shared `trace_id` plus the span
+    id that children created from this context should parent under."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: Optional[str] = None,
+                 span_id: Optional[str] = None):
+        self.trace_id = trace_id or _new_id()
+        self.span_id = span_id
+
+    def to_wire(self) -> dict:
+        """The dict that rides a fragment request's JSON region."""
+        return {"trace_id": self.trace_id, "parent_span_id": self.span_id}
+
+    @staticmethod
+    def from_wire(obj: Optional[dict]) -> Optional["TraceContext"]:
+        if not isinstance(obj, dict) or not obj.get("trace_id"):
+            return None
+        return TraceContext(str(obj["trace_id"]),
+                            obj.get("parent_span_id") or None)
+
+    def __repr__(self):
+        return f"TraceContext({self.trace_id}, parent={self.span_id})"
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "start_ns",
+                 "end_ns", "attrs", "tid", "proc")
+
+    def __init__(self, name: str, trace_id: str, parent_id: Optional[str],
+                 attrs: Optional[dict] = None):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.start_ns = time.time_ns()
+        self.end_ns = 0
+        self.attrs = attrs or {}
+        self.tid = threading.get_ident()
+        self.proc = f"{_ROLE}:{os.getpid()}"
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.end_ns - self.start_ns, 0) / 1e9
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "start_ns": self.start_ns,
+            "end_ns": self.end_ns,
+            "attrs": self.attrs,
+            "tid": self.tid,
+            "proc": self.proc,
+        }
+
+    @staticmethod
+    def from_json(obj: dict) -> "Span":
+        sp = Span.__new__(Span)
+        sp.name = obj["name"]
+        sp.trace_id = obj["trace_id"]
+        sp.span_id = obj["span_id"]
+        sp.parent_id = obj.get("parent_id")
+        sp.start_ns = int(obj["start_ns"])
+        sp.end_ns = int(obj["end_ns"])
+        sp.attrs = obj.get("attrs") or {}
+        sp.tid = obj.get("tid", 0)
+        sp.proc = obj.get("proc", "?")
+        return sp
+
+    def __repr__(self):
+        return f"Span({self.name}, {self.duration_s * 1e3:.3f}ms)"
+
+
+_current_span: contextvars.ContextVar[Optional[Span]] = contextvars.ContextVar(
+    "datafusion_tpu_span", default=None
+)
+_current_trace: contextvars.ContextVar[Optional[TraceContext]] = (
+    contextvars.ContextVar("datafusion_tpu_trace", default=None)
+)
+# process-default trace for spans recorded outside any session/adoption
+# (e.g. DATAFUSION_TPU_TRACE=1 with plain ctx.sql_collect calls)
+_ambient_trace: Optional[TraceContext] = None
+
+
+def enabled() -> bool:
+    """Collection is on when the engine-wide flag is set, a trace
+    session (EXPLAIN ANALYZE) is active, or THIS thread carries an
+    adopted trace context (a worker handler serving a traced request —
+    contextvar-scoped, so concurrent untraced requests on other handler
+    threads stay dark and never leak orphan spans into the buffer)."""
+    return (
+        _ENABLED
+        or _SESSION_DEPTH > 0
+        or _current_trace.get() is not None
+    )
+
+
+def enable() -> None:
+    global _ENABLED
+    _ENABLED = True
+    _install_compile_listener()
+
+
+def disable() -> None:
+    global _ENABLED
+    _ENABLED = False
+
+
+def set_process_role(role: str) -> None:
+    """Tag spans from this process (workers pass "worker"); mirrors
+    `testing.faults.set_role`."""
+    global _ROLE
+    _ROLE = role
+
+
+def current_trace(create: bool = False) -> Optional[TraceContext]:
+    tc = _current_trace.get()
+    if tc is None and create:
+        global _ambient_trace
+        with _lock:  # two threads must not mint two ambient traces
+            if _ambient_trace is None:
+                _ambient_trace = TraceContext()
+            tc = _ambient_trace
+    return tc
+
+
+def current_span() -> Optional[Span]:
+    return _current_span.get()
+
+
+def wire_context() -> Optional[dict]:
+    """The propagation dict for an outgoing fragment request: current
+    trace_id plus the current span as the remote parent.  None when
+    tracing is disabled."""
+    if not enabled():
+        return None
+    tc = current_trace(create=True)
+    sp = _current_span.get()
+    return {
+        "trace_id": tc.trace_id,
+        "parent_span_id": sp.span_id if sp is not None else tc.span_id,
+    }
+
+
+def begin_span(name: str, parent: Optional[Span] = None,
+               attrs: Optional[dict] = None,
+               trace_id: Optional[str] = None) -> Optional[Span]:
+    """Start a span WITHOUT making it the contextvar current (for spans
+    whose lifetime crosses generator resumes or thread hops; pair with
+    `finish_span`).  Returns None when disabled.  Pass `parent` and/or
+    `trace_id` explicitly from code running on pool threads —
+    contextvars do not cross thread boundaries."""
+    if not enabled():
+        return None
+    if parent is None:
+        parent = _current_span.get()
+    if trace_id is None:
+        trace_id = getattr(parent, "trace_id", None)
+    parent_id = parent.span_id if parent is not None else None
+    if trace_id is None:
+        tc = current_trace(create=True)
+        trace_id = tc.trace_id
+        if parent_id is None:
+            parent_id = tc.span_id
+    return Span(name, trace_id, parent_id, attrs)
+
+
+def finish_span(sp: Optional[Span]) -> None:
+    if sp is None:
+        return
+    sp.end_ns = time.time_ns()
+    _record(sp)
+
+
+def _record(sp: Span) -> None:
+    with _lock:
+        _spans.append(sp)
+        if len(_spans) > _MAX_SPANS:
+            # drop the OLDEST on overflow: a long-lived env-traced
+            # worker whose untraced-request spans are never drained must
+            # not wedge the buffer against future traced requests
+            del _spans[0]
+            METRICS.add("obs.spans_dropped")
+    METRICS.add("obs.spans")
+
+
+class _NoopSpan:
+    """Singleton no-op context manager: the disabled-mode hot path
+    allocates nothing (`span("x") is span("y")`)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc_info):
+        return False
+
+
+_NOOP = _NoopSpan()
+
+
+class _SpanScope:
+    __slots__ = ("_name", "_attrs", "_span", "_token")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> Span:
+        sp = begin_span(self._name, attrs=self._attrs)
+        if sp is None:  # disabled between construction and entry
+            sp = Span(self._name, "disabled", None, self._attrs)
+        self._span = sp
+        self._token = _current_span.set(sp)
+        return sp
+
+    def __exit__(self, *exc_info):
+        _current_span.reset(self._token)
+        if self._span.trace_id != "disabled":
+            finish_span(self._span)
+        return False
+
+
+def span(name: str, **attrs: Any):
+    """`with span("stage", key=value): ...` — records a nested span.
+    When tracing is disabled this returns a shared no-op singleton;
+    call sites on hot paths that build attribute dicts should guard
+    with `enabled()` to skip even the kwargs allocation."""
+    if not enabled():
+        return _NOOP
+    return _SpanScope(name, attrs or None)
+
+
+def spans(trace_id: Optional[str] = None) -> list[dict]:
+    """Snapshot of buffered spans (filtered by trace when given)."""
+    with _lock:
+        out = list(_spans)
+    if trace_id is not None:
+        out = [s for s in out if s.trace_id == trace_id]
+    return [s.to_json() for s in out]
+
+
+def drain(trace_id: Optional[str] = None) -> list[dict]:
+    """Remove and return buffered spans (one trace, or everything)."""
+    global _spans
+    with _lock:
+        if trace_id is None:
+            out, _spans = _spans, []
+        else:
+            out = [s for s in _spans if s.trace_id == trace_id]
+            _spans = [s for s in _spans if s.trace_id != trace_id]
+    return [s.to_json() for s in out]
+
+
+def ingest(span_dicts) -> int:
+    """Fold remotely-produced spans (a worker response's `spans` list)
+    into the local buffer; returns how many were accepted."""
+    if not span_dicts:
+        return 0
+    n = 0
+    for obj in span_dicts:
+        try:
+            sp = Span.from_json(obj)
+        except (KeyError, TypeError, ValueError):
+            METRICS.add("obs.spans_rejected")
+            continue
+        _record(sp)
+        n += 1
+    return n
+
+
+class adopt:
+    """Worker-side trace adoption: `with adopt(msg.get("trace")):` makes
+    the request's trace ambient for the handler thread (spans record
+    and parent under the coordinator's dispatch span) and — because
+    `enabled()` honors the thread's trace contextvar — turns collection
+    on for exactly this thread's work, even when the worker process has
+    tracing off.  A None/invalid wire dict is a no-op."""
+
+    __slots__ = ("_tc", "_tok_trace", "_tok_span", "_active")
+
+    def __init__(self, wire: Optional[dict]):
+        self._tc = TraceContext.from_wire(wire)
+        self._active = False
+
+    def __enter__(self) -> Optional[TraceContext]:
+        if self._tc is None:
+            return None
+        self._active = True
+        self._tok_trace = _current_trace.set(self._tc)
+        # synthetic (never-recorded) parent handle so children chain to
+        # the remote dispatch span
+        parent = None
+        if self._tc.span_id:
+            parent = Span.__new__(Span)
+            parent.span_id = self._tc.span_id
+            parent.trace_id = self._tc.trace_id
+        self._tok_span = _current_span.set(parent)
+        _install_compile_listener()
+        return self._tc
+
+    def __exit__(self, *exc_info):
+        if self._active:
+            _current_span.reset(self._tok_span)
+            _current_trace.reset(self._tok_trace)
+            self._active = False
+        return False
+
+    @property
+    def trace_id(self) -> Optional[str]:
+        return None if self._tc is None else self._tc.trace_id
+
+
+@contextmanager
+def session():
+    """Enable tracing for a block under a fresh TraceContext (the
+    EXPLAIN ANALYZE entry).  Session-active state is a depth counter
+    (not a flip of the engine-wide flag), so one session ending cannot
+    disable another still running on a sibling thread; the session's
+    trace also becomes the process-ambient fallback so spans opened on
+    helper threads (prefetch producers) join it instead of leaking into
+    a never-drained orphan trace.  Spans stay buffered for
+    `drain(tc.trace_id)` after exit."""
+    global _SESSION_DEPTH, _ambient_trace
+    _install_compile_listener()
+    tc = TraceContext()
+    token = _current_trace.set(tc)
+    with _lock:
+        _SESSION_DEPTH += 1
+        prev_ambient = _ambient_trace
+        _ambient_trace = tc
+    try:
+        yield tc
+    finally:
+        with _lock:
+            _SESSION_DEPTH -= 1
+            if _ambient_trace is tc:
+                _ambient_trace = prev_ambient
+        _current_trace.reset(token)
+
+
+def _install_compile_listener() -> None:
+    """Attribute XLA compile time to the ambient operator (compile vs
+    execute split in EXPLAIN ANALYZE) and fold it into the METRICS
+    timing registry.  Best-effort: jax.monitoring is not a stable API,
+    so absence degrades to compile time staying inside execute time."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    _compile_listener_installed = True
+    try:
+        import jax
+
+        register = getattr(
+            jax.monitoring, "register_event_duration_secs_listener", None
+        )
+        if register is None:
+            return
+
+        def _on_duration(event: str, duration: float, **_kw) -> None:
+            if "compile" not in event:
+                return
+            METRICS.observe("compile.xla", duration)
+            from datafusion_tpu.obs.stats import current_op
+
+            st = current_op()
+            if st is not None:
+                st.compile_s += duration
+
+        register(_on_duration)
+    except Exception:  # noqa: BLE001 — observability must never break queries
+        pass
+
+
+_trace_file = os.environ.get("DATAFUSION_TPU_TRACE_FILE")
+if _trace_file:
+    import atexit
+
+    def _dump_at_exit(path=_trace_file):
+        try:
+            from datafusion_tpu.obs.export import write_chrome_trace
+
+            write_chrome_trace(path, spans())
+        except Exception:  # noqa: BLE001 — exit hooks must not raise
+            pass
+
+    atexit.register(_dump_at_exit)
+if _ENABLED:
+    _install_compile_listener()
+del _trace_file

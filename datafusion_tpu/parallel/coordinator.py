@@ -52,8 +52,15 @@ from datafusion_tpu.exec.context import ExecutionContext
 from datafusion_tpu.exec.relation import Relation
 from datafusion_tpu.parallel.partition import PartitionedDataSource
 from datafusion_tpu.plan.logical import Aggregate
+from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.parallel.physical import PlanFragment
-from datafusion_tpu.parallel.wire import dec_array, recv_msg, send_msg
+from datafusion_tpu.parallel.wire import (
+    CRC_ENABLED,
+    WIRE_VERSION,
+    dec_array,
+    recv_msg,
+    send_msg,
+)
 from datafusion_tpu.plan.logical import (
     LogicalPlan,
     Projection,
@@ -87,6 +94,10 @@ class WorkerHandle:
     def request(self, msg: dict, timeout: Optional[float] = -1) -> dict:
         if timeout == -1:
             timeout = self.request_timeout
+        if CRC_ENABLED and "wire_version" not in msg:
+            # advertise the protocol version (the CRC handshake): a v2
+            # worker answers binary frames with per-segment CRC32s
+            msg = {**msg, "wire_version": WIRE_VERSION}
         with socket.create_connection((self.host, self.port), timeout=10.0) as s:
             s.settimeout(timeout)
             send_msg(s, msg)
@@ -259,6 +270,11 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
     if not workers:
         raise ExecutionError("no workers configured")
     rr = itertools.count()
+    # captured HERE because contextvars don't cross into pool threads:
+    # per-fragment dispatch spans parent under the caller's span, and
+    # the wire context makes worker-side spans chain under those
+    trace_parent = obs_trace.current_span()
+    trace_wire = obs_trace.wire_context()
 
     def run(item):
         fi, frag = item
@@ -294,10 +310,27 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 timeout = msg["deadline_s"]
                 if w.request_timeout is not None:
                     timeout = min(timeout, w.request_timeout)
+            sp = None
+            if trace_wire is not None:
+                sp = obs_trace.begin_span(
+                    "coord.dispatch", parent=trace_parent,
+                    trace_id=trace_wire["trace_id"],
+                    attrs={**frag.span_attrs(),
+                           "worker": f"{w.host}:{w.port}",
+                           "attempt": attempts},
+                )
+                # worker-side spans parent under THIS dispatch span
+                msg["trace"] = {**trace_wire, "parent_span_id": sp.span_id}
             try:
                 faults.check("coord.request", shard=frag.shard)
-                return frag, w.request(msg, timeout=timeout)
+                resp = w.request(msg, timeout=timeout)
+                obs_trace.finish_span(sp)
+                obs_trace.ingest(resp.pop("spans", None))
+                return frag, resp
             except (ConnectionError, OSError):
+                if sp is not None:
+                    sp.attrs["failed_over"] = True
+                    obs_trace.finish_span(sp)
                 # connect refused/reset, mid-query EOF, or a garbled
                 # stream (wire.ProtocolError): the query is the recovery
                 # unit — mark the worker dead and replay this fragment
@@ -312,6 +345,9 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                         f"(fragment {fi}: {attempts} attempts)"
                     )
             except RequestTimeoutError as e:
+                if sp is not None:
+                    sp.attrs["timed_out"] = True
+                    obs_trace.finish_span(sp)
                 # only the socket-timeout error is eligible: a genuine
                 # worker error (bad plan, execution failure) must keep
                 # its message even when the deadline has since lapsed
@@ -378,8 +414,18 @@ class DistributedAggregateRelation(Relation):
             for i, p in enumerate(self.ds.partitions)
         ]
 
+    def op_label(self) -> str:
+        return (
+            f"DistributedAggregate[partitions={len(self.ds.partitions)}, "
+            f"workers={len(self.workers)}]"
+        )
+
     def batches(self) -> Iterator[RecordBatch]:
         t = self.template
+        if obs_trace.enabled():
+            self.stats.attrs.update(
+                partitions=len(self.ds.partitions), workers=len(self.workers)
+            )
         deadline = (
             None
             if self.query_deadline_s is None
@@ -505,8 +551,16 @@ class DistributedUnionRelation(Relation):
     def schema(self) -> Schema:
         return self._schema
 
+    def op_label(self) -> str:
+        return (
+            f"DistributedUnion[partitions={len(self.ds.partitions)}, "
+            f"workers={len(self.workers)}]"
+        )
+
     def batches(self) -> Iterator[RecordBatch]:
         n = len(self.ds.partitions)
+        if obs_trace.enabled():
+            self.stats.attrs.update(partitions=n, workers=len(self.workers))
         plan_json = self.plan.to_json()
         qid = uuid.uuid4().hex[:12]
         fragments = [
@@ -603,7 +657,8 @@ class DistributedContext(ExecutionContext):
         self.workers = [WorkerHandle(h, p, request_timeout) for h, p in workers]
         if query_deadline_s is None:
             env = os.environ.get("DATAFUSION_TPU_QUERY_DEADLINE_S")
-            query_deadline_s = float(env) if env else None
+            # "0" means off (the documented default), not a 0s budget
+            query_deadline_s = (float(env) or None) if env else None
         self.query_deadline_s = query_deadline_s
         if heartbeat_interval is None:
             env = os.environ.get("DATAFUSION_TPU_HEARTBEAT_S")

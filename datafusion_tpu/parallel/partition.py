@@ -76,6 +76,7 @@ from datafusion_tpu.parallel.mesh import MESH_AXIS, make_mesh
 from datafusion_tpu.parallel.physical import PlanFragment
 from datafusion_tpu.plan.expr import Expr
 from datafusion_tpu.plan.logical import Aggregate, LogicalPlan, Selection, TableScan
+from datafusion_tpu.utils.deadline import Deadline, current_deadline, deadline_scope
 from datafusion_tpu.utils.metrics import METRICS
 from datafusion_tpu.utils.retry import device_call
 
@@ -238,7 +239,9 @@ class _ShardFeed:
     """Chained batch iterator over one shard's assigned partitions."""
 
     def __init__(self, relations: list[Relation]):
-        self._iters = [r.batches() for r in relations]
+        from datafusion_tpu.obs.stats import iter_stats
+
+        self._iters = [iter_stats(r) for r in relations]
         self._pos = 0
 
     def next_batch(self) -> Optional[RecordBatch]:
@@ -355,8 +358,15 @@ class PartitionedPipelineRelation(Relation):
     def schema(self) -> Schema:
         return self._schema
 
+    def op_label(self) -> str:
+        return (
+            f"MeshPipeline[shards={self.n_shards}, "
+            f"partitions={len(self.children)}]"
+        )
+
     def batches(self) -> Iterator[RecordBatch]:
         from datafusion_tpu.exec.expression import compute_aux_values as _aux
+        from datafusion_tpu.obs.stats import op_timer
 
         core = self.core
         n = self.n_shards
@@ -365,8 +375,14 @@ class PartitionedPipelineRelation(Relation):
         used = core.used_cols
 
         stacker = _MeshStacker(self.mesh)
+        # the ambient per-query deadline bounds every mesh round (the
+        # distributed path already honors it via fragment budgets; the
+        # single-host mesh path used to run unbounded)
+        deadline = current_deadline()
 
         while True:
+            if deadline is not None:
+                deadline.check("partitioned pipeline round")
             round_batches = [f.next_batch() for f in feeds]
             if all(b is None for b in round_batches):
                 return
@@ -415,7 +431,8 @@ class PartitionedPipelineRelation(Relation):
                                 else stacker.pad(v, cap)
                             )
                 aux = tuple(_aux(core.aux_specs, live[0], self._aux_cache))
-                with METRICS.timer("execute.partitioned_pipeline"):
+                with METRICS.timer("execute.partitioned_pipeline"), \
+                        op_timer(self):
                     out_cols, out_valids, masks = device_call(
                         self._stacked_jit,
                         tuple(stacker.put(s) for s in col_shards),
@@ -634,8 +651,16 @@ class PartitionedAggregateRelation(AggregateRelation):
         )
         return self._shard_state((grow(counts, 0), new_accs))
 
+    def op_label(self) -> str:
+        return (
+            f"MeshAggregate[shards={self.n_shards}, "
+            f"partitions={len(self.children)}, keys={len(self.key_cols)}]"
+        )
+
     # -- the partitioned scan loop --
     def accumulate(self):
+        from datafusion_tpu.obs.stats import op_timer
+
         n = self.n_shards
         feeds = [
             _ShardFeed(rels) for rels in _round_robin(self.children, n)
@@ -649,8 +674,13 @@ class PartitionedAggregateRelation(AggregateRelation):
             in_schema.field(i).data_type.np_dtype for i in sub_cols
         ]
         stacker = _MeshStacker(self.mesh)
+        # ambient per-query deadline: bounds every mesh round AND (via
+        # the contextvar already being set) the device_call backoffs
+        deadline = current_deadline()
 
         while True:
+            if deadline is not None:
+                deadline.check("partitioned aggregate round")
             round_batches = [f.next_batch() for f in feeds]
             if all(b is None for b in round_batches):
                 break
@@ -735,7 +765,8 @@ class PartitionedAggregateRelation(AggregateRelation):
                 else []
             )
             str_aux = self._compute_str_aux(live_batch)
-            with METRICS.timer("execute.partitioned_aggregate"):
+            with METRICS.timer("execute.partitioned_aggregate"), \
+                    op_timer(self):
                 state = device_call(
                     self._stacked_jit,
                     tuple(stacker.put(s) for s in col_shards),
@@ -766,6 +797,42 @@ class PartitionedAggregateRelation(AggregateRelation):
             return device_call(self._combine_jit, state, str_aux)
 
 
+class DeadlineBoundRelation(Relation):
+    """Bounds a relation's entire iteration with a per-query deadline:
+    anchors the budget at first pull, checks it before every batch, and
+    makes it ambient (`deadline_scope`) around each child pull so
+    `device_call` backoffs and the mesh round loops honor it too.  This
+    closes the single-host gap: the distributed path already threads a
+    budget through fragment requests, but a local mesh query used to
+    run unbounded."""
+
+    def __init__(self, inner: Relation, seconds: float):
+        self.inner = inner
+        self.seconds = seconds
+
+    @property
+    def schema(self) -> Schema:
+        return self.inner.schema
+
+    def op_label(self) -> str:
+        return f"Deadline[{self.seconds}s]"
+
+    def batches(self) -> Iterator[RecordBatch]:
+        from datafusion_tpu.obs.stats import iter_stats
+
+        deadline = Deadline.after(self.seconds)
+        it = iter(iter_stats(self.inner))
+        while True:
+            deadline.check("partitioned query")
+            # scope set per-pull (not around the generator): contextvar
+            # writes inside a generator leak into the consumer otherwise
+            with deadline_scope(deadline):
+                batch = next(it, None)
+            if batch is None:
+                return
+            yield batch
+
+
 class PartitionedContext(ExecutionContext):
     """ExecutionContext that executes over a device mesh.
 
@@ -773,12 +840,26 @@ class PartitionedContext(ExecutionContext):
     collective-combine path; every plan fragment round-trips through
     the JSON wire format first (`PlanFragment`), proving the bytes a
     multi-host coordinator would ship.
+
+    `query_deadline_s` (or env DATAFUSION_TPU_QUERY_DEADLINE_S — the
+    same knob the distributed coordinator honors) bounds every query's
+    iteration end to end, including mesh rounds and device retries.
     """
 
-    def __init__(self, mesh=None, n_devices: Optional[int] = None, batch_size: int = 131072):
+    def __init__(self, mesh=None, n_devices: Optional[int] = None,
+                 batch_size: int = 131072,
+                 query_deadline_s: Optional[float] = None):
+        import os
+
         super().__init__(device=None, batch_size=batch_size)
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.last_fragments: list[PlanFragment] = []
+        if query_deadline_s is None:
+            env = os.environ.get("DATAFUSION_TPU_QUERY_DEADLINE_S")
+            # "0" means off (the documented default), not a 0s budget
+            query_deadline_s = (float(env) or None) if env else None
+        self.query_deadline_s = query_deadline_s
+        self._executing = False
 
     def register_partitioned_csv(
         self, name: str, paths: Sequence[str], schema: Schema, has_header: bool = True
@@ -801,6 +882,19 @@ class PartitionedContext(ExecutionContext):
         )
 
     def execute(self, plan: LogicalPlan) -> Relation:
+        # wrap only the ROOT (execute recurses through self.execute for
+        # child plans; nested wrappers would hand every subtree a fresh
+        # budget instead of one per-query deadline)
+        if self.query_deadline_s is None or self._executing:
+            return self._execute_unbounded(plan)
+        self._executing = True
+        try:
+            rel = self._execute_unbounded(plan)
+        finally:
+            self._executing = False
+        return DeadlineBoundRelation(rel, self.query_deadline_s)
+
+    def _execute_unbounded(self, plan: LogicalPlan) -> Relation:
         agg, pred, scan = _match_partitioned_aggregate(plan, self.datasources)
         if agg is not None:
             ds = self.datasources[scan.table_name]

@@ -92,6 +92,16 @@ class PlanFragment:
     def fragment_id(self) -> str:
         return f"{self.query_id}/{self.shard}"
 
+    def span_attrs(self) -> dict:
+        """Span attributes identifying this fragment in a trace — the
+        coordinator's dispatch span and the worker's fragment span both
+        carry them, so the merged timeline joins on shard/fragment_id."""
+        return {
+            "shard": self.shard,
+            "num_shards": self.num_shards,
+            "fragment_id": self.fragment_id,
+        }
+
     def to_json_str(self) -> str:
         return json.dumps(
             {

@@ -17,6 +17,17 @@ Frame layouts (after the 8-byte big-endian frame length):
   nodes reference segments as {"dtype", "shape", "bin": i}.  Tiny
   arrays stay inline base64 — a segment's framing overhead outweighs
   its bytes below ~256 B.
+
+Integrity: the JSON region fails loudly on corruption (it stops
+parsing), but a bit-flip inside a RAW segment used to parse fine and
+silently poison the merge.  Senders on wire version >= 2 add a CRC32
+per segment (`"_crc32"` next to `"_bins"`); receivers verify every
+listed CRC and surface a mismatch as `ProtocolError` — which subclasses
+ConnectionError, so the coordinator's existing failover path replays
+the fragment elsewhere.  The gate is a handshake, not a flag day:
+requests advertise `"wire_version"`, and a worker only emits CRCs for
+peers that advertised >= 2 (old peers ignore the unknown key anyway).
+`DATAFUSION_TPU_WIRE_CRC=0` disables emission for A/B measurements.
 """
 
 from __future__ import annotations
@@ -25,6 +36,7 @@ import base64
 import json
 import socket
 import struct
+import zlib
 from typing import Optional
 
 import numpy as np
@@ -51,6 +63,20 @@ MAX_FRAME = 1 << 32
 # framing overhead outweighs the bytes); the env knob exists for
 # protocol A/B measurements
 INLINE_MAX = int(os.environ.get("DATAFUSION_TPU_WIRE_INLINE", 256))
+# protocol version this build speaks: 2 = per-segment CRC32 supported.
+# Requests advertise it ("wire_version"); responders emit CRCs only for
+# peers that advertised >= 2.
+WIRE_VERSION = 2
+CRC_ENABLED = os.environ.get("DATAFUSION_TPU_WIRE_CRC", "1") not in ("0", "false")
+
+
+def crc_for_peer(msg: dict) -> bool:
+    """Should a response to `msg` carry segment CRCs?  (the
+    wire-version handshake, receiver side)"""
+    try:
+        return CRC_ENABLED and int(msg.get("wire_version", 1)) >= 2
+    except (TypeError, ValueError):
+        return False
 
 
 class BinWriter:
@@ -63,12 +89,15 @@ class BinWriter:
         self.chunks: list = []  # buffer-protocol objects
 
 
-def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None) -> None:
+def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
+             crc: bool = False) -> None:
     faults.check("wire.send", type=obj.get("type"))
     if bw is not None and bw.chunks:
         sizes = [memoryview(c).nbytes for c in bw.chunks]
         obj = dict(obj)
         obj["_bins"] = sizes
+        if crc:
+            obj["_crc32"] = [zlib.crc32(c) & 0xFFFFFFFF for c in bw.chunks]
         data = json.dumps(obj).encode("utf-8")
         frame_len = 1 + _U32.size + len(data) + sum(sizes)
         sock.sendall(
@@ -138,6 +167,20 @@ def recv_msg(sock: socket.socket) -> Optional[dict]:
                     raise ValueError(f"bad binary segment length {size!r}")
                 bins.append(blob[off : off + size])
                 off += size
+            crcs = obj.get("_crc32")
+            if crcs is not None:
+                # verify BEFORE segments attach to array nodes: a flipped
+                # RAW byte must fail loudly, never poison a merge
+                if not isinstance(crcs, list) or len(crcs) != len(bins):
+                    raise ValueError(
+                        f"CRC list shape mismatch ({crcs!r} for "
+                        f"{len(bins)} segments)"
+                    )
+                for i, (want, seg) in enumerate(zip(crcs, bins)):
+                    if zlib.crc32(seg) & 0xFFFFFFFF != want:
+                        raise ValueError(
+                            f"CRC32 mismatch in binary segment {i}"
+                        )
             _attach_bins(obj, bins)
             return obj
         return json.loads(data.decode("utf-8"))

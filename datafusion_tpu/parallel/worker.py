@@ -34,8 +34,15 @@ from datafusion_tpu.errors import DataFusionError, ExecutionError
 from datafusion_tpu.exec.aggregate import AggregateRelation
 from datafusion_tpu.exec.context import ExecutionContext
 from datafusion_tpu.exec.materialize import collect_columns
+from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.parallel.physical import PlanFragment
-from datafusion_tpu.parallel.wire import BinWriter, enc_array, recv_msg, send_msg
+from datafusion_tpu.parallel.wire import (
+    BinWriter,
+    crc_for_peer,
+    enc_array,
+    recv_msg,
+    send_msg,
+)
 from datafusion_tpu.plan.logical import TableScan
 from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.deadline import Deadline, deadline_scope
@@ -115,6 +122,11 @@ class WorkerState:
         faults.check(
             "worker.fragment", shard=frag.shard, fragment_id=frag.fragment_id
         )
+        with obs_trace.span("worker.fragment", **frag.span_attrs()):
+            return self._execute_fragment(frag, bw)
+
+    def _execute_fragment(self, frag: PlanFragment,
+                          bw: Optional[BinWriter] = None) -> dict:
         rel, _plan = self._relation(frag)
         if not isinstance(rel, AggregateRelation):
             raise ExecutionError(
@@ -168,6 +180,11 @@ class WorkerState:
         faults.check(
             "worker.fragment", shard=frag.shard, fragment_id=frag.fragment_id
         )
+        with obs_trace.span("worker.fragment", **frag.span_attrs()):
+            return self._execute_plan(frag, bw)
+
+    def _execute_plan(self, frag: PlanFragment,
+                      bw: Optional[BinWriter] = None) -> dict:
         rel, plan = self._relation(frag)
         columns, validity, dicts, total = collect_columns(rel)
         self.queries += 1
@@ -216,6 +233,10 @@ class _Handler(socketserver.BaseRequestHandler):
             if msg is None:
                 return
             bw = BinWriter()
+            # trace adoption: the request's {trace_id, parent_span_id}
+            # makes this handler's spans chain under the coordinator's
+            # dispatch span; finished spans ship back in the response
+            adoption = obs_trace.adopt(msg.get("trace"))
             try:
                 kind = msg.get("type")
                 # the coordinator ships the REMAINING per-query budget in
@@ -229,10 +250,10 @@ class _Handler(socketserver.BaseRequestHandler):
                 elif kind == "status":
                     out = state.status()
                 elif kind == "execute_fragment":
-                    with deadline_scope(deadline):
+                    with adoption, deadline_scope(deadline):
                         out = state.execute_fragment(msg["fragment"], bw)
                 elif kind == "execute_plan":
-                    with deadline_scope(deadline):
+                    with adoption, deadline_scope(deadline):
                         out = state.execute_plan(msg["fragment"], bw)
                 elif kind == "shutdown":
                     send_msg(self.request, {"type": "bye"})
@@ -255,8 +276,12 @@ class _Handler(socketserver.BaseRequestHandler):
                 out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
                 bw = BinWriter()
                 state.errors += 1
+            if adoption.trace_id is not None and isinstance(out, dict):
+                out["spans"] = obs_trace.drain(adoption.trace_id)
             try:
-                send_msg(self.request, out, bw)
+                # CRC emission follows the wire-version handshake: only
+                # peers that advertised >= 2 get (and verify) segment CRCs
+                send_msg(self.request, out, bw, crc=crc_for_peer(msg))
             except (ConnectionError, OSError):
                 return
 
@@ -343,6 +368,7 @@ def main(argv=None) -> int:
     ap.add_argument("--process-id", type=int, default=None)
     args = ap.parse_args(argv)
     faults.set_role("worker")  # role-scoped fault rules (testing/faults.py)
+    obs_trace.set_process_role("worker")  # span process labels (obs/trace.py)
     # honor JAX_PLATFORMS even on hosts whose sitecustomize registers an
     # accelerator backend and overrides the env var at interpreter boot
     # (same re-pin as tests/conftest.py)

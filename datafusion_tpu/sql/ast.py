@@ -167,7 +167,10 @@ class SqlCreateExternalTable(SqlNode):
 
 @dataclass
 class SqlExplain(SqlNode):
-    """EXPLAIN stmt — engine extension (the reference only println!s the
-    plan on every execute, `context.rs:104`)."""
+    """EXPLAIN [ANALYZE] stmt — engine extension (the reference only
+    println!s the plan on every execute, `context.rs:104`).  With
+    `analyze` the statement EXECUTES and the plan is annotated with
+    measured per-operator stats (obs/explain.py)."""
 
     stmt: SqlNode
+    analyze: bool = False

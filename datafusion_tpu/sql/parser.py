@@ -9,7 +9,7 @@ the CREATE EXTERNAL TABLE extension, `src/dfparser.rs:101-208`):
     CREATE EXTERNAL TABLE name (col TYPE [NOT NULL], ...)
         STORED AS CSV|NDJSON|PARQUET [WITH|WITHOUT HEADER ROW]
         LOCATION 'path'
-    EXPLAIN <select>
+    EXPLAIN [ANALYZE] <select>
 
 Expression grammar with precedence climbing:
     OR < AND < NOT < comparison (= != <> < <= > >=) < + - < * / %
@@ -21,9 +21,13 @@ from __future__ import annotations
 
 from typing import Optional
 
+import re
+
 from datafusion_tpu.errors import ParserError
 from datafusion_tpu.sql import ast
 from datafusion_tpu.sql.tokenizer import EOF, NUMBER, OP, STRING, WORD, Token, tokenize
+
+_EXPLAIN_ANALYZE = re.compile(r"\s*EXPLAIN\s+ANALYZE\b", re.IGNORECASE)
 
 # precedence table (higher binds tighter)
 _PREC_OR = 5
@@ -116,7 +120,8 @@ class Parser:
         if self.parse_keywords("CREATE", "EXTERNAL", "TABLE"):
             return self._parse_create_external_table()
         if self.parse_keyword("EXPLAIN"):
-            return ast.SqlExplain(self.parse_statement())
+            analyze = self.parse_keyword("ANALYZE")
+            return ast.SqlExplain(self.parse_statement(), analyze=analyze)
         if self.parse_keyword("SELECT"):
             return self._parse_select()
         raise ParserError(f"Expected a statement, found {self.peek()} in {self.sql!r}")
@@ -336,6 +341,12 @@ def parse_sql(sql: str) -> ast.SqlNode:
     """
     from datafusion_tpu.native.sqlfront import native_parse_sql
 
+    # EXPLAIN ANALYZE is a Python-side extension (the C++ front-end's
+    # grammar stops at plain EXPLAIN): strip the prefix here and wrap,
+    # so both front-ends accept it identically
+    m = _EXPLAIN_ANALYZE.match(sql)
+    if m:
+        return ast.SqlExplain(parse_sql(sql[m.end():]), analyze=True)
     node = native_parse_sql(sql)
     if node is not None:
         return node

@@ -33,8 +33,11 @@ Rule fields:
 - ``after``: 1-based hit index at which the rule starts firing
   (default 1 = first hit).
 - ``count``: number of firings (default 1; 0 means unlimited).
-- ``p``: firing probability per eligible hit, drawn from the plan's
-  seeded RNG (omit for the deterministic every-eligible-hit default).
+- ``p``: firing probability per eligible hit.  Each (rule, site) pair
+  keeps its own *virtual hit clock*: the draw for the k-th hit at a
+  site is a pure function of (plan seed, rule index, site name, k), so
+  the set of firing hits is identical across runs regardless of thread
+  interleaving — probabilistic chaos soaks replay exactly.
 - ``role``: only fire in processes whose role matches (workers set
   ``worker``; everything else is ``main``).
 - ``where``: dict matched against the site's context kwargs (e.g.
@@ -43,8 +46,11 @@ Rule fields:
   (default: drawn from the rule's seeded stream).
 
 Deterministic plans should use ``after``/``count`` (hit counting is
-per-rule and lock-protected); ``p`` draws are seeded but interleave
-with thread scheduling, so they are for chaos soaks, not exact replays.
+per-rule and lock-protected).  ``p`` draws are deterministic per
+(site, hit-index) — see above — so a soak replays the same firing
+pattern per site; only ``count``-capped p-rules can still diverge
+across runs (which thread reaches its firing hit first decides which
+SITE consumes the cap).
 
 Environment: ``DATAFUSION_TPU_FAULTS`` holds the plan JSON inline, or
 ``@/path/to/plan.json``.  Parsed once at import.
@@ -93,6 +99,7 @@ class _Rule:
     __slots__ = (
         "site", "op", "exc", "message", "seconds", "after", "count",
         "p", "role", "where", "offset", "hits", "fired", "rng",
+        "seed", "index", "site_hits",
     )
 
     def __init__(self, spec: dict, seed: int, index: int):
@@ -112,8 +119,23 @@ class _Rule:
         self.offset = spec.get("offset")  # corrupt: byte offset (None = seeded)
         self.hits = 0
         self.fired = 0
-        # per-rule stream: adding a rule never perturbs another's draws
+        self.seed = seed
+        self.index = index
+        # per-(rule, site) virtual hit clocks for the p draws
+        self.site_hits: dict = {}
+        # per-rule stream (corrupt offsets): adding a rule never
+        # perturbs another's draws
         self.rng = random.Random((seed << 8) ^ index)
+
+    def p_fires(self, site: str) -> bool:
+        """Advance `site`'s virtual hit clock and decide the p draw.
+        The draw is a pure function of (seed, rule index, site, hit
+        index) — no shared RNG stream, so thread interleaving cannot
+        reshuffle which hits fire (str seeds hash via sha512, stable
+        across processes unlike builtin hash())."""
+        k = self.site_hits[site] = self.site_hits.get(site, 0) + 1
+        draw = random.Random(f"{self.seed}:{self.index}:{site}:{k}").random()
+        return draw < self.p
 
     def matches(self, site: str, role: str, ctx: dict) -> bool:
         if self.role is not None and self.role != role:
@@ -126,8 +148,11 @@ class _Rule:
         return True
 
     def snapshot(self) -> dict:
-        return {"site": self.site, "op": self.op, "hits": self.hits,
-                "fired": self.fired}
+        out = {"site": self.site, "op": self.op, "hits": self.hits,
+               "fired": self.fired}
+        if self.site_hits:
+            out["site_hits"] = dict(self.site_hits)
+        return out
 
 
 class FaultPlan:
@@ -151,7 +176,7 @@ class FaultPlan:
                     continue
                 if rule.count and rule.fired >= rule.count:
                     continue
-                if rule.p is not None and rule.rng.random() >= rule.p:
+                if rule.p is not None and not rule.p_fires(site):
                     continue
                 rule.fired += 1
                 return rule

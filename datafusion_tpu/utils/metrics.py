@@ -35,6 +35,13 @@ class Metrics:
     def add(self, name: str, n: int = 1):
         self.counts[name] += n
 
+    def observe(self, name: str, seconds: float):
+        """Fold an externally-measured duration into a stage timing
+        (the obs subsystem's XLA-compile listener lands here — this
+        registry is the single counter backend; see obs/export.py's
+        `prometheus_text` for the scrape format)."""
+        self.timings[name] += seconds
+
     def timed_iter(self, name: str, it):
         """Wrap a generator so time spent *producing* items (host parse,
         encode) accrues to `name`, while consumer time doesn't."""

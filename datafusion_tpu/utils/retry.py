@@ -93,4 +93,7 @@ def device_call(fn, /, *args, **kwargs):
                     f"{delay:.3f}s retry backoff"
                 ) from transient
             METRICS.add("device.transient_retries")
+            from datafusion_tpu.obs.stats import record_retry
+
+            record_retry()  # ambient-operator attribution (EXPLAIN ANALYZE)
             time.sleep(delay)

@@ -133,14 +133,23 @@ def main() -> int:
         assert fired["device.call"] == 2, fired
         print(f"chaos query matches fault-free run (fired: {fired})", flush=True)
 
-        # the aborted fragment marked its worker down; one heartbeat
-        # probation cycle must bring it back
-        down = [w for w in dctx.workers if not w.alive]
-        assert down, "expected the aborted worker to be marked down"
+        # the injected failures marked worker(s) down during the query
+        # (the counter, not the live worker list: with two faults and
+        # two workers, BOTH can go down mid-query, in which case the
+        # dispatcher's last-gasp re-probe already re-admitted them —
+        # which recv the reset lands on is scheduling-dependent)
+        from datafusion_tpu.utils.metrics import METRICS
+
+        assert METRICS.counts.get("coord.worker_marked_down", 0) >= 1, (
+            "expected at least one worker marked down during the chaos run"
+        )
+        # any worker still down must come back after one heartbeat
+        # probation cycle; already-recovered workers stay up
         HeartbeatMonitor(dctx.workers, interval=0.05,
                          probation_pings=1).poll_once()
         assert all(w.alive for w in dctx.workers), dctx.workers
-        print("down worker re-admitted after one probation cycle", flush=True)
+        print("down workers re-admitted (probation cycle / last-gasp probe)",
+              flush=True)
 
         # healed cluster, no plan: agree again
         assert rows(dctx) == want, "post-recovery result diverges"

@@ -27,6 +27,9 @@ fi
 echo "== chaos smoke (distributed query under a seeded fault plan) =="
 python scripts/chaos_smoke.py
 
+echo "== trace smoke (EXPLAIN ANALYZE + merged worker trace) =="
+python scripts/trace_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

@@ -200,6 +200,77 @@ class TestFaultPlanMechanics:
         assert seq == fired_sequence()  # same seed, same draws
         assert 0 < sum(seq) < 20
 
+    def test_p_rule_virtual_hit_clock_is_per_site(self):
+        """Each (rule, site) pair has its own hit clock: hits at one
+        site never shift another site's draws (ROADMAP follow-on — the
+        old shared-RNG stream reshuffled under interleaving)."""
+        spec = {"seed": 9, "rules": [
+            {"site": "*", "op": "raise", "exc": "ValueError",
+             "p": 0.5, "count": 0},
+        ]}
+
+        def pattern(site, n, warmup_other=0):
+            with faults.scoped(spec):
+                for _ in range(warmup_other):
+                    try:
+                        faults.check("other.site")
+                    except ValueError:
+                        pass
+                out = []
+                for _ in range(n):
+                    try:
+                        faults.check(site)
+                        out.append(0)
+                    except ValueError:
+                        out.append(1)
+                return out
+
+        base = pattern("a.site", 30)
+        # interleaved traffic on another site leaves a.site's draws
+        # untouched — the property that makes chaos soaks replayable
+        assert pattern("a.site", 30, warmup_other=17) == base
+        assert 0 < sum(base) < 30
+
+    def test_p_rule_deterministic_under_thread_interleaving(self):
+        """The SET of firing (site, hit-index) pairs is a pure function
+        of the plan, so the per-site fire counts match no matter how
+        many threads deliver the hits."""
+        import threading
+
+        spec = {"seed": 21, "rules": [
+            {"site": "s", "op": "raise", "exc": "ValueError",
+             "p": 0.3, "count": 0},
+        ]}
+
+        def run(n_threads, hits_total):
+            fired = []
+            lock = threading.Lock()
+
+            def hammer(n):
+                for _ in range(n):
+                    try:
+                        faults.check("s")
+                    except ValueError:
+                        with lock:
+                            fired.append(1)
+
+            with faults.scoped(spec):
+                threads = [
+                    threading.Thread(target=hammer,
+                                     args=(hits_total // n_threads,))
+                    for _ in range(n_threads)
+                ]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=30)
+            return len(fired)
+
+        sequential = run(1, 120)
+        assert 0 < sequential < 120
+        for n_threads in (4, 8):
+            assert run(n_threads, 120) == sequential
+
     def test_corrupt_is_deterministic_and_offsettable(self):
         data = bytes(range(64))
         spec = {"seed": 3, "rules": [

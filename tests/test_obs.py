@@ -1,0 +1,428 @@
+"""Observability subsystem (datafusion_tpu/obs/): hierarchical spans,
+trace-context propagation (in-process and across a real worker
+subprocess), per-operator stats, EXPLAIN ANALYZE invariants, and the
+Chrome-trace / Prometheus exporters."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.obs import trace
+from datafusion_tpu.obs.explain import ExplainAnalyzeResult
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = Schema(
+    [
+        Field("region", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+        Field("x", DataType.FLOAT64, True),
+    ]
+)
+
+
+def _write_csv(path, rows=300, seed=7):
+    rng = np.random.default_rng(seed)
+    regions = ["north", "south", "east", "west"]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("region,v,x\n")
+        for _ in range(rows):
+            r = regions[rng.integers(0, len(regions))]
+            x = "" if rng.random() < 0.1 else f"{rng.uniform(-5, 5):.6f}"
+            f.write(f"{r},{int(rng.integers(-1000, 1000))},{x}\n")
+    return str(path)
+
+
+@pytest.fixture()
+def ctx(tmp_path):
+    c = ExecutionContext(device="cpu")
+    c.register_csv("t", _write_csv(tmp_path / "t.csv"), SCHEMA)
+    return c
+
+
+class TestSpans:
+    def test_nesting_and_attrs(self):
+        with trace.session() as tc:
+            with trace.span("outer", kind="test") as outer:
+                with trace.span("inner", shard=3) as inner:
+                    assert trace.current_span() is inner
+                assert trace.current_span() is outer
+        recorded = trace.drain(tc.trace_id)
+        by_name = {s["name"]: s for s in recorded}
+        assert set(by_name) == {"outer", "inner"}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        assert by_name["outer"]["attrs"] == {"kind": "test"}
+        assert by_name["inner"]["attrs"] == {"shard": 3}
+        assert by_name["inner"]["trace_id"] == tc.trace_id
+        for s in recorded:
+            assert s["end_ns"] >= s["start_ns"]
+
+    def test_disabled_mode_is_allocation_free(self):
+        assert not trace.enabled()
+        # the no-op context manager is a process-wide singleton: the
+        # hot path allocates nothing per call
+        assert trace.span("a") is trace.span("b")
+        with trace.span("a") as sp:
+            assert sp is None
+        assert trace.begin_span("x") is None
+        trace.finish_span(None)  # no-op, no error
+
+    def test_disabled_mode_records_no_operator_stats(self, ctx):
+        rel = ctx.sql("SELECT region, v FROM t WHERE v > 0")
+        from datafusion_tpu.exec.materialize import collect
+
+        collect(rel)
+        # lazily-created stats never materialize on an uninstrumented run
+        assert rel._op_stats is None
+        assert rel.child._op_stats is None
+
+    def test_session_restores_disabled_state(self):
+        assert not trace.enabled()
+        with trace.session():
+            assert trace.enabled()
+        assert not trace.enabled()
+
+    def test_overlapping_sessions_keep_collection_on(self):
+        # sessions are a depth counter, not a flag flip: a session
+        # beginning AND ending while another thread's session is still
+        # active must not turn collection off under it
+        import threading
+
+        started, release = threading.Event(), threading.Event()
+        results = {}
+
+        def holder():
+            with trace.session() as tc:
+                started.set()
+                release.wait(timeout=10)
+                results["enabled_inside"] = trace.enabled()
+                results["trace_id"] = tc.trace_id
+
+        t = threading.Thread(target=holder)
+        t.start()
+        try:
+            assert started.wait(timeout=10)
+            with trace.session():
+                pass  # full session lifecycle while holder is active
+            assert trace.enabled(), "sibling session lost collection"
+        finally:
+            release.set()
+            t.join(timeout=10)
+        assert results["enabled_inside"] is True
+        assert not trace.enabled()
+        trace.drain()
+
+    def test_buffer_cap_drops_not_grows(self):
+        import datafusion_tpu.obs.trace as t
+
+        old_max = t._MAX_SPANS
+        t._MAX_SPANS = 2
+        try:
+            with trace.session() as tc:
+                for i in range(5):
+                    with trace.span(f"s{i}"):
+                        pass
+            assert len(trace.drain(tc.trace_id)) <= 2
+        finally:
+            t._MAX_SPANS = old_max
+            trace.drain()  # leave a clean buffer for other tests
+
+
+class TestTraceContextWire:
+    def test_wire_roundtrip(self):
+        tc = trace.TraceContext("abc123", "span9")
+        back = trace.TraceContext.from_wire(tc.to_wire())
+        assert back.trace_id == "abc123" and back.span_id == "span9"
+        assert trace.TraceContext.from_wire(None) is None
+        assert trace.TraceContext.from_wire({}) is None
+        assert trace.TraceContext.from_wire({"nope": 1}) is None
+
+    def test_adopt_parents_and_force_enables(self):
+        assert not trace.enabled()
+        wire = {"trace_id": "feedc0de00000001", "parent_span_id": "p" * 16}
+        with trace.adopt(wire):
+            assert trace.enabled()  # force-enabled for the request
+            with trace.span("worker.fragment", shard=0):
+                pass
+        assert not trace.enabled()
+        got = trace.drain("feedc0de00000001")
+        assert len(got) == 1
+        assert got[0]["parent_id"] == "p" * 16
+        assert got[0]["trace_id"] == "feedc0de00000001"
+
+    def test_adopt_invalid_is_noop(self):
+        with trace.adopt(None) as tc:
+            assert tc is None
+            assert not trace.enabled()
+
+    def test_adopt_is_thread_scoped(self):
+        """A worker thread serving a traced request must not turn
+        collection on for sibling handler threads serving untraced
+        requests (orphan spans would fill the bounded buffer)."""
+        import threading
+
+        seen = {}
+        with trace.adopt({"trace_id": "aaaa000011112222"}):
+            assert trace.enabled()
+
+            def probe():
+                seen["enabled"] = trace.enabled()
+                with trace.span("should_not_record"):
+                    pass
+
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join(timeout=10)
+        assert seen["enabled"] is False
+        assert trace.drain("aaaa000011112222") == []
+        assert all(
+            s["name"] != "should_not_record" for s in trace.drain()
+        )
+
+    def test_ingest_rejects_garbage_keeps_good(self):
+        good = {
+            "name": "w", "trace_id": "t1", "span_id": "s1",
+            "parent_id": None, "start_ns": 1, "end_ns": 2,
+        }
+        assert trace.ingest([good, "garbage", {"name": "incomplete"}]) == 1
+        assert [s["name"] for s in trace.drain("t1")] == ["w"]
+
+
+class TestExplainAnalyze:
+    def test_rows_match_plain_run(self, ctx):
+        sql = "SELECT region, v + 1 FROM t WHERE v > 0"
+        plain = ctx.sql_collect(sql)
+        res = ctx.sql_collect(f"EXPLAIN ANALYZE {sql}")
+        assert isinstance(res, ExplainAnalyzeResult)
+        # the analyzed run IS a real run: same rows out
+        assert res.result.num_rows == plain.num_rows
+        assert sorted(res.result.to_rows()) == sorted(plain.to_rows())
+        # root operator stats agree with the materialized result
+        assert res.root.stats.rows_out == plain.num_rows
+        assert res.root.stats.batches_out >= 1
+        assert res.root.stats.time_s > 0
+        assert res.wall_s >= res.root.stats.time_s
+
+    def test_operator_tree_and_scan_rows(self, ctx):
+        res = ctx.sql_collect(
+            "EXPLAIN ANALYZE SELECT region, SUM(v), COUNT(1) FROM t "
+            "WHERE v > -2000 GROUP BY region"
+        )
+        report = res.report()
+        assert "Aggregate[" in report and "Scan[Csv" in report
+        # the scan feeds every input row to the aggregate
+        tree = {rel.op_label(): rel for _, rel in self._tree(res)}
+        scan = next(v for k, v in tree.items() if k.startswith("Scan"))
+        assert scan.stats.rows_out == 300
+        assert repr(res) == report
+
+    @staticmethod
+    def _tree(res):
+        from datafusion_tpu.obs.stats import collect_tree
+
+        return collect_tree(res.root)
+
+    def test_explain_without_analyze_still_plans_only(self, ctx):
+        from datafusion_tpu.exec.context import ExplainResult
+
+        out = ctx.sql_collect("EXPLAIN SELECT region FROM t")
+        assert isinstance(out, ExplainResult)
+
+    def test_parser_analyze_flag(self):
+        from datafusion_tpu.sql import ast
+        from datafusion_tpu.sql.parser import parse_sql
+
+        node = parse_sql("EXPLAIN ANALYZE SELECT 1")
+        assert isinstance(node, ast.SqlExplain) and node.analyze
+        node = parse_sql("explain analyze select 1")
+        assert isinstance(node, ast.SqlExplain) and node.analyze
+        node = parse_sql("EXPLAIN SELECT 1")
+        assert isinstance(node, ast.SqlExplain) and not node.analyze
+
+    def test_chrome_trace_schema(self, ctx):
+        res = ctx.sql_collect("EXPLAIN ANALYZE SELECT v FROM t WHERE v > 0")
+        ct = res.chrome_trace()
+        json.dumps(ct)  # serializable
+        events = ct["traceEvents"]
+        xs = [e for e in events if e["ph"] == "X"]
+        assert xs, "no complete events"
+        for e in xs:
+            assert {"name", "ts", "dur", "pid", "tid", "args"} <= set(e)
+            assert e["dur"] >= 0
+            assert e["args"]["trace_id"] == res.trace_id
+        metas = [e for e in events if e["ph"] == "M"]
+        assert any(m["name"] == "process_name" for m in metas)
+
+    def test_write_chrome_trace(self, ctx, tmp_path):
+        res = ctx.sql_collect("EXPLAIN ANALYZE SELECT v FROM t")
+        path = res.write_chrome_trace(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            loaded = json.load(f)
+        assert loaded["traceEvents"]
+
+    def test_cli_backslash_explain(self, ctx):
+        import io
+
+        from datafusion_tpu.cli import Console
+
+        out = io.StringIO()
+        console = Console(ctx, out=out)
+        assert console.handle_command("\\explain SELECT region FROM t;")
+        text = out.getvalue()
+        assert "EXPLAIN ANALYZE" in text and "Scan[Csv" in text
+        out.truncate(0)
+        assert console.handle_command("\\explain")
+        assert "Usage" in out.getvalue()
+
+
+class TestMeshDeadline:
+    """ROADMAP follow-on: the single-host mesh path honors the ambient
+    per-query deadline instead of running unbounded."""
+
+    def _pctx(self, tmp_path, **kw):
+        from datafusion_tpu.parallel.partition import PartitionedContext
+
+        paths = [
+            _write_csv(tmp_path / f"p{i}.csv", rows=200, seed=i)
+            for i in range(3)
+        ]
+        pctx = PartitionedContext(n_devices=2, **kw)
+        pctx.register_partitioned_csv("t", paths, SCHEMA)
+        return pctx
+
+    def test_expired_deadline_aborts_mesh_query(self, tmp_path):
+        from datafusion_tpu.errors import QueryDeadlineError
+        from datafusion_tpu.exec.materialize import collect
+
+        pctx = self._pctx(tmp_path, query_deadline_s=1e-9)
+        with pytest.raises(QueryDeadlineError):
+            collect(pctx.sql("SELECT region, SUM(v) FROM t GROUP BY region"))
+
+    def test_generous_deadline_passes_and_matches(self, tmp_path):
+        from datafusion_tpu.exec.materialize import collect
+
+        pctx = self._pctx(tmp_path, query_deadline_s=300.0)
+        sql = "SELECT region, SUM(v), COUNT(1) FROM t GROUP BY region"
+        got = sorted(collect(pctx.sql(sql)).to_rows())
+        want = sorted(collect(self._pctx(tmp_path).sql(sql)).to_rows())
+        assert got == want
+
+    def test_env_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_QUERY_DEADLINE_S", "123.5")
+        pctx = self._pctx(tmp_path)
+        assert pctx.query_deadline_s == 123.5
+
+
+@pytest.fixture(scope="module")
+def obs_worker():
+    """One real worker OS process (the cross-process propagation leg)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "datafusion_tpu.worker",
+         "--bind", "127.0.0.1:0", "--device", "cpu"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "listening on" in line, line
+        host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+        yield (host, int(port))
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+
+class TestWorkerPropagation:
+    def _dctx(self, tmp_path, addr):
+        from datafusion_tpu.exec.datasource import CsvDataSource
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+        from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+        paths = [
+            _write_csv(tmp_path / f"d{i}.csv", rows=150, seed=10 + i)
+            for i in range(3)
+        ]
+        dctx = DistributedContext([addr])
+        dctx.register_datasource(
+            "t",
+            PartitionedDataSource(
+                [CsvDataSource(p, SCHEMA, True, 131072) for p in paths]
+            ),
+        )
+        return dctx
+
+    def test_explain_analyze_merges_worker_spans(self, tmp_path, obs_worker):
+        dctx = self._dctx(tmp_path, obs_worker)
+        res = dctx.sql_collect(
+            "EXPLAIN ANALYZE SELECT region, SUM(v), MIN(v) FROM t "
+            "GROUP BY region"
+        )
+        assert isinstance(res, ExplainAnalyzeResult)
+        # ONE trace id across coordinator and worker timelines
+        assert {s["trace_id"] for s in res.spans} == {res.trace_id}
+        frags = [s for s in res.spans if s["name"] == "worker.fragment"]
+        assert len(frags) == 3  # one per partition
+        assert all(str(s["proc"]).startswith("worker") for s in frags)
+        dispatches = {
+            s["span_id"]: s for s in res.spans if s["name"] == "coord.dispatch"
+        }
+        # every worker fragment span parents under a dispatch span
+        for s in frags:
+            assert s["parent_id"] in dispatches
+            assert dispatches[s["parent_id"]]["attrs"]["shard"] == \
+                s["attrs"]["shard"]
+        # the report names them
+        assert "worker-side" in res.report()
+        json.dumps(res.chrome_trace())
+
+    def test_untraced_requests_carry_no_trace(self, tmp_path, obs_worker):
+        """Tracing off => requests ship no trace key and responses ship
+        no spans (the disabled path stays lean on the wire too)."""
+        trace.drain()  # start from a clean buffer
+        dctx = self._dctx(tmp_path, obs_worker)
+        rows = dctx.sql_collect(
+            "SELECT region, SUM(v) FROM t GROUP BY region"
+        )
+        assert rows.num_rows == 4
+        assert trace.spans() == []
+
+
+class TestPrometheusExport:
+    def test_counters_render_after_query(self, ctx):
+        from datafusion_tpu.obs.export import prometheus_text
+
+        ctx.sql_collect("SELECT region, SUM(v) FROM t GROUP BY region")
+        text = prometheus_text()
+        assert "datafusion_tpu_timing_seconds_total" in text
+        assert 'datafusion_tpu_events_total{name="scan_rows"}' in text
+        assert text == ctx.metrics_text()
+        # exposition format sanity: every sample line is name{labels} value
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            name_part, value = line.rsplit(" ", 1)
+            float(value)
+            assert "{" in name_part and name_part.endswith('"}')
+
+    def test_extra_gauges(self):
+        from datafusion_tpu.obs.export import prometheus_text
+        from datafusion_tpu.utils.metrics import Metrics
+
+        m = Metrics()
+        m.add("x.y", 3)
+        m.observe("stage-a", 0.5)
+        text = prometheus_text(m, extra_gauges={"spans_buffered": 7})
+        assert 'datafusion_tpu_events_total{name="x_y"} 3' in text
+        assert 'stage="stage_a"' in text
+        assert 'datafusion_tpu_gauge{name="spans_buffered"} 7' in text

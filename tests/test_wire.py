@@ -11,15 +11,19 @@ import pytest
 
 from datafusion_tpu.parallel.wire import (
     INLINE_MAX,
+    WIRE_VERSION,
     BinWriter,
+    ProtocolError,
+    crc_for_peer,
     dec_array,
     enc_array,
     recv_msg,
     send_msg,
 )
+from datafusion_tpu.testing import faults
 
 
-def _roundtrip(obj, bw=None):
+def _roundtrip(obj, bw=None, crc=False):
     a, b = socket.socketpair()
     try:
         out = {}
@@ -32,7 +36,7 @@ def _roundtrip(obj, bw=None):
 
         t = threading.Thread(target=rx)
         t.start()
-        send_msg(a, obj, bw)
+        send_msg(a, obj, bw, crc=crc)
         t.join(timeout=10)
         assert not t.is_alive(), "receiver did not finish"
         if "err" in out:
@@ -106,3 +110,132 @@ class TestWireFrames:
         over = np.zeros(INLINE_MAX + 1, np.uint8)
         assert "data" in enc_array(at, bw)
         assert "bin" in enc_array(over, bw)
+
+
+class TestWireCrc:
+    """Per-segment CRC32 (wire v2): a bit-flip inside a RAW segment —
+    which parses fine and silently poisons the merge on v1 frames —
+    fails loudly as ProtocolError, which subclasses ConnectionError so
+    the coordinator's existing failover path replays the fragment."""
+
+    def _payload(self):
+        bw = BinWriter()
+        arr = np.arange(10_000, dtype=np.int64)
+        return {"type": "rows", "col": enc_array(arr, bw)}, bw, arr
+
+    def test_crc_roundtrip(self):
+        obj, bw, arr = self._payload()
+        msg = _roundtrip(obj, bw, crc=True)
+        assert len(msg["_crc32"]) == 1
+        np.testing.assert_array_equal(dec_array(msg["col"]), arr)
+
+    def test_raw_flip_without_crc_parses_silently(self):
+        # documents the v1 hazard the CRC closes: offset 5000 lands deep
+        # inside the 80 kB RAW segment, far past the JSON region
+        obj, bw, arr = self._payload()
+        with faults.scoped({"rules": [
+            {"site": "wire.recv.payload", "op": "corrupt", "offset": 5000},
+        ]}):
+            msg = _roundtrip(obj, bw, crc=False)
+        got = dec_array(msg["col"])
+        assert not np.array_equal(got, arr)  # poisoned, no error raised
+
+    def test_raw_flip_with_crc_raises_protocol_error(self):
+        obj, bw, _ = self._payload()
+        with faults.scoped({"rules": [
+            {"site": "wire.recv.payload", "op": "corrupt", "offset": 5000},
+        ]}):
+            with pytest.raises(ProtocolError, match="CRC32 mismatch"):
+                _roundtrip(obj, bw, crc=True)
+
+    def test_crc_list_shape_mismatch_raises(self):
+        obj, bw, _ = self._payload()
+        obj["_crc32"] = [1, 2, 3]  # wrong length, spoofed by sender
+        with pytest.raises(ProtocolError, match="CRC list shape"):
+            _roundtrip(obj, bw, crc=False)
+
+    def test_handshake_gating(self):
+        assert crc_for_peer({"wire_version": WIRE_VERSION})
+        assert crc_for_peer({"wire_version": 3})
+        assert not crc_for_peer({"wire_version": 1})
+        assert not crc_for_peer({})  # legacy peer never advertised
+        assert not crc_for_peer({"wire_version": "junk"})
+
+    def test_worker_responses_carry_crc_for_v2_peers(self):
+        """End to end over a live in-process worker: a v2 request gets a
+        CRC-protected binary response; a legacy request does not."""
+        import json
+        import threading as th
+
+        from datafusion_tpu.parallel.worker import serve
+
+        server = serve("127.0.0.1:0", device="cpu")
+        t = th.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            host, port = server.server_address[:2]
+            frag = json.dumps({
+                "shard": 0, "num_shards": 1, "query_id": "q",
+                "plan": _scan_plan_json(),
+                "datasource": _csv_meta(),
+            })
+            for version, expect_crc in ((WIRE_VERSION, True), (None, False)):
+                msg = {"type": "execute_fragment", "fragment": frag}
+                if version is not None:
+                    msg["wire_version"] = version
+                with socket.create_connection((host, port), timeout=10) as s:
+                    send_msg(s, msg)
+                    resp = recv_msg(s)
+                assert resp["type"] == "partial_state", resp
+                assert ("_crc32" in resp) == expect_crc
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+_CSV_PATH = None
+
+
+def _csv_meta():
+    global _CSV_PATH
+    if _CSV_PATH is None:
+        import tempfile
+
+        fd = tempfile.NamedTemporaryFile(
+            "w", suffix=".csv", delete=False, encoding="utf-8"
+        )
+        fd.write("g,v\n")
+        # enough rows that the accumulator arrays clear INLINE_MAX and
+        # ship as RAW segments (the CRC-covered region)
+        for i in range(2000):
+            fd.write(f"{i % 200},{i}\n")
+        fd.close()
+        _CSV_PATH = fd.name
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+
+    schema = Schema([
+        Field("g", DataType.INT64, False),
+        Field("v", DataType.INT64, False),
+    ]).to_json()
+    return {"CsvFile": {"filename": _CSV_PATH, "schema": schema,
+                        "has_header": True, "projection": None}}
+
+
+def _scan_plan_json():
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.plan.expr import AggregateFunction, Column
+    from datafusion_tpu.plan.logical import Aggregate, TableScan
+
+    schema = Schema([
+        Field("g", DataType.INT64, False),
+        Field("v", DataType.INT64, False),
+    ])
+    scan = TableScan("default", "t", schema)
+    agg = Aggregate(
+        scan,
+        [Column(0)],
+        [AggregateFunction("SUM", [Column(1)], DataType.INT64)],
+        Schema([Field("g", DataType.INT64, False),
+                Field("SUM", DataType.INT64, False)]),
+    )
+    return agg.to_json()
