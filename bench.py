@@ -12,10 +12,11 @@ D2H) is reported separately with a per-phase breakdown under
 `configs.tpch_q1_parquet`.
 
 Env knobs: BENCH_SF (lineitem scale factor for config 3, default 1),
-BENCH_CONFIGS (comma list, default "1,2,3,4,5,3sf10,worker" — "3sf10"
-runs Q1 at the north-star SF-10 scale, "worker" runs the
+BENCH_CONFIGS (comma list, default "1,2,3,4,5,3sf10,worker,cache" —
+"3sf10" runs Q1 at the north-star SF-10 scale, "worker" runs the
 coordinator->worker-on-chip parity smoke and writes
-artifacts/TPU_WORKER_SMOKE.json), BENCH_RUNS / BENCH_COLD_RUNS.
+artifacts/TPU_WORKER_SMOKE.json, "cache" runs the result-cache
+warm-repeat phase), BENCH_RUNS / BENCH_COLD_RUNS.
 """
 
 import json
@@ -34,7 +35,7 @@ def main():
     device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker"
+        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache"
     ).split(",")
     runners = {
         "1": suite.config1_csv_filter,
@@ -49,6 +50,8 @@ def main():
         # node seam (reference scripts/smoketest.sh:30-66) exercised on
         # real hardware as part of every bench run
         "worker": suite.config_worker_smoke,
+        # warm-repeat phase: result-cache hit rate + warm/cold speedup
+        "cache": suite.config_cache,
     }
     if float(os.environ.get("BENCH_SF", 1)) == 10 and "3" in [
         w.strip() for w in wanted

@@ -444,6 +444,72 @@ def config4_sort_topk(device_kind: str):
     }
 
 
+# -- cache config: warm-repeat phase (result cache hit rate + speedup) --
+def config_cache(device_kind: str):
+    """Cold-vs-warm repeat of one query through the full SQL front end:
+    the cold leg executes (and fills the result cache), the warm legs
+    re-submit the identical SQL and must be served from the coordinator
+    result cache (parse+plan+fingerprint+replay, no device work).
+    Reports the hit rate and the warm/cold speedup."""
+    from datafusion_tpu import cache as qcache
+    from datafusion_tpu.cache.result import CachedResultRelation
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.materialize import collect
+
+    rows = int(os.environ.get("BENCH_CACHE_ROWS", 2_000_000))
+    groups = 10_000
+    sql = (
+        "SELECT k, SUM(v1), AVG(v2), MIN(v3), MAX(v3), COUNT(1) "
+        "FROM t GROUP BY k"
+    )
+    log("  config cache: warm-repeat result cache")
+    _, src = bdata.groupby_batches(rows, groups, 1 << 19)
+    device = None if device_kind == "cpu" else device_kind
+    with qcache.configured(enabled=True):
+        ctx = ExecutionContext(device="cpu" if device is None else device)
+        ctx.register_datasource("t", src)
+
+        def run():
+            return collect(ctx.sql(sql))
+
+        run()  # compile + warm device state outside the cold timing
+        ctx.result_cache.clear()
+        t0 = time.perf_counter()
+        cold_out = run()
+        cold_s = time.perf_counter() - t0
+        rel = ctx.sql(sql)
+        assert isinstance(rel, CachedResultRelation), (
+            "warm repeat was not served from the result cache"
+        )
+        warm_runs = max(WARM_RUNS, 5)
+        times = []
+        for _ in range(warm_runs):
+            t0 = time.perf_counter()
+            warm_out = collect(ctx.sql(sql))
+            times.append(time.perf_counter() - t0)
+        warm_s = _p50(times)
+        _assert_tables_match(warm_out, cold_out, "config cache", rtol=1e-9)
+        stats = ctx.result_cache.stats()
+    hit_rate = stats["hits"] / max(stats["hits"] + stats["misses"], 1)
+    log(
+        f"    cold {cold_s * 1e3:.1f} ms -> warm p50 {warm_s * 1e3:.2f} ms "
+        f"({cold_s / warm_s:.0f}x), hit rate {hit_rate:.2f}, "
+        f"{stats['bytes']} cached bytes"
+    )
+    return {
+        "name": "result_cache_warm_repeat",
+        "rows": rows,
+        "unit": "rows/s",
+        "value": round(rows / warm_s, 1),
+        "warm_p50_ms": round(warm_s * 1e3, 3),
+        "cold_ms": round(cold_s * 1e3, 2),
+        "warm_speedup": round(cold_s / warm_s, 1),
+        "hit_rate": round(hit_rate, 4),
+        "cached_bytes": stats["bytes"],
+        "vs_baseline": round(cold_s / warm_s, 3),
+    }
+
+
 # -- worker-on-the-chip smoke (part of the bench protocol) --
 def config_worker_smoke(device_kind: str):
     """Coordinator -> TPU-worker parity smoke on the attached chip

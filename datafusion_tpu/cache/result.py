@@ -1,0 +1,163 @@
+"""Coordinator-side result cache: capture + replay.
+
+The capture point is the materialization boundary, not the operator
+tree: `ExecutionContext.execute` tags the root relation of a cache-miss
+query with a `_result_cache_fill` callable, and `collect_columns`
+(`exec/materialize.py`) invokes it with the fully-materialized host
+columns after a complete, exception-free run.  This keeps the executed
+relation *identical* to the uncached engine — same operator types, same
+batch identities, same device behavior — so nothing downstream can tell
+caching is on until a repeat of the same fingerprint returns a
+`CachedResultRelation` instead of an operator tree.
+
+Stored values are host-only snapshots: numpy column copies, validity
+copies, and a frozen copy of each string dictionary's value table
+(dictionaries are append-only, so codes taken at snapshot time stay
+valid, but the snapshot must not pin the live dictionary object).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Iterator, Optional
+
+import numpy as np
+
+from datafusion_tpu.utils.metrics import METRICS
+
+
+class CachedResult:
+    """One query's materialized result, as stored in the cache."""
+
+    __slots__ = ("columns", "validity", "dict_values", "num_rows", "nbytes")
+
+    def __init__(self, columns, validity, dict_values, num_rows: int,
+                 nbytes: int):
+        self.columns = columns
+        self.validity = validity
+        self.dict_values = dict_values
+        self.num_rows = num_rows
+        self.nbytes = nbytes
+
+
+def _snapshot_nbytes(columns, validity, dicts) -> int:
+    """Byte size of a would-be snapshot, computed BEFORE any copying so
+    over-budget results cost nothing but this sum."""
+    n = 0
+    for c in columns:
+        n += c.nbytes
+    for v in validity:
+        if v is not None:
+            n += v.nbytes
+    for d in dicts:
+        if d is not None:
+            # string payload + per-entry object overhead estimate
+            n += sum(len(s) for s in d.values) + 16 * len(d.values)
+    return n
+
+
+def attach_result_capture(rel, store, key: str, tags, on_complete=None) -> None:
+    """Tag `rel` so its next complete materialization snapshots into
+    `store` under `key` (tagged with the scanned table names)."""
+
+    def fill(columns, validity, dicts, total, wall_s):
+        summary = {"rows": total, "cache_hit": False, "wall_s": wall_s}
+        try:
+            if not columns:
+                METRICS.add("cache.result.uncacheable")
+                return
+            nbytes = _snapshot_nbytes(columns, validity, dicts)
+            if nbytes > store.max_bytes:
+                store.rejected += 1
+                METRICS.add("cache.result.rejected")
+                return
+            entry = CachedResult(
+                [np.array(c, copy=True) for c in columns],
+                [None if v is None else np.array(v, copy=True)
+                 for v in validity],
+                [None if d is None else tuple(d.values) for d in dicts],
+                total,
+                nbytes,
+            )
+            store.put(key, entry, nbytes, tags=tags)
+        finally:
+            if on_complete is not None:
+                on_complete(summary)
+
+    rel._result_cache_fill = fill
+
+
+from datafusion_tpu.exec.relation import Relation
+
+
+class CachedResultRelation(Relation):
+    """Relation replaying a cached result as one host batch.
+
+    Shows up in EXPLAIN ANALYZE as `CachedResult[...]` with
+    `cache.hit=True` / `cache.bytes=...` operator attributes; pulling
+    its batches touches no datasource, worker, or device.
+    """
+
+    def __init__(self, schema, entry: CachedResult, fingerprint: str,
+                 on_complete=None):
+        self._schema = schema
+        self.entry = entry
+        self.fingerprint = fingerprint
+        self._on_complete = on_complete
+        self._op_stats = None
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def stats(self):
+        st = self._op_stats
+        if st is None:
+            from datafusion_tpu.obs.stats import OperatorStats
+
+            st = self._op_stats = OperatorStats()
+            st.attrs.update({
+                "cache.hit": True,
+                "cache.bytes": self.entry.nbytes,
+            })
+        return st
+
+    def op_name(self) -> str:
+        return "CachedResult"
+
+    def op_label(self) -> str:
+        return (
+            f"CachedResult[rows={self.entry.num_rows}, "
+            f"bytes={self.entry.nbytes}, fp={self.fingerprint[:12]}]"
+        )
+
+    def op_children(self) -> list:
+        return []
+
+    def batches(self) -> Iterator:
+        from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+
+        t0 = time.perf_counter()
+        entry = self.entry
+        METRICS.add("cache.result.rows_served", entry.num_rows)
+        self.stats  # materialize the cache.hit attrs for EXPLAIN ANALYZE
+        if entry.num_rows and entry.columns:
+            dicts: list[Optional[StringDictionary]] = []
+            for vals in entry.dict_values:
+                if vals is None:
+                    dicts.append(None)
+                    continue
+                d = StringDictionary()
+                d.values = list(vals)
+                d.index = {s: i for i, s in enumerate(vals)}
+                dicts.append(d)
+            yield make_host_batch(
+                self._schema, list(entry.columns), list(entry.validity), dicts
+            )
+        if self._on_complete is not None:
+            self._on_complete({
+                "rows": entry.num_rows,
+                "cache_hit": True,
+                "wall_s": time.perf_counter() - t0,
+            })

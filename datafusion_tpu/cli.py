@@ -92,6 +92,28 @@ class Console:
             else:
                 self.execute(f"EXPLAIN ANALYZE {arg}")
             return True
+        if cmd == "\\cache":
+            # result-cache introspection (datafusion_tpu/cache): hit/
+            # miss/eviction counters, byte budget, per-query history
+            store = getattr(self.ctx, "result_cache", None)
+            if store is None:
+                self._print("Result cache is off (DATAFUSION_TPU_CACHE=0).")
+            else:
+                s = store.stats()
+                self._print(
+                    f"Result cache: {s['entries']} entries, "
+                    f"{s['bytes']}/{s['max_bytes']} bytes, "
+                    f"ttl {s['ttl_s']}s — {s['hits']} hits, "
+                    f"{s['misses']} misses, {s['evictions']} evictions, "
+                    f"{s['invalidations']} invalidations"
+                )
+                for fp, runs in self.ctx.stats_history().items():
+                    warm = sum(1 for r in runs if r.get("cache_hit"))
+                    self._print(
+                        f"  {fp}: {len(runs)} runs ({warm} cached), "
+                        f"last {runs[-1]['wall_s'] * 1e3:.1f} ms"
+                    )
+            return True
         return False
 
     def execute(self, sql: str) -> None:

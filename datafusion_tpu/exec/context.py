@@ -18,6 +18,8 @@ scalar UDF lookup (`context.rs:222-224`) — is implemented.
 
 from __future__ import annotations
 
+import threading
+import time
 from typing import Callable, Iterator, Optional, Union
 
 import numpy as np
@@ -110,11 +112,37 @@ class ExecutionContext:
     mirroring the north-star `with_device("tpu")` design.
     """
 
-    def __init__(self, device: Optional[str] = None, batch_size: int = 131072):
+    def __init__(self, device: Optional[str] = None, batch_size: int = 131072,
+                 result_cache=None):
         self.datasources: dict[str, DataSource] = {}
         self.functions: dict[str, FunctionMeta] = {}
         self.batch_size = batch_size
         self.device = None
+        # catalog versioning: every (re-)registration of a table name
+        # bumps a context-wide serial, and the result-cache fingerprint
+        # folds the versions of every table a plan scans in — so
+        # re-registering a table instantly invalidates dependent entries
+        self._catalog_versions: dict[str, int] = {}
+        self._catalog_serial = 0
+        self._functions_version = 0
+        # result cache: None = off, False = explicitly off (workers'
+        # internal per-fragment contexts), a CacheStore, or the env
+        # default (datafusion_tpu.cache knobs)
+        if result_cache is None:
+            from datafusion_tpu import cache as _cache
+
+            result_cache = _cache.make_store("result")
+        elif result_cache is False:
+            result_cache = None
+        self._result_cache = result_cache
+        self._stats_history: dict[str, list[dict]] = {}
+        self._history_cap = 32  # runs kept per fingerprint
+        self._history_fingerprints = 128  # distinct fingerprints kept
+        self.last_fingerprint: Optional[str] = None
+        # per-thread root/recursion guard: concurrent queries on one
+        # context must not see each other's in-execute state (a subtree
+        # expansion mistaken for a root would mis-wire the cache seam)
+        self._execute_tls = threading.local()
         if device is not None:
             import jax
 
@@ -138,8 +166,18 @@ class ExecutionContext:
 
     # -- catalog --
     def register_datasource(self, name: str, ds: DataSource) -> None:
-        """reference `context.rs:99`"""
+        """reference `context.rs:99`.  Re-registering a name bumps its
+        catalog version: cached results that scanned the old table stop
+        matching (fingerprint) AND are dropped eagerly (tag)."""
+        self._catalog_serial += 1
+        self._catalog_versions[name] = self._catalog_serial
+        if self._result_cache is not None:
+            self._result_cache.invalidate_tag(name)
         self.datasources[name] = ds
+
+    def catalog_version(self, name: str) -> int:
+        """Monotonic version of a registered table (0 = never seen)."""
+        return self._catalog_versions.get(name, 0)
 
     def register_csv(
         self, name: str, path: str, schema: Schema, has_header: bool = True
@@ -179,6 +217,8 @@ class ExecutionContext:
             jax_fn,
             host_fn,
         )
+        # a (re-)registered UDF changes what identical SQL text computes
+        self._functions_version += 1
         self.functions[name.lower()] = meta
 
     def _jax_functions(self) -> dict[str, Callable]:
@@ -255,8 +295,118 @@ class ExecutionContext:
             self.register_parquet(stmt.name, stmt.location, schema)
         return DdlResult(f"Registered table {stmt.name}")
 
+    # -- result caching (datafusion_tpu/cache) --
+    def query_fingerprint(self, plan: LogicalPlan) -> str:
+        """Canonical identity of `plan`'s result under this context's
+        catalog state: plan wire JSON + per-table catalog versions +
+        backing-file versions (mtime, size — an externally rewritten
+        file must not serve stale cached rows) + the execution
+        environment facts that change answers (device, batch size, UDF
+        registry version)."""
+        from datafusion_tpu.cache import (
+            plan_fingerprint,
+            scan_tables,
+            source_version,
+        )
+
+        versions: dict[str, object] = {}
+        for t in scan_tables(plan):
+            entry: list = [self.catalog_version(t)]
+            ds = self.datasources.get(t)
+            if ds is not None:
+                try:
+                    entry.append(source_version(ds.to_meta()))
+                except PlanError:
+                    # non-serializable (in-memory) sources have no file
+                    # identity; the catalog version alone covers them
+                    pass
+            versions[t] = entry
+        return plan_fingerprint(plan, versions, extra={
+            "device": str(self.device) if self.device is not None else "",
+            "batch_size": self.batch_size,
+            "functions_v": self._functions_version,
+        })
+
+    @property
+    def result_cache(self):
+        """The context's result CacheStore (None when caching is off)."""
+        return self._result_cache
+
+    def _record_history(self, fingerprint: str, summary: dict,
+                        root: Optional[Relation] = None) -> None:
+        entry = {"fingerprint": fingerprint, "ts": time.time(), **summary}
+        if root is not None:
+            from datafusion_tpu.obs import trace as obs_trace
+
+            if obs_trace.enabled():
+                from datafusion_tpu.obs.stats import collect_tree
+
+                entry["operators"] = [
+                    {"op": rel.op_label(), "depth": depth,
+                     **rel.stats.snapshot()}
+                    for depth, rel in collect_tree(root)
+                ]
+        hist = self._stats_history.setdefault(fingerprint, [])
+        hist.append(entry)
+        del hist[: -self._history_cap]
+        # bound the number of distinct fingerprints too (a long-lived
+        # coordinator seeing parameterized SQL mints one per literal):
+        # drop the oldest-inserted fingerprints beyond the cap
+        while len(self._stats_history) > self._history_fingerprints:
+            # tolerant pop: two threads recording concurrently may race
+            # to evict the same oldest key
+            try:
+                self._stats_history.pop(next(iter(self._stats_history)), None)
+            except (StopIteration, RuntimeError):
+                break
+
+    def stats_history(self, fingerprint: Optional[str] = None):
+        """Per-query run history keyed by plan fingerprint: each entry
+        records rows, wall seconds, whether it was a cache hit, and —
+        on instrumented runs (EXPLAIN ANALYZE / tracing) — per-operator
+        stats.  Warm-vs-cold runs of the same query compare directly.
+        With a fingerprint returns that query's runs (oldest first);
+        without, the whole mapping."""
+        if fingerprint is not None:
+            return list(self._stats_history.get(fingerprint, ()))
+        return {k: list(v) for k, v in self._stats_history.items()}
+
     # -- plan -> operators (reference context.rs:103-163) --
     def execute(self, plan: LogicalPlan) -> Relation:
+        """The cache seam: a root-level plan whose fingerprint is cached
+        replays materialized batches (`CachedResultRelation`); a miss
+        executes normally with a capture hook attached, filled by
+        `collect_columns` at the materialization boundary.  Recursive
+        calls (operator subtrees) pass straight through to
+        `_execute_plan`, which subclasses override."""
+        tls = self._execute_tls
+        if getattr(tls, "in_execute", False) or self._result_cache is None:
+            return self._execute_plan(plan)
+        tls.in_execute = True
+        try:
+            from datafusion_tpu.cache import scan_tables
+            from datafusion_tpu.cache.result import (
+                CachedResultRelation,
+                attach_result_capture,
+            )
+
+            fp = self.last_fingerprint = self.query_fingerprint(plan)
+            entry = self._result_cache.get(fp)
+            if entry is not None:
+                return CachedResultRelation(
+                    plan.schema, entry, fp,
+                    on_complete=lambda s: self._record_history(fp, s),
+                )
+            rel = self._execute_plan(plan)
+            attach_result_capture(
+                rel, self._result_cache, fp, tags=scan_tables(plan),
+                on_complete=lambda s: self._record_history(fp, s, root=rel),
+            )
+            return rel
+        finally:
+            tls.in_execute = False
+
+    def _execute_plan(self, plan: LogicalPlan) -> Relation:
         fns = self._jax_functions()
         if isinstance(plan, TableScan):
             ds = self.datasources.get(plan.table_name)

@@ -279,7 +279,16 @@ def collect_columns(relation):
 
     Returns (columns, validity, dicts, total_rows); strings stay
     dictionary-coded (dicts[i] holds the decoder).
+
+    This is also the result-cache capture point: a root relation that
+    `ExecutionContext.execute` tagged with `_result_cache_fill`
+    (`cache/result.py`) gets the fully-materialized columns handed to
+    that hook after a complete, exception-free run — caching never
+    changes what this function returns or how batches are pulled.
     """
+    import time as _time
+
+    t0 = _time.perf_counter()
     schema = relation.schema
     ncols = len(schema)
     parts: list[list[np.ndarray]] = [[] for _ in range(ncols)]
@@ -331,6 +340,9 @@ def collect_columns(relation):
                 for v, p in zip(vparts[i], parts[i])
             ]
             validity.append(np.concatenate(vs))
+    fill = getattr(relation, "_result_cache_fill", None)
+    if fill is not None:
+        fill(columns, validity, dicts, total, _time.perf_counter() - t0)
     return columns, validity, dicts, total
 
 

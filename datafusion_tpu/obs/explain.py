@@ -134,6 +134,11 @@ class _RootTap:
 
     def __init__(self, rel):
         self.rel = rel
+        # forward the result-cache capture hook so EXPLAIN ANALYZE runs
+        # populate the cache exactly like plain runs (cache/result.py)
+        fill = getattr(rel, "_result_cache_fill", None)
+        if fill is not None:
+            self._result_cache_fill = fill
 
     @property
     def schema(self):

@@ -285,6 +285,13 @@ def span(name: str, **attrs: Any):
     return _SpanScope(name, attrs or None)
 
 
+def buffered() -> int:
+    """Finished spans currently buffered (the span-buffer-depth gauge
+    workers fold into their status/Prometheus scrape)."""
+    with _lock:
+        return len(_spans)
+
+
 def spans(trace_id: Optional[str] = None) -> list[dict]:
     """Snapshot of buffered spans (filtered by trace when given)."""
     with _lock:
@@ -426,12 +433,84 @@ def _install_compile_listener() -> None:
         pass
 
 
+# -- background trace flusher (push export for long-running workers) --
+# Span export is otherwise pull-only (drain / ride fragment responses):
+# a worker whose spans outlive any request would buffer until overflow.
+# With DATAFUSION_TPU_TRACE_FLUSH_S set (> 0), a daemon thread drains
+# finished spans every interval and APPENDS them to the trace file as
+# JSON lines (one span dict per line — `json.loads` per line rebuilds
+# them; chrome_trace() accepts the list).  Without it, the atexit hook
+# keeps writing one Chrome-trace document as before.
+_flush_stop = threading.Event()
+_flush_thread: Optional[threading.Thread] = None
+# once the flusher has ever run, the trace file is JSONL — the atexit
+# dump must append the tail instead of truncating it with a Chrome doc
+_flush_path: Optional[str] = None
+
+
+def _flush_once(path: str) -> int:
+    out = drain()
+    if out:
+        import json
+
+        with open(path, "a", encoding="utf-8") as f:
+            for sp in out:
+                f.write(json.dumps(sp) + "\n")
+    return len(out)
+
+
+def start_flusher(path: Optional[str] = None,
+                  interval_s: Optional[float] = None) -> bool:
+    """Start (idempotently) the background span flusher.  Defaults come
+    from DATAFUSION_TPU_TRACE_FILE / DATAFUSION_TPU_TRACE_FLUSH_S;
+    returns False when either is missing."""
+    global _flush_thread, _flush_path
+    path = path or os.environ.get("DATAFUSION_TPU_TRACE_FILE")
+    if interval_s is None:
+        env = os.environ.get("DATAFUSION_TPU_TRACE_FLUSH_S", "")
+        interval_s = float(env) if env else 0.0
+    if not path or not interval_s or _flush_thread is not None:
+        return _flush_thread is not None
+    _flush_path = path
+
+    def _loop():
+        while not _flush_stop.wait(interval_s):
+            try:
+                _flush_once(path)
+            except Exception:  # noqa: BLE001 — the flusher must outlive IO
+                METRICS.add("obs.flush_errors")
+
+    _flush_stop.clear()
+    _flush_thread = threading.Thread(
+        target=_loop, name="df-tpu-trace-flush", daemon=True
+    )
+    _flush_thread.start()
+    return True
+
+
+def stop_flusher(flush: bool = True) -> None:
+    global _flush_thread
+    if _flush_thread is None:
+        return
+    _flush_stop.set()
+    _flush_thread.join(timeout=10)
+    _flush_thread = None
+    if flush and _flush_path:
+        _flush_once(_flush_path)
+
+
 _trace_file = os.environ.get("DATAFUSION_TPU_TRACE_FILE")
 if _trace_file:
     import atexit
 
     def _dump_at_exit(path=_trace_file):
         try:
+            if _flush_path is not None:
+                # the flusher owned (or still owns) the file — it is
+                # JSONL; append the tail rather than truncating the
+                # already-flushed spans with a Chrome-trace document
+                _flush_once(_flush_path)
+                return
             from datafusion_tpu.obs.export import write_chrome_trace
 
             write_chrome_trace(path, spans())
@@ -439,6 +518,7 @@ if _trace_file:
             pass
 
     atexit.register(_dump_at_exit)
+    start_flusher()
 if _ENABLED:
     _install_compile_listener()
 del _trace_file

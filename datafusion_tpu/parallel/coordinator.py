@@ -324,6 +324,13 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
             try:
                 faults.check("coord.request", shard=frag.shard)
                 resp = w.request(msg, timeout=timeout)
+                if resp.get("cache_hit"):
+                    # the worker served this fragment from its fragment
+                    # cache (no partition re-scan) — the flag rides the
+                    # wire response and surfaces in the dispatch span
+                    METRICS.add("coord.fragment_cache_hits")
+                    if sp is not None:
+                        sp.attrs["cache_hit"] = True
                 obs_trace.finish_span(sp)
                 obs_trace.ingest(resp.pop("spans", None))
                 return frag, resp
@@ -650,10 +657,12 @@ class DistributedContext(ExecutionContext):
         probation_pings: int = 1,
         fail_threshold: int = 2,
         query_deadline_s: Optional[float] = None,
+        result_cache=None,
     ):
         import os
 
-        super().__init__(device=None, batch_size=batch_size)
+        super().__init__(device=None, batch_size=batch_size,
+                         result_cache=result_cache)
         self.workers = [WorkerHandle(h, p, request_timeout) for h, p in workers]
         if query_deadline_s is None:
             env = os.environ.get("DATAFUSION_TPU_QUERY_DEADLINE_S")
@@ -699,10 +708,12 @@ class DistributedContext(ExecutionContext):
                 out[f"{w.host}:{w.port}"] = None
         return out
 
-    def execute(self, plan: LogicalPlan) -> Relation:
+    def _execute_plan(self, plan: LogicalPlan) -> Relation:
         # unlike the single-host mesh matcher this one keeps Utf8
         # MIN/MAX: the coordinator merges actual strings, so worker-local
-        # dictionary codes never need a shared rank table
+        # dictionary codes never need a shared rank table.  (The result
+        # cache sits above this in ExecutionContext.execute: a repeated
+        # identical query replays without dispatching any fragment.)
         agg, pred, scan = _match_shippable_aggregate(plan, self.datasources)
         if agg is not None:
             ds = self.datasources[scan.table_name]
@@ -711,7 +722,7 @@ class DistributedContext(ExecutionContext):
             try:
                 ds.to_meta()  # fragments must be serializable
             except PlanError:
-                return super().execute(plan)
+                return super()._execute_plan(plan)
             return DistributedAggregateRelation(
                 plan, agg, pred, scan, ds, self.workers,
                 functions=self._jax_functions(),
@@ -722,9 +733,9 @@ class DistributedContext(ExecutionContext):
             try:
                 ds.to_meta()
             except PlanError:
-                return super().execute(plan)
+                return super()._execute_plan(plan)
             return DistributedUnionRelation(
                 plan, ds, self.workers,
                 query_deadline_s=self.query_deadline_s,
             )
-        return super().execute(plan)
+        return super()._execute_plan(plan)

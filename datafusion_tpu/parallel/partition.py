@@ -848,10 +848,12 @@ class PartitionedContext(ExecutionContext):
 
     def __init__(self, mesh=None, n_devices: Optional[int] = None,
                  batch_size: int = 131072,
-                 query_deadline_s: Optional[float] = None):
+                 query_deadline_s: Optional[float] = None,
+                 result_cache=None):
         import os
 
-        super().__init__(device=None, batch_size=batch_size)
+        super().__init__(device=None, batch_size=batch_size,
+                         result_cache=result_cache)
         self.mesh = mesh if mesh is not None else make_mesh(n_devices)
         self.last_fragments: list[PlanFragment] = []
         if query_deadline_s is None:
@@ -881,10 +883,12 @@ class PartitionedContext(ExecutionContext):
             ),
         )
 
-    def execute(self, plan: LogicalPlan) -> Relation:
+    def _execute_plan(self, plan: LogicalPlan) -> Relation:
         # wrap only the ROOT (execute recurses through self.execute for
         # child plans; nested wrappers would hand every subtree a fresh
-        # budget instead of one per-query deadline)
+        # budget instead of one per-query deadline).  The result-cache
+        # seam lives one level up (ExecutionContext.execute): a cache
+        # hit replays batches without entering this method at all.
         if self.query_deadline_s is None or self._executing:
             return self._execute_unbounded(plan)
         self._executing = True
@@ -944,7 +948,7 @@ class PartitionedContext(ExecutionContext):
                 functions=self._jax_functions(),
                 function_metas=self.functions,
             )
-        return super().execute(plan)
+        return super()._execute_plan(plan)
 
     def _ship_fragments(self, plan: LogicalPlan, ds: PartitionedDataSource) -> list[PlanFragment]:
         n = len(ds.partitions)

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Cache smoketest: the query/fragment cache contract end to end.
+
+One process, CPU backend, one worker subprocess.  Asserts:
+
+1. a repeated identical SQL query on one context is served from the
+   coordinator result cache — no datasource re-scan, no worker
+   dispatch — and returns identical rows;
+2. EXPLAIN ANALYZE on the repeat shows `cache.hit=True`;
+3. on the distributed path, a duplicate fragment dispatch (lost
+   response -> failover replay) is served from the worker's fragment
+   cache: the cache-hit flag is observed at merge and the worker's
+   scrape shows the hits;
+4. re-registering a table invalidates dependent result-cache entries;
+5. `DATAFUSION_TPU_CACHE=0` turns everything off (no cached relations,
+   no fragment cache on a worker spawned with the knob).
+
+Exit non-zero on any violation.  `scripts/smoketest.sh` runs this after
+the trace smoke.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _write_partitions(tmpdir: str, n_parts: int = 2, rows_per: int = 400):
+    import numpy as np
+
+    rng = np.random.default_rng(17)
+    regions = ["north", "south", "east", "west"]
+    paths = []
+    for p in range(n_parts):
+        path = os.path.join(tmpdir, f"part{p}.csv")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("region,v\n")
+            for _ in range(rows_per):
+                f.write(f"{regions[rng.integers(0, 4)]},"
+                        f"{int(rng.integers(-1000, 1000))}\n")
+        paths.append(path)
+    return paths
+
+
+def _spawn_worker(env):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "datafusion_tpu.worker",
+         "--bind", "127.0.0.1:0", "--device", "cpu"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on" in line, f"worker failed to start: {line!r}"
+    host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+    return proc, (host, int(port))
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from datafusion_tpu.cache.result import CachedResultRelation
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+    from datafusion_tpu.testing import faults
+    from datafusion_tpu.utils.metrics import METRICS
+
+    schema = Schema([
+        Field("region", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+    ])
+    sql = ("SELECT region, SUM(v), COUNT(1), MIN(v), MAX(v) "
+           "FROM t GROUP BY region")
+
+    tmpdir = tempfile.mkdtemp(prefix="df_tpu_cache_smoke_")
+    paths = _write_partitions(tmpdir)
+
+    def make_pds():
+        return PartitionedDataSource(
+            [CsvDataSource(p, schema, True, 131072) for p in paths]
+        )
+
+    # 1. local result cache: repeat served without re-execution
+    ctx = ExecutionContext(device="cpu")
+    ctx.register_datasource("t", make_pds())
+    want = sorted(collect(ctx.sql(sql)).to_rows())
+    rel = ctx.sql(sql)
+    assert isinstance(rel, CachedResultRelation), type(rel).__name__
+    got = sorted(collect(rel).to_rows())
+    assert got == want, f"cached result diverges:\n{got}\nvs\n{want}"
+    stats = ctx.result_cache.stats()
+    assert stats["hits"] >= 1, stats
+    print(f"result cache: repeat served from cache ({stats['bytes']} bytes, "
+          f"{stats['hits']} hits)", flush=True)
+
+    # 2. EXPLAIN ANALYZE shows the hit
+    report = ctx.sql(f"EXPLAIN ANALYZE {sql}").report()
+    assert "cache.hit=True" in report, report
+    print("EXPLAIN ANALYZE reports cache.hit=True", flush=True)
+
+    # 4 (early, while the entry is warm). re-registration invalidates
+    ctx.register_datasource("t", make_pds())
+    rel = ctx.sql(sql)
+    assert not isinstance(rel, CachedResultRelation), (
+        "re-registering the table must invalidate its cached results"
+    )
+    assert sorted(collect(rel).to_rows()) == want
+    print("table re-registration invalidates dependent entries", flush=True)
+
+    # 3. distributed: failover replay served from the fragment cache
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    proc, addr = _spawn_worker(env)
+    try:
+        dctx = DistributedContext([addr], result_cache=False)
+        dctx.register_datasource("t", make_pds())
+        dgot = sorted(collect(dctx.sql(sql)).to_rows())
+        assert dgot == want, f"distributed run diverges:\n{dgot}\nvs\n{want}"
+        before = METRICS.snapshot()["counts"].get(
+            "coord.fragment_cache_hits", 0
+        )
+        with faults.scoped({"rules": [
+            {"site": "wire.recv", "op": "raise",
+             "exc": "ConnectionResetError", "after": 1, "count": 1},
+        ]}) as plan:
+            dgot = sorted(collect(dctx.sql(sql)).to_rows())
+            assert plan.snapshot()[0]["fired"] == 1
+        assert dgot == want, "replayed run diverges"
+        hits = METRICS.snapshot()["counts"].get(
+            "coord.fragment_cache_hits", 0
+        ) - before
+        assert hits >= 2, f"expected cached fragment serves, saw {hits}"
+        status = dctx.worker_status()[f"{addr[0]}:{addr[1]}"]
+        frag = status["cache"]["fragment"]
+        assert frag and frag["hits"] >= 2, frag
+        assert "cache_fragment_bytes" in status["prometheus"]
+        print(f"fragment cache: replay after lost response served from "
+              f"memory ({hits} cache-hit responses at merge, worker "
+              f"{frag['hits']} hits / {frag['bytes']} bytes)", flush=True)
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+
+    # 5. the master switch
+    from datafusion_tpu import cache as qcache
+
+    with qcache.configured(enabled=False):
+        off_ctx = ExecutionContext(device="cpu")
+        off_ctx.register_datasource("t", make_pds())
+        assert off_ctx.result_cache is None
+        collect(off_ctx.sql(sql))
+        rel = off_ctx.sql(sql)
+        assert not isinstance(rel, CachedResultRelation)
+    env_off = dict(env)
+    env_off["DATAFUSION_TPU_CACHE"] = "0"
+    proc, addr = _spawn_worker(env_off)
+    try:
+        dctx = DistributedContext([addr], result_cache=False)
+        dctx.register_datasource("t", make_pds())
+        collect(dctx.sql(sql))
+        status = dctx.worker_status()[f"{addr[0]}:{addr[1]}"]
+        assert status["cache"]["fragment"] is None, status["cache"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=10)
+    print("DATAFUSION_TPU_CACHE=0 disables both caches", flush=True)
+
+    print("CACHE SMOKETEST PASSED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -123,7 +123,13 @@ def main() -> int:
         print(f"in-process workers at {addrs}", flush=True)
 
         retry.seed_backoff(42)
-        dctx = DistributedContext(addrs, query_deadline_s=300.0)
+        # result_cache=False: this smoke asserts RE-execution mechanics
+        # (failover, dedup, retries, the healed re-run) — a coordinator
+        # result-cache hit would skip the cluster entirely.  The worker
+        # fragment caches stay on (in-process workers), so the replay
+        # legs also exercise cached serves.
+        dctx = DistributedContext(addrs, query_deadline_s=300.0,
+                                  result_cache=False)
         dctx.register_datasource("t", make_pds())
         with faults.scoped(FAULT_PLAN) as plan:
             got = rows(dctx)

@@ -30,6 +30,9 @@ python scripts/chaos_smoke.py
 echo "== trace smoke (EXPLAIN ANALYZE + merged worker trace) =="
 python scripts/trace_smoke.py
 
+echo "== cache smoke (result + fragment caches, invalidation, off-switch) =="
+python scripts/cache_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

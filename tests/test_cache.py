@@ -1,0 +1,675 @@
+"""Plan-fingerprinted query/fragment cache subsystem (datafusion_tpu/cache).
+
+Covers the store mechanics (byte-accounted LRU, TTL, tag invalidation),
+fingerprint canonicalization (catalog versions, fragment identity
+without query_id, source-file versioning), the coordinator result cache
+(repeat query served without re-execution, EXPLAIN ANALYZE cache.hit,
+invalidation on re-registration, zero overhead when off), the worker
+fragment cache (duplicate dispatches after failover served from memory,
+cache-hit flag observed at merge), per-context stats history, and the
+background trace flusher.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu import cache
+from datafusion_tpu.cache.result import CachedResultRelation
+from datafusion_tpu.cache.store import CacheStore
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.datasource import MemoryDataSource
+from datafusion_tpu.exec.materialize import collect
+from datafusion_tpu.utils.metrics import METRICS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- helpers --------------------------------------------------------------
+
+SCHEMA = Schema(
+    [Field("k", DataType.UTF8, False), Field("v", DataType.FLOAT64, False)]
+)
+
+
+def _mem_source(keys=("a", "b", "a", "c"), vals=(1.0, 2.0, 3.0, 4.0)):
+    d = StringDictionary()
+    codes = np.array([d.add(s) for s in keys], dtype=np.int32)
+    batch = make_host_batch(
+        SCHEMA, [codes, np.asarray(vals, dtype=np.float64)],
+        [None, None], [d, None],
+    )
+    return MemoryDataSource(SCHEMA, [batch])
+
+
+class CountingSource(MemoryDataSource):
+    """MemoryDataSource that counts scans — asserts 'no re-execution'.
+    The counter is shared through projection pushdown (with_projection
+    builds a new source object)."""
+
+    def __init__(self, schema, batches, counter=None):
+        super().__init__(schema, batches)
+        self.counter = counter if counter is not None else {"scans": 0}
+
+    @property
+    def scans(self):
+        return self.counter["scans"]
+
+    def batches(self):
+        self.counter["scans"] += 1
+        return super().batches()
+
+    def with_projection(self, projection):
+        base = super().with_projection(projection)
+        return CountingSource(base._schema, base._batches, self.counter)
+
+
+def _counting_ctx(**kw):
+    src = CountingSource(SCHEMA, list(_mem_source()._batches))
+    ctx = ExecutionContext(device="cpu", **kw)
+    ctx.register_datasource("t", src)
+    return ctx, src
+
+
+SQL = "SELECT k, SUM(v), COUNT(1) FROM t GROUP BY k"
+
+
+def _rows(ctx, sql=SQL):
+    return sorted(collect(ctx.sql(sql)).to_rows())
+
+
+# -- store ----------------------------------------------------------------
+
+
+class TestCacheStore:
+    def test_lru_eviction_by_bytes(self):
+        st = CacheStore(max_bytes=100, name="t1")
+        assert st.put("a", 1, 40) and st.put("b", 2, 40)
+        assert st.get("a") == 1  # a is now MRU
+        assert st.put("c", 3, 40)  # evicts b (LRU)
+        assert st.get("b") is None
+        assert st.get("a") == 1 and st.get("c") == 3
+        assert st.evictions == 1
+        assert st.bytes_used == 80
+
+    def test_oversized_value_rejected_not_stored(self):
+        st = CacheStore(max_bytes=100, name="t2")
+        st.put("small", 1, 10)
+        assert not st.put("huge", 2, 1000)
+        assert st.get("huge") is None
+        assert st.get("small") == 1  # the giant value didn't wipe the cache
+        assert st.rejected == 1
+
+    def test_ttl_expiry(self):
+        st = CacheStore(max_bytes=100, ttl_s=0.05, name="t3")
+        st.put("a", 1, 10)
+        assert st.get("a") == 1
+        time.sleep(0.08)
+        assert st.get("a") is None
+        assert st.entries == 0 and st.bytes_used == 0
+
+    def test_tag_invalidation(self):
+        st = CacheStore(max_bytes=1000, name="t4")
+        st.put("q1", 1, 10, tags=("lineitem",))
+        st.put("q2", 2, 10, tags=("lineitem", "orders"))
+        st.put("q3", 3, 10, tags=("orders",))
+        assert st.invalidate_tag("lineitem") == 2
+        assert st.get("q1") is None and st.get("q2") is None
+        assert st.get("q3") == 3
+        assert st.bytes_used == 10
+
+    def test_replace_updates_bytes(self):
+        st = CacheStore(max_bytes=100, name="t5")
+        st.put("a", 1, 60)
+        st.put("a", 2, 30)
+        assert st.bytes_used == 30 and st.entries == 1
+        assert st.get("a") == 2
+
+    def test_stats_and_gauges(self):
+        st = CacheStore(max_bytes=100, name="t6")
+        st.put("a", 1, 10)
+        st.get("a")
+        st.get("missing")
+        s = st.stats()
+        assert (s["hits"], s["misses"], s["entries"]) == (1, 1, 1)
+        g = st.gauges()
+        assert g["cache.t6.bytes"] == 10 and g["cache.t6.entries"] == 1
+
+
+# -- fingerprints ---------------------------------------------------------
+
+
+class TestFingerprint:
+    def _plan(self, ctx, sql):
+        from datafusion_tpu.sql.parser import parse_sql
+
+        return ctx._plan(parse_sql(sql))
+
+    def test_plan_fingerprint_deterministic_and_sensitive(self):
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("t", _mem_source())
+        p1 = self._plan(ctx, SQL)
+        p2 = self._plan(ctx, SQL)
+        assert ctx.query_fingerprint(p1) == ctx.query_fingerprint(p2)
+        p3 = self._plan(ctx, "SELECT k, SUM(v), COUNT(1) FROM t GROUP BY k "
+                             "LIMIT 1")
+        assert ctx.query_fingerprint(p1) != ctx.query_fingerprint(p3)
+        # a different literal is different work
+        a = self._plan(ctx, "SELECT v FROM t WHERE v > 1.0")
+        b = self._plan(ctx, "SELECT v FROM t WHERE v > 2.0")
+        assert ctx.query_fingerprint(a) != ctx.query_fingerprint(b)
+
+    def test_catalog_version_changes_fingerprint(self):
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("t", _mem_source())
+        plan = self._plan(ctx, SQL)
+        fp1 = ctx.query_fingerprint(plan)
+        ctx.register_datasource("t", _mem_source())  # same data, new version
+        assert ctx.query_fingerprint(plan) != fp1
+        assert ctx.catalog_version("t") == 2
+
+    def test_fragment_fingerprint_ignores_query_id(self, tmp_path):
+        from datafusion_tpu.parallel.physical import PlanFragment
+
+        path = tmp_path / "part.csv"
+        path.write_text("k,v\na,1.0\nb,2.0\n")
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_csv("t", str(path), SCHEMA)
+        plan = self._plan(ctx, SQL)
+        meta = ctx.datasources["t"].to_meta()
+        f1 = PlanFragment(0, 2, plan.to_json(), meta, "query-aaa")
+        f2 = PlanFragment(0, 2, plan.to_json(), meta, "query-bbb")
+        assert cache.fragment_fingerprint(f1) == cache.fragment_fingerprint(f2)
+        # shard identity and source-file version DO matter
+        f3 = PlanFragment(1, 2, plan.to_json(), meta, "query-aaa")
+        assert cache.fragment_fingerprint(f1) != cache.fragment_fingerprint(f3)
+        fp_before = cache.fragment_fingerprint(f1)
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        assert cache.fragment_fingerprint(f1) != fp_before
+
+    def test_canonical_json_key_order_independent(self):
+        assert cache.canonical_json({"b": 1, "a": [2, 3]}) == \
+            cache.canonical_json({"a": [2, 3], "b": 1})
+
+
+# -- coordinator result cache --------------------------------------------
+
+
+class TestResultCache:
+    def test_repeat_query_served_without_reexecution(self):
+        ctx, src = _counting_ctx()
+        want = _rows(ctx)
+        scans = src.scans
+        assert scans >= 1
+        rel = ctx.sql(SQL)
+        assert isinstance(rel, CachedResultRelation)
+        assert sorted(collect(rel).to_rows()) == want
+        assert src.scans == scans  # datasource untouched on the repeat
+        assert ctx.result_cache.hits == 1
+
+    def test_explain_analyze_shows_cache_hit(self):
+        ctx, _src = _counting_ctx()
+        _rows(ctx)
+        report = ctx.sql("EXPLAIN ANALYZE " + SQL).report()
+        assert "CachedResult" in report and "cache.hit=True" in report
+
+    def test_explain_analyze_populates_cache(self):
+        # the EA run is a real execution — its result fills the cache,
+        # so a plain repeat afterwards is a hit
+        ctx, src = _counting_ctx()
+        res = ctx.sql("EXPLAIN ANALYZE " + SQL)
+        scans = src.scans
+        rel = ctx.sql(SQL)
+        assert isinstance(rel, CachedResultRelation)
+        assert sorted(collect(rel).to_rows()) == sorted(res.result.to_rows())
+        assert src.scans == scans
+
+    def test_reregistration_invalidates(self):
+        ctx, _src = _counting_ctx()
+        want1 = _rows(ctx)
+        src2 = CountingSource(SCHEMA, list(_mem_source(
+            keys=("x", "x"), vals=(10.0, 20.0))._batches))
+        ctx.register_datasource("t", src2)
+        rel = ctx.sql(SQL)
+        assert not isinstance(rel, CachedResultRelation)
+        got = sorted(collect(rel).to_rows())
+        assert got == [("x", 30.0, 2)] and got != want1
+        assert src2.scans >= 1
+
+    def test_ttl_expiry_re_executes(self):
+        with cache.configured(ttl_s=0.05):
+            ctx, src = _counting_ctx()
+            _rows(ctx)
+            scans = src.scans
+            time.sleep(0.08)
+            rel = ctx.sql(SQL)
+            assert not isinstance(rel, CachedResultRelation)
+            collect(rel)
+            assert src.scans > scans
+
+    def test_oversized_result_not_cached(self):
+        with cache.configured(max_bytes=64):  # result won't fit
+            ctx, src = _counting_ctx()
+            _rows(ctx)
+            scans = src.scans
+            rel = ctx.sql(SQL)
+            assert not isinstance(rel, CachedResultRelation)
+            collect(rel)
+            assert src.scans > scans
+            assert ctx.result_cache.entries == 0
+
+    def test_distinct_queries_distinct_entries(self):
+        ctx, _src = _counting_ctx()
+        _rows(ctx)
+        _rows(ctx, "SELECT v FROM t WHERE v > 1.5")
+        assert ctx.result_cache.entries == 2
+        assert isinstance(ctx.sql(SQL), CachedResultRelation)
+        assert isinstance(
+            ctx.sql("SELECT v FROM t WHERE v > 1.5"), CachedResultRelation
+        )
+
+    def test_utf8_and_validity_roundtrip(self):
+        schema = Schema([
+            Field("s", DataType.UTF8, True),
+            Field("x", DataType.FLOAT64, True),
+        ])
+        d = StringDictionary()
+        codes = np.array([d.add(s) for s in ["aa", "bb", "aa"]], np.int32)
+        batch = make_host_batch(
+            schema,
+            [codes, np.array([1.0, 2.0, 3.0])],
+            [np.array([True, False, True]), np.array([False, True, True])],
+            [d, None],
+        )
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource("u", MemoryDataSource(schema, [batch]))
+        sql = "SELECT s, x FROM u"
+        want = collect(ctx.sql(sql)).to_rows()
+        rel = ctx.sql(sql)
+        assert isinstance(rel, CachedResultRelation)
+        assert collect(rel).to_rows() == want
+        assert [r[0] for r in want] == ["aa", None, "aa"]
+
+    def test_empty_result_cached(self):
+        ctx, _src = _counting_ctx()
+        sql = "SELECT v FROM t WHERE v > 100.0"
+        assert _rows(ctx, sql) == []
+        rel = ctx.sql(sql)
+        assert isinstance(rel, CachedResultRelation)
+        assert sorted(collect(rel).to_rows()) == []
+
+    def test_udf_registration_invalidates_by_fingerprint(self):
+        ctx, _src = _counting_ctx()
+        _rows(ctx)
+        ctx.register_udf(
+            "twice", [DataType.FLOAT64], DataType.FLOAT64, lambda x: x * 2
+        )
+        # the functions_version rode the fingerprint: same SQL re-plans
+        assert not isinstance(ctx.sql(SQL), CachedResultRelation)
+
+    def test_off_means_off(self):
+        with cache.configured(enabled=False):
+            ctx, src = _counting_ctx()
+            assert ctx.result_cache is None
+            _rows(ctx)
+            scans = src.scans
+            rel = ctx.sql(SQL)
+            assert not isinstance(rel, CachedResultRelation)
+            assert not hasattr(rel, "_result_cache_fill")
+            collect(rel)
+            assert src.scans > scans
+
+    def test_explicit_false_overrides_env_default(self):
+        ctx = ExecutionContext(device="cpu", result_cache=False)
+        assert ctx.result_cache is None
+
+    def test_externally_rewritten_file_not_served_stale(self, tmp_path):
+        # the result fingerprint folds in the backing file's
+        # (mtime, size): rewriting the file out from under the catalog
+        # must miss, exactly like the uncached engine re-scanning it
+        path = tmp_path / "t.csv"
+        path.write_text("k,v\na,1.0\nb,2.0\n")
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_csv("t", str(path), SCHEMA)
+        sql = "SELECT k, v FROM t"
+        assert sorted(r[0] for r in _rows(ctx, sql)) == ["a", "b"]
+        path.write_text("k,v\nz,9.0\n")
+        st = os.stat(path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns + 1_000_000))
+        rel = ctx.sql(sql)
+        assert not isinstance(rel, CachedResultRelation)
+        assert [r[0] for r in collect(rel).to_rows()] == ["z"]
+
+    def test_concurrent_queries_one_context(self):
+        # the root/recursion guard is per-thread: parallel queries on a
+        # shared context must each see correct (and cacheable) results
+        import threading
+
+        ctx, _src = _counting_ctx()
+        sqls = [SQL, "SELECT v FROM t WHERE v > 1.5", "SELECT k FROM t"]
+        wants = [_rows(ctx, s) for s in sqls]
+        results: dict[int, list] = {}
+
+        def run(i):
+            out = []
+            for _ in range(5):
+                out.append(_rows(ctx, sqls[i]))
+            results[i] = out
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(sqls))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, want in enumerate(wants):
+            assert all(got == want for got in results[i])
+
+
+# -- stats history --------------------------------------------------------
+
+
+class TestStatsHistory:
+    def test_warm_and_cold_runs_recorded(self):
+        ctx, _src = _counting_ctx()
+        _rows(ctx)
+        fp = ctx.last_fingerprint
+        _rows(ctx)
+        hist = ctx.stats_history(fp)
+        assert [h["cache_hit"] for h in hist] == [False, True]
+        assert all(h["rows"] == 3 for h in hist)
+        assert all(h["wall_s"] >= 0 for h in hist)
+        assert fp in ctx.stats_history()
+
+    def test_instrumented_run_records_operators(self):
+        ctx, _src = _counting_ctx()
+        ctx.sql("EXPLAIN ANALYZE " + SQL)
+        fp = ctx.last_fingerprint
+        hist = ctx.stats_history(fp)
+        assert hist and "operators" in hist[0]
+        ops = [o["op"] for o in hist[0]["operators"]]
+        assert any("Aggregate" in o for o in ops)
+
+    def test_history_bounded(self):
+        ctx, _src = _counting_ctx()
+        ctx._history_cap = 4
+        for _ in range(8):
+            _rows(ctx)
+        assert len(ctx.stats_history(ctx.last_fingerprint)) == 4
+
+
+# -- worker fragment cache (distributed) ----------------------------------
+
+
+def _write_partitions(tmp_path, n_parts=2, rows_per=200):
+    rng = np.random.default_rng(7)
+    regions = ["north", "south", "east", "west"]
+    paths = []
+    for p in range(n_parts):
+        path = tmp_path / f"part{p}.csv"
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("region,v\n")
+            for _ in range(rows_per):
+                f.write(f"{regions[rng.integers(0, 4)]},"
+                        f"{int(rng.integers(-1000, 1000))}\n")
+        paths.append(str(path))
+    return paths
+
+
+DSCHEMA = Schema([
+    Field("region", DataType.UTF8, False),
+    Field("v", DataType.INT64, False),
+])
+DSQL = ("SELECT region, SUM(v), COUNT(1), MIN(v), MAX(v) "
+        "FROM t GROUP BY region")
+
+
+def _spawn_worker(fault_plan=None, extra_env=None):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    if fault_plan is not None:
+        env["DATAFUSION_TPU_FAULTS"] = json.dumps(fault_plan)
+    env.update(extra_env or {})
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "datafusion_tpu.worker",
+         "--bind", "127.0.0.1:0", "--device", "cpu"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on" in line, line
+    host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+    return proc, (host, int(port))
+
+
+def _register_parts(ctx, paths):
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+    ctx.register_datasource(
+        "t",
+        PartitionedDataSource(
+            [CsvDataSource(p, DSCHEMA, True, 131072) for p in paths]
+        ),
+    )
+    return ctx
+
+
+def _frag_hits() -> int:
+    return METRICS.snapshot()["counts"].get("coord.fragment_cache_hits", 0)
+
+
+class TestWorkerFragmentCache:
+    def test_replayed_fragment_served_from_cache(self, tmp_path):
+        """Lost-response failover: the worker already executed the
+        fragment; the replay (and the repeat query) must be served from
+        its fragment cache — the cache-hit flag observed at merge."""
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+        from datafusion_tpu.testing import faults
+
+        paths = _write_partitions(tmp_path)
+        want = sorted(
+            collect(
+                _register_parts(ExecutionContext(device="cpu"), paths).sql(DSQL)
+            ).to_rows()
+        )
+        proc, addr = _spawn_worker()
+        try:
+            dctx = _register_parts(
+                DistributedContext([addr], result_cache=False), paths
+            )
+            base = _frag_hits()
+            assert sorted(collect(dctx.sql(DSQL)).to_rows()) == want
+            assert _frag_hits() == base  # cold run: no cached serves
+            # drop the first fragment response at the coordinator: the
+            # worker is marked down, re-probed, and the replay must be
+            # answered from its fragment cache (no partition re-scan)
+            with faults.scoped({"rules": [
+                {"site": "wire.recv", "op": "raise",
+                 "exc": "ConnectionResetError", "after": 1, "count": 1},
+            ]}) as plan:
+                assert sorted(collect(dctx.sql(DSQL)).to_rows()) == want
+                assert plan.snapshot()[0]["fired"] == 1
+            assert _frag_hits() - base >= 2
+            snap = METRICS.snapshot()["counts"]
+            assert snap.get("coord.fragment_reassigned", 0) >= 1
+            status = dctx.worker_status()[f"{addr[0]}:{addr[1]}"]
+            frag_stats = status["cache"]["fragment"]
+            assert frag_stats["hits"] >= 2
+            # satellite: one status scrape carries the Prometheus text
+            # with counter lines and the cache/span-buffer gauges
+            prom = status["prometheus"]
+            assert "datafusion_tpu_events_total" in prom
+            assert "cache_fragment_bytes" in prom
+            assert "obs_span_buffer_depth" in prom
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_chaos_kill_served_from_surviving_cache(self, tmp_path):
+        """Worker death chaos: with a kill rule armed on the next real
+        fragment execution, the repeat query must complete with at
+        least one fragment served from a fragment cache — either the
+        crashy worker answers from memory (no execution, no kill), or
+        it dies mid-fragment and the survivor serves the replay from
+        its own cache."""
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+
+        paths = _write_partitions(tmp_path)
+        want = sorted(
+            collect(
+                _register_parts(ExecutionContext(device="cpu"), paths).sql(DSQL)
+            ).to_rows()
+        )
+        crashy, crashy_addr = _spawn_worker(fault_plan={"rules": [
+            {"site": "worker.fragment", "op": "kill", "after": 2},
+        ]})
+        healthy, healthy_addr = _spawn_worker()
+        try:
+            dctx = _register_parts(
+                DistributedContext([crashy_addr, healthy_addr],
+                                   result_cache=False),
+                paths,
+            )
+            base = _frag_hits()
+            # q1: both workers execute one fragment each (kill arms at
+            # the crashy worker's SECOND execution)
+            assert sorted(collect(dctx.sql(DSQL)).to_rows()) == want
+            # q2: every fragment is already cached on SOME worker; a
+            # kill (if it fires) hits a fragment the survivor has
+            assert sorted(collect(dctx.sql(DSQL)).to_rows()) == want
+            assert _frag_hits() - base >= 1
+            if crashy.poll() is not None:
+                assert crashy.returncode == 17  # died by injected kill
+                assert not dctx.workers[0].alive
+        finally:
+            for p in (crashy, healthy):
+                if p.poll() is None:
+                    p.terminate()
+            for p in (crashy, healthy):
+                p.wait(timeout=10)
+
+    def test_coordinator_result_cache_skips_dispatch(self, tmp_path):
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+
+        paths = _write_partitions(tmp_path)
+        proc, addr = _spawn_worker()
+        try:
+            dctx = _register_parts(DistributedContext([addr]), paths)
+            want = sorted(collect(dctx.sql(DSQL)).to_rows())
+            key = f"{addr[0]}:{addr[1]}"
+            q_before = dctx.worker_status()[key]["queries"]
+            rel = dctx.sql(DSQL)
+            assert isinstance(rel, CachedResultRelation)
+            assert sorted(collect(rel).to_rows()) == want
+            assert dctx.worker_status()[key]["queries"] == q_before
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_fragment_cache_off_in_worker(self, tmp_path):
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+
+        paths = _write_partitions(tmp_path)
+        proc, addr = _spawn_worker(extra_env={"DATAFUSION_TPU_CACHE": "0"})
+        try:
+            dctx = _register_parts(
+                DistributedContext([addr], result_cache=False), paths
+            )
+            base = _frag_hits()
+            a = sorted(collect(dctx.sql(DSQL)).to_rows())
+            b = sorted(collect(dctx.sql(DSQL)).to_rows())
+            assert a == b
+            assert _frag_hits() == base  # nothing served from cache
+            status = dctx.worker_status()[f"{addr[0]}:{addr[1]}"]
+            assert status["cache"]["fragment"] is None
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+    def test_rows_fragments_cached_too(self, tmp_path):
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+
+        paths = _write_partitions(tmp_path)
+        sql = "SELECT region, v FROM t WHERE v > 0"
+        want = sorted(
+            collect(
+                _register_parts(ExecutionContext(device="cpu"), paths).sql(sql)
+            ).to_rows()
+        )
+        proc, addr = _spawn_worker()
+        try:
+            dctx = _register_parts(
+                DistributedContext([addr], result_cache=False), paths
+            )
+            base = _frag_hits()
+            assert sorted(collect(dctx.sql(sql)).to_rows()) == want
+            assert sorted(collect(dctx.sql(sql)).to_rows()) == want
+            assert _frag_hits() - base >= 2
+        finally:
+            proc.terminate()
+            proc.wait(timeout=10)
+
+
+# -- background trace flusher ---------------------------------------------
+
+
+class TestTraceFlusher:
+    def test_flusher_appends_span_jsonl(self, tmp_path):
+        from datafusion_tpu.obs import trace
+
+        path = str(tmp_path / "spans.jsonl")
+        assert trace.start_flusher(path, interval_s=0.02)
+        try:
+            with trace.session():
+                with trace.span("flush.me", n=1):
+                    pass
+                with trace.span("flush.me.too"):
+                    pass
+            deadline = time.monotonic() + 5
+            names: set = set()
+            while time.monotonic() < deadline and not (
+                {"flush.me", "flush.me.too"} <= names
+            ):
+                time.sleep(0.03)
+                if os.path.exists(path):
+                    with open(path, "r", encoding="utf-8") as f:
+                        names = {json.loads(ln)["name"] for ln in f if ln.strip()}
+            assert {"flush.me", "flush.me.too"} <= names
+        finally:
+            trace.stop_flusher(flush=False)
+
+    def test_stop_flushes_to_started_path(self, tmp_path):
+        # stop_flusher must flush to the path start_flusher was given
+        # (not only the env var), and a stopped flusher must leave the
+        # file JSONL — earlier flushed spans survive
+        from datafusion_tpu.obs import trace
+
+        path = str(tmp_path / "tail.jsonl")
+        assert trace.start_flusher(path, interval_s=60)  # never ticks
+        try:
+            with trace.session():
+                with trace.span("tail.span"):
+                    pass
+        finally:
+            trace.stop_flusher(flush=True)
+        with open(path, "r", encoding="utf-8") as f:
+            names = [json.loads(ln)["name"] for ln in f if ln.strip()]
+        assert "tail.span" in names
+
+    def test_stop_is_idempotent(self):
+        from datafusion_tpu.obs import trace
+
+        trace.stop_flusher(flush=False)
+        trace.stop_flusher(flush=False)

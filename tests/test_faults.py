@@ -634,7 +634,13 @@ class TestDistributedChaos:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
         victim, victim_addr = _spawn_worker(bind=f"127.0.0.1:{port}")
-        dctx = _register(DistributedContext([victim_addr, *addrs]), paths)
+        # result_cache=False: this test re-runs the SAME query to assert
+        # failover/readmission mechanics — a coordinator result-cache
+        # hit would answer without dispatching anything
+        dctx = _register(
+            DistributedContext([victim_addr, *addrs], result_cache=False),
+            paths,
+        )
         want = _local_want(paths)
         try:
             assert _rows(dctx) == want
