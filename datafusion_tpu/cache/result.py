@@ -27,17 +27,22 @@ from datafusion_tpu.utils.metrics import METRICS
 
 
 class CachedResult:
-    """One query's materialized result, as stored in the cache."""
+    """One query's materialized result, as stored in the cache.
+    `shared` marks snapshots that arrived via the cluster's shared
+    result tier (cluster/shared_cache.py) rather than a local fill —
+    surfaced in EXPLAIN ANALYZE and used to suppress re-publication."""
 
-    __slots__ = ("columns", "validity", "dict_values", "num_rows", "nbytes")
+    __slots__ = ("columns", "validity", "dict_values", "num_rows", "nbytes",
+                 "shared")
 
     def __init__(self, columns, validity, dict_values, num_rows: int,
-                 nbytes: int):
+                 nbytes: int, shared: bool = False):
         self.columns = columns
         self.validity = validity
         self.dict_values = dict_values
         self.num_rows = num_rows
         self.nbytes = nbytes
+        self.shared = shared
 
 
 def _snapshot_nbytes(columns, validity, dicts) -> int:
@@ -91,19 +96,28 @@ from datafusion_tpu.exec.relation import Relation
 
 
 class CachedResultRelation(Relation):
-    """Relation replaying a cached result as one host batch.
+    """Relation replaying a cached result as bucketed host batches.
 
     Shows up in EXPLAIN ANALYZE as `CachedResult[...]` with
-    `cache.hit=True` / `cache.bytes=...` operator attributes; pulling
-    its batches touches no datasource, worker, or device.
+    `cache.hit=True` / `cache.bytes=...` operator attributes (plus
+    `cache.shared=True` for shared-tier snapshots); pulling its batches
+    touches no datasource, worker, or device.
+
+    Replay is chunked: rows stream out in `batch_size`-row batches
+    instead of one concatenated batch, so a large cached result's peak
+    working set during replay is one bucket's padding plus the consumer
+    side, and consumers that stream (the CLI printing rows) start
+    producing output before the whole result is re-assembled.  Slices
+    view the cached columns — chunking copies nothing.
     """
 
     def __init__(self, schema, entry: CachedResult, fingerprint: str,
-                 on_complete=None):
+                 on_complete=None, batch_size: Optional[int] = None):
         self._schema = schema
         self.entry = entry
         self.fingerprint = fingerprint
         self._on_complete = on_complete
+        self._batch_size = batch_size
         self._op_stats = None
 
     @property
@@ -121,6 +135,8 @@ class CachedResultRelation(Relation):
                 "cache.hit": True,
                 "cache.bytes": self.entry.nbytes,
             })
+            if self.entry.shared:
+                st.attrs["cache.shared"] = True
         return st
 
     def op_name(self) -> str:
@@ -152,9 +168,19 @@ class CachedResultRelation(Relation):
                 d.values = list(vals)
                 d.index = {s: i for i, s in enumerate(vals)}
                 dicts.append(d)
-            yield make_host_batch(
-                self._schema, list(entry.columns), list(entry.validity), dicts
-            )
+            step = self._batch_size or entry.num_rows
+            n_batches = 0
+            for off in range(0, entry.num_rows, step):
+                yield make_host_batch(
+                    self._schema,
+                    [c[off:off + step] for c in entry.columns],
+                    [None if v is None else v[off:off + step]
+                     for v in entry.validity],
+                    dicts,
+                )
+                n_batches += 1
+            if self._op_stats is not None and n_batches > 1:
+                self._op_stats.attrs["cache.batches"] = n_batches
         if self._on_complete is not None:
             self._on_complete({
                 "rows": entry.num_rows,

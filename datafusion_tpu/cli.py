@@ -114,7 +114,59 @@ class Console:
                         f"last {runs[-1]['wall_s'] * 1e3:.1f} ms"
                     )
             return True
+        if cmd == "\\cluster":
+            # cluster control plane introspection (datafusion_tpu/cluster):
+            # membership epoch, live workers + lease ages, shared tier
+            self._cluster_status()
+            return True
         return False
+
+    def _cluster_status(self) -> None:
+        import os
+
+        client = getattr(self.ctx, "cluster", None)
+        target = os.environ.get("DATAFUSION_TPU_CLUSTER")
+        if client is None and not target:
+            self._print(
+                "Cluster mode is off (no DATAFUSION_TPU_CLUSTER and the "
+                "context has no cluster client)."
+            )
+            return
+        from datafusion_tpu.errors import ExecutionError
+
+        try:
+            if client is None:
+                from datafusion_tpu.cluster import connect
+
+                client = connect(target)
+            status = client.status()
+        except (ConnectionError, OSError, ExecutionError) as e:
+            # ExecutionError covers an error *reply* from the service —
+            # the console must report it, not die on it
+            self._print(f"Cluster service unreachable: {e}")
+            return
+        self._print(
+            f"Cluster epoch {status['epoch']} (rev {status['rev']}), "
+            f"{len(status['workers'])} live worker(s), "
+            f"service up {status['uptime_s']}s"
+        )
+        for addr, info in sorted(status["workers"].items()):
+            self._print(
+                f"  worker {addr}: lease age {info.get('lease_age_s')}s"
+            )
+        r = status["results"]
+        self._print(
+            f"Shared result tier: {r['entries']} entries, "
+            f"{r['bytes']}/{r['max_bytes']} bytes — {r['hits']} hits, "
+            f"{r['misses']} misses, {r['invalidations']} invalidations"
+        )
+        membership = getattr(self.ctx, "membership", None)
+        if membership is not None:
+            lag = membership.watch_lag_s
+            self._print(
+                f"This coordinator: epoch {membership.epoch}, watch lag "
+                f"{'never refreshed' if lag is None else f'{lag:.3f}s'}"
+            )
 
     def execute(self, sql: str) -> None:
         sql = sql.strip().rstrip(";").strip()

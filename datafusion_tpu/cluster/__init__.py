@@ -1,0 +1,88 @@
+"""Cluster control plane: shared membership, lease KV, cache coherence.
+
+The reference scaffolded an etcd-based distributed mode — membership
+and worker discovery wired into `scripts/smoketest.sh:30-66` and named
+in `README.md:33-35` — then commented it out because distributed mode
+never worked.  This package is a lightweight, TPU-native realization of
+that intent over the engine's own versioned wire protocol (CRC'd
+frames, `parallel/wire.py`): one small `ClusterStateService` holds a
+lease-based KV that three concerns ride together ("namespaces on one
+bus"):
+
+- ``workers/<addr>``        worker membership.  A worker registers its
+  address under a TTL lease and refreshes it from a heartbeat thread;
+  a lease that lapses drops the key and bumps the membership *epoch*.
+  Coordinators subscribe through a `MembershipView` instead of each
+  privately probing every worker (`cluster/membership.py`).
+- ``cache/invalidate/*``    coordinator-driven fragment-cache
+  invalidation broadcast.  Events append to a revision-numbered log;
+  workers pick them up piggybacked on their next lease refresh (one
+  round trip refreshes the lease AND returns pending events) and drop
+  the tagged fragment-cache entries without waiting for TTL expiry.
+- ``cache/result/*``        a shared result-cache tier keyed by the
+  existing plan fingerprint (`cache/fingerprint.py`), so a fleet of
+  coordinators behind a load balancer gets warm hits from each other's
+  queries (`cluster/shared_cache.py` plugs it into `CacheStore` as a
+  read-through/write-behind tier).
+
+Deployment shapes: in-process (`ClusterState` + `LocalClusterClient` —
+tests, single-binary demos) or standalone TCP service
+(``python -m datafusion_tpu.cluster --bind host:port``) that workers
+and coordinators dial with `ClusterClient`.
+
+Env knobs (all off by default = zero overhead, zero new threads or
+sockets; existing single-coordinator paths are byte-identical):
+
+    DATAFUSION_TPU_CLUSTER            service address host:port; set on
+                                      coordinators AND workers
+    DATAFUSION_TPU_CLUSTER_TTL_S      worker lease TTL (default 10)
+    DATAFUSION_TPU_CLUSTER_CACHE_BYTES  shared result tier byte budget
+                                      (default 256 MiB)
+
+Fault sites (`testing/faults.py`): ``cluster.request`` (service
+partition), ``cluster.lease.refresh`` (lease expiry), ``cluster.watch``
+(stale membership view).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from datafusion_tpu.cluster.client import (  # noqa: F401 — subsystem API
+    ClusterClient,
+    LocalClusterClient,
+)
+from datafusion_tpu.cluster.service import (  # noqa: F401
+    ClusterState,
+    ClusterStateService,
+    serve,
+)
+
+DEFAULT_LEASE_TTL_S = 10.0
+DEFAULT_CACHE_BYTES = 256 << 20
+
+
+def cluster_address() -> Optional[str]:
+    """The env-configured service address, or None (cluster mode off)."""
+    return os.environ.get("DATAFUSION_TPU_CLUSTER") or None
+
+
+def lease_ttl_s() -> float:
+    env = os.environ.get("DATAFUSION_TPU_CLUSTER_TTL_S", "")
+    return float(env) if env else DEFAULT_LEASE_TTL_S
+
+
+def connect(target):
+    """A client for `target`: a "host:port" string dials the TCP
+    service, a `ClusterState` wraps in-process, an existing client
+    passes through — so every cluster-aware constructor takes one
+    `cluster=` argument regardless of deployment shape."""
+    if isinstance(target, (ClusterClient, LocalClusterClient)):
+        return target
+    if isinstance(target, ClusterState):
+        return LocalClusterClient(target)
+    if isinstance(target, str):
+        host, _, port = target.partition(":")
+        return ClusterClient(host or "127.0.0.1", int(port))
+    raise TypeError(f"cannot connect to cluster target {target!r}")

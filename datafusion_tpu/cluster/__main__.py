@@ -1,0 +1,10 @@
+"""``python -m datafusion_tpu.cluster`` — run the standalone cluster
+state service (lease KV + membership + shared result tier).  See
+cluster/service.py."""
+
+import sys
+
+from datafusion_tpu.cluster.service import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,183 @@
+"""Worker-side cluster agent: lease registration + invalidation apply.
+
+A worker in cluster mode registers ``workers/<addr>`` under a TTL lease
+and keeps it alive from a heartbeat thread.  The refresh is ONE round
+trip that renews the lease AND returns the event-log tail — the
+invalidation broadcast piggybacks on the heartbeat exactly as the cache
+PR's ROADMAP note proposed ("piggybacked on heartbeat pings"), so a
+coordinator-driven ``invalidate(table)`` drops this worker's tagged
+fragment-cache entries within one refresh interval, far sooner than
+TTL/file-version aging would.
+
+Failure behavior: a refresh that finds its lease gone (the service
+restarted, or injected lease expiry via the ``cluster.lease.refresh``
+fault site) re-registers from scratch — the membership epoch records
+the leave/join pair, and the agent clears the fragment cache first
+because it may have missed invalidation events while deregistered
+(the event log is only guaranteed to cover a held lease).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.metrics import METRICS
+
+
+class WorkerClusterAgent:
+    """Keeps one worker registered in the cluster and applies broadcast
+    invalidations to its fragment cache.  `poll_once()` runs one
+    heartbeat synchronously — tests drive it deterministically without
+    the thread."""
+
+    def __init__(self, client, addr: str, worker_state,
+                 ttl_s: Optional[float] = None,
+                 refresh_s: Optional[float] = None):
+        from datafusion_tpu import cluster as _cluster
+
+        self.client = client
+        self.addr = addr
+        self.worker_state = worker_state
+        self.ttl_s = ttl_s if ttl_s is not None else _cluster.lease_ttl_s()
+        # 3 refresh chances per TTL: one lost heartbeat never expires us
+        self.refresh_s = refresh_s if refresh_s is not None else self.ttl_s / 3.0
+        self.lease: Optional[str] = None
+        self.last_rev = 0
+        self.epoch = -1
+        self.events_applied = 0
+        self.reregistrations = 0
+        self._lease_refreshed: Optional[float] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration / heartbeat --
+    def register(self) -> None:
+        granted = self.client.lease_grant(self.ttl_s)
+        self.lease = granted["lease"]
+        # resume the event log from the grant: events before this worker
+        # held a lease concern caches it does not have
+        self.last_rev = granted.get("rev", 0)
+        self.client.put(
+            f"workers/{self.addr}",
+            {"addr": self.addr, "pid": os.getpid(),
+             "batch_size": self.worker_state.batch_size},
+            lease=self.lease,
+        )
+        self._lease_refreshed = time.monotonic()
+        METRICS.add("worker.cluster_registered")
+
+    def poll_once(self) -> None:
+        """One heartbeat: refresh the lease, apply any broadcast events
+        that arrived since the last one.  Raises on a partitioned
+        service (the loop counts and retries next cycle)."""
+        faults.check("cluster.lease.refresh", addr=self.addr)
+        if self.lease is None:
+            self.register()
+        resp = self.client.lease_refresh(self.lease, since=self.last_rev)
+        if not resp.get("found"):
+            # lease lapsed out from under us (expiry, service restart):
+            # we may have missed invalidations, so the cache is suspect
+            self.reregistrations += 1
+            METRICS.add("worker.cluster_reregistered")
+            cache = self.worker_state.fragment_cache
+            if cache is not None:
+                cache.clear()
+            self.register()
+            resp = self.client.lease_refresh(self.lease, since=self.last_rev)
+        self._lease_refreshed = time.monotonic()
+        self.epoch = resp.get("epoch", self.epoch)
+        if resp.get("truncated"):
+            # fell off the retained event window: same cache-suspect
+            # resync as a lapsed lease
+            cache = self.worker_state.fragment_cache
+            if cache is not None:
+                cache.clear()
+            METRICS.add("worker.cluster_event_log_truncated")
+        for ev in resp.get("events", ()):
+            self._apply(ev)
+        self.last_rev = resp.get("rev", self.last_rev)
+
+    def _apply(self, event: dict) -> None:
+        if event.get("kind") != "invalidate":
+            return  # join/leave events are membership bookkeeping
+        self.events_applied += 1
+        cache = self.worker_state.fragment_cache
+        if cache is None:
+            return
+        dropped = cache.invalidate_tag(str(event.get("table", "")))
+        if dropped:
+            METRICS.add("worker.cluster_invalidations_applied", dropped)
+
+    # -- lifecycle --
+    def _loop(self) -> None:
+        while not self._stop.wait(self.refresh_s):
+            try:
+                self.poll_once()
+            except (ConnectionError, OSError, ExecutionError):
+                METRICS.add("worker.cluster_refresh_errors")
+            except Exception:  # noqa: BLE001 — the heartbeat must outlive surprises
+                METRICS.add("worker.cluster_refresh_errors")
+
+    def start(self) -> "WorkerClusterAgent":
+        try:
+            self.poll_once()  # register before serving, not a cycle later
+        except (ConnectionError, OSError, ExecutionError):
+            METRICS.add("worker.cluster_refresh_errors")
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="df-tpu-cluster-agent", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def close(self) -> None:
+        """Clean shutdown: stop the heartbeat and revoke the lease so
+        the membership epoch moves now, not a TTL later."""
+        self.stop()
+        if self.lease is not None:
+            try:
+                self.client.lease_revoke(self.lease)
+            except (ConnectionError, OSError, ExecutionError):
+                pass  # the TTL will collect us
+            self.lease = None
+
+    # -- introspection --
+    @property
+    def lease_age_s(self) -> Optional[float]:
+        if self._lease_refreshed is None:
+            return None
+        return time.monotonic() - self._lease_refreshed
+
+    def gauges(self) -> dict:
+        age = self.lease_age_s
+        return {
+            "cluster.lease_age_s": round(age, 3) if age is not None else -1,
+            "cluster.lease_ttl_s": self.ttl_s,
+            "cluster.epoch": self.epoch,
+            "cluster.events_applied": self.events_applied,
+        }
+
+    def snapshot(self) -> dict:
+        """Status-endpoint block (worker `{"type": "status"}`)."""
+        age = self.lease_age_s
+        return {
+            "addr": self.addr,
+            "registered": self.lease is not None,
+            "lease_ttl_s": self.ttl_s,
+            "lease_age_s": round(age, 3) if age is not None else None,
+            "epoch": self.epoch,
+            "events_applied": self.events_applied,
+            "reregistrations": self.reregistrations,
+        }

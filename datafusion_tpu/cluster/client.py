@@ -1,0 +1,151 @@
+"""Cluster service clients: TCP (`ClusterClient`) and in-process
+(`LocalClusterClient`).
+
+Both expose the same typed surface over the same request dicts —
+`LocalClusterClient` routes them through `service.handle_request`
+directly, so in-process tests exercise the exact wire semantics minus
+the sockets.  The TCP client mirrors `WorkerHandle`'s discipline: one
+connection per request (the control plane is low-rate; no pooled
+sockets to leak), the `wire_version` CRC handshake, and a bounded
+connect timeout so a partitioned service surfaces as `ConnectionError`
+instead of a hang.
+
+The fault site ``cluster.request`` fires per request with the request
+type as context — a chaos rule raising `ConnectionRefusedError` at
+``{"where": {"op": "membership"}}`` simulates a service partition for
+exactly the membership path.
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Any, Optional
+
+from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.obs import trace as obs_trace
+from datafusion_tpu.testing import faults
+
+
+class _ClientApi:
+    """Typed helpers shared by both transports; subclasses implement
+    `request(msg) -> dict`."""
+
+    def request(self, msg: dict) -> dict:  # pragma: no cover — interface
+        raise NotImplementedError
+
+    def ping(self) -> bool:
+        try:
+            return self.request({"type": "ping"})["type"] == "pong"
+        except (ConnectionError, OSError, ExecutionError):
+            return False
+
+    def lease_grant(self, ttl_s: float) -> dict:
+        return self.request({"type": "lease_grant", "ttl_s": ttl_s})
+
+    def lease_refresh(self, lease: str, since: Optional[int] = None) -> dict:
+        msg: dict = {"type": "lease_refresh", "lease": lease}
+        if since is not None:
+            msg["since"] = since
+        return self.request(msg)
+
+    def lease_revoke(self, lease: str) -> bool:
+        return bool(self.request({"type": "lease_revoke", "lease": lease}).get("found"))
+
+    def put(self, key: str, value: Any, lease: Optional[str] = None) -> int:
+        return self.request(
+            {"type": "kv_put", "key": key, "value": value, "lease": lease}
+        )["rev"]
+
+    def get(self, key: str) -> Optional[Any]:
+        out = self.request({"type": "kv_get", "key": key})
+        return out.get("value") if out.get("found") else None
+
+    def delete(self, key: str) -> bool:
+        return bool(self.request({"type": "kv_delete", "key": key}).get("found"))
+
+    def range(self, prefix: str) -> dict:
+        return self.request({"type": "kv_range", "prefix": prefix})["items"]
+
+    def membership(self) -> dict:
+        return self.request({"type": "membership"})
+
+    def events_since(self, since: int) -> dict:
+        return self.request({"type": "events", "since": since})
+
+    def invalidate(self, table: str) -> dict:
+        return self.request({"type": "invalidate", "table": table})
+
+    def result_put(self, key: str, value: dict, nbytes: int,
+                   tables: tuple = ()) -> bool:
+        return bool(self.request({
+            "type": "result_put", "key": key, "value": value,
+            "nbytes": nbytes, "tables": list(tables),
+        }).get("stored"))
+
+    def result_get(self, key: str) -> dict:
+        return self.request({"type": "result_get", "key": key})
+
+    def status(self) -> dict:
+        return self.request({"type": "status"})
+
+
+class LocalClusterClient(_ClientApi):
+    """In-process client over a shared `ClusterState` — the deployment
+    shape for tests and single-binary demos (several coordinators and
+    embedded workers sharing one state object)."""
+
+    def __init__(self, state):
+        self.state = state
+
+    def __repr__(self):
+        return f"LocalClusterClient({self.state!r})"
+
+    def request(self, msg: dict) -> dict:
+        from datafusion_tpu.cluster.service import handle_request
+
+        faults.check("cluster.request", op=msg.get("type"))
+        out = handle_request(self.state, msg)
+        if out.get("type") == "error":
+            raise ExecutionError(f"cluster service: {out['message']}")
+        return out
+
+
+class ClusterClient(_ClientApi):
+    """TCP client for a standalone `ClusterStateService`."""
+
+    def __init__(self, host: str, port: int,
+                 request_timeout: Optional[float] = 10.0):
+        self.host = host
+        self.port = port
+        self.request_timeout = request_timeout
+
+    def __repr__(self):
+        return f"ClusterClient({self.host}:{self.port})"
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def request(self, msg: dict) -> dict:
+        from datafusion_tpu.parallel.wire import (
+            CRC_ENABLED,
+            WIRE_VERSION,
+            recv_msg,
+            send_msg,
+        )
+
+        faults.check("cluster.request", op=msg.get("type"))
+        if CRC_ENABLED and "wire_version" not in msg:
+            msg = {**msg, "wire_version": WIRE_VERSION}
+        with obs_trace.span("cluster.request", op=msg.get("type")):
+            with socket.create_connection(
+                (self.host, self.port), timeout=5.0
+            ) as s:
+                s.settimeout(self.request_timeout)
+                send_msg(s, msg)
+                out = recv_msg(s)
+        if out is None:
+            raise ConnectionError("cluster service closed the connection")
+        if out.get("type") == "error":
+            raise ExecutionError(f"cluster service: {out['message']}")
+        return out

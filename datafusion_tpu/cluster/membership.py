@@ -1,0 +1,100 @@
+"""Coordinator-side membership subscription.
+
+`MembershipView` replaces the per-coordinator probe loop as the source
+of worker liveness when cluster mode is on: instead of every
+coordinator privately pinging every worker (N_coordinators x N_workers
+probe traffic, and each coordinator re-learning liveness alone), each
+`refresh()` is ONE request to the cluster service returning the epoch
+plus the live worker set — the view all coordinators share.  The
+`HeartbeatMonitor` consumes it in place of its probe cycle
+(`parallel/coordinator.py`); dispatch's last-gasp re-probe is
+unaffected (a coordinator facing an all-dead view still probes workers
+directly before failing a query).
+
+A refresh that cannot reach the service keeps the last view (stale
+liveness beats no liveness) and the staleness is observable: the
+``cluster.watch_lag_s`` gauge is the age of the last successful
+refresh.  The fault site ``cluster.watch`` makes stale-view handling
+testable on demand.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.obs import trace as obs_trace
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.metrics import METRICS
+
+
+class MembershipView:
+    """A coordinator's subscription to the shared worker membership."""
+
+    def __init__(self, client):
+        self.client = client
+        self.epoch = -1  # -1 = never refreshed
+        self.rev = 0
+        self.workers: dict[str, dict] = {}  # addr -> info (lease_age_s, ...)
+        self._lock = threading.Lock()
+        self._last_refresh: Optional[float] = None
+        self.refresh_errors = 0
+
+    def refresh(self) -> "MembershipView":
+        """Pull the current view from the service.  Raises
+        ConnectionError/OSError when the service is unreachable — the
+        caller decides whether stale is acceptable (`poll` swallows)."""
+        faults.check("cluster.watch", epoch=self.epoch)
+        with obs_trace.span("cluster.watch", epoch=self.epoch):
+            out = self.client.membership()
+        with self._lock:
+            if out["epoch"] != self.epoch:
+                METRICS.add("coord.membership_epoch_changes")
+            self.epoch = out["epoch"]
+            self.rev = out.get("rev", self.rev)
+            self.workers = out.get("workers", {})
+            self._last_refresh = time.monotonic()
+        return self
+
+    def poll(self) -> bool:
+        """`refresh()` that tolerates a partitioned service: keeps the
+        last view and returns False instead of raising."""
+        try:
+            self.refresh()
+            return True
+        except (ConnectionError, OSError, ExecutionError):
+            with self._lock:
+                self.refresh_errors += 1
+            METRICS.add("coord.membership_refresh_errors")
+            return False
+
+    def live_addresses(self) -> set[str]:
+        with self._lock:
+            return set(self.workers)
+
+    @property
+    def watch_lag_s(self) -> Optional[float]:
+        """Seconds since the last successful refresh (None = never)."""
+        with self._lock:
+            if self._last_refresh is None:
+                return None
+            return time.monotonic() - self._last_refresh
+
+    def gauges(self) -> dict:
+        """Prometheus gauges for `prometheus_text(extra_gauges=...)`."""
+        lag = self.watch_lag_s
+        with self._lock:
+            return {
+                "cluster.epoch": self.epoch,
+                "cluster.workers_live": len(self.workers),
+                "cluster.watch_lag_s": round(lag, 3) if lag is not None else -1,
+                "cluster.watch_errors": self.refresh_errors,
+            }
+
+    def __repr__(self):
+        return (
+            f"MembershipView(epoch={self.epoch}, "
+            f"workers={sorted(self.workers)})"
+        )

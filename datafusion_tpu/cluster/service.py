@@ -1,0 +1,431 @@
+"""The cluster state service: a lease-based KV with a membership epoch.
+
+`ClusterState` is the pure, thread-safe state machine (run it in-process
+for tests); `ClusterStateService` serves it over TCP reusing the
+engine's versioned wire protocol (`parallel/wire.py` length-prefixed
+frames — requests advertise `wire_version` and corrupt frames raise
+`ProtocolError`, exactly like the fragment protocol).
+
+Semantics (the useful subset of etcd's):
+
+- **Leases**: `lease_grant(ttl_s)` mints an id; keys put with a lease
+  die with it.  `lease_refresh` renews AND returns the event-log tail
+  plus the current epoch in the same round trip — a worker's heartbeat
+  is one request, not three.  Expiry is lazy: every public operation
+  first sweeps lapsed leases, so no timer thread is needed and a
+  single-threaded test can step time deterministically.
+- **Epoch**: a counter bumped by every membership change (a
+  ``workers/*`` key appearing or disappearing).  Two coordinators that
+  observe the same epoch observed the same worker set.
+- **Event log**: revision-numbered, bounded; carries membership changes
+  and ``cache/invalidate`` broadcasts.  Consumers poll with their last
+  seen revision (`events_since`); a consumer that fell off the retained
+  window gets `truncated=True` and should resync from scratch.
+- **Result tier**: ``cache/result/<fingerprint>`` entries live in a
+  byte-accounted `CacheStore` (LRU+TTL, tagged by table name) holding
+  wire-encoded snapshots — `invalidate(table)` drops dependent results
+  here and broadcasts the fragment-cache invalidation to workers.
+"""
+
+from __future__ import annotations
+
+import os
+import socketserver
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from datafusion_tpu.cache.store import CacheStore
+from datafusion_tpu.utils.metrics import METRICS
+
+_EVENT_LOG_CAP = 1024
+
+
+class _Lease:
+    __slots__ = ("lease_id", "ttl_s", "expires", "keys")
+
+    def __init__(self, lease_id: str, ttl_s: float, now: float):
+        self.lease_id = lease_id
+        self.ttl_s = ttl_s
+        self.expires = now + ttl_s
+        self.keys: set[str] = set()
+
+
+class _Key:
+    __slots__ = ("value", "lease", "rev", "refreshed")
+
+    def __init__(self, value: Any, lease: Optional[str], rev: int, now: float):
+        self.value = value
+        self.lease = lease
+        self.rev = rev
+        self.refreshed = now  # last lease refresh covering this key
+
+
+class ClusterState:
+    """The control-plane state machine.  All public methods are
+    thread-safe; time is injectable (`now`) so tests drive lease expiry
+    without sleeping."""
+
+    def __init__(self, result_cache_bytes: Optional[int] = None,
+                 result_ttl_s: Optional[float] = None):
+        if result_cache_bytes is None:
+            env = os.environ.get("DATAFUSION_TPU_CLUSTER_CACHE_BYTES", "")
+            from datafusion_tpu.cluster import DEFAULT_CACHE_BYTES
+
+            result_cache_bytes = int(env) if env else DEFAULT_CACHE_BYTES
+        self._lock = threading.Lock()
+        self._kv: dict[str, _Key] = {}
+        self._leases: dict[str, _Lease] = {}
+        self._epoch = 0
+        self._rev = 0
+        self._events: list[dict] = []
+        self._events_floor = 0  # oldest revision still in the log
+        self.started = time.time()
+        # the shared result tier: wire-encoded snapshots, tagged by the
+        # tables they scanned so invalidate(table) drops exactly them
+        self.results = CacheStore(
+            result_cache_bytes, result_ttl_s, name="cluster_result"
+        )
+
+    # -- internals (lock held) --
+    def _next_rev(self) -> int:
+        self._rev += 1
+        return self._rev
+
+    def _append_event(self, kind: str, **payload) -> int:
+        rev = self._next_rev()
+        self._events.append({"rev": rev, "kind": kind, **payload})
+        if len(self._events) > _EVENT_LOG_CAP:
+            del self._events[0]
+        if self._events:
+            self._events_floor = self._events[0]["rev"]
+        return rev
+
+    def _is_member_key(self, key: str) -> bool:
+        return key.startswith("workers/")
+
+    def _drop_key(self, key: str, reason: str) -> None:
+        entry = self._kv.pop(key, None)
+        if entry is None:
+            return
+        if entry.lease is not None:
+            lease = self._leases.get(entry.lease)
+            if lease is not None:
+                lease.keys.discard(key)
+        if self._is_member_key(key):
+            self._epoch += 1
+            self._append_event(
+                "leave", key=key, addr=key.split("/", 1)[1], reason=reason
+            )
+            METRICS.add("cluster.members_left")
+
+    def _expire(self, now: float) -> None:
+        dead = [l for l in self._leases.values() if now >= l.expires]
+        for lease in dead:
+            for key in sorted(lease.keys):
+                lease.keys.discard(key)
+                self._drop_key(key, "lease_expired")
+            del self._leases[lease.lease_id]
+            METRICS.add("cluster.leases_expired")
+
+    # -- leases --
+    def lease_grant(self, ttl_s: float, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        if ttl_s <= 0:
+            raise ValueError(f"lease ttl must be positive, got {ttl_s}")
+        lease_id = uuid.uuid4().hex[:16]
+        with self._lock:
+            self._expire(now)
+            self._leases[lease_id] = _Lease(lease_id, float(ttl_s), now)
+            METRICS.add("cluster.leases_granted")
+            # a fresh registrant has no cache to invalidate: it resumes
+            # the event log from *here*, not from history
+            return {"lease": lease_id, "ttl_s": float(ttl_s), "rev": self._rev}
+
+    def lease_refresh(self, lease_id: str, since: Optional[int] = None,
+                      now: Optional[float] = None) -> dict:
+        """Renew a lease; one round trip also returns the epoch and the
+        event-log tail past `since` (the worker-heartbeat piggyback)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return {"found": False, "epoch": self._epoch, "rev": self._rev}
+            lease.expires = now + lease.ttl_s
+            for key in lease.keys:
+                entry = self._kv.get(key)
+                if entry is not None:
+                    entry.refreshed = now
+            out: dict = {"found": True, "epoch": self._epoch, "rev": self._rev}
+            if since is not None:
+                out.update(self._events_since(since))
+            return out
+
+    def lease_revoke(self, lease_id: str, now: Optional[float] = None) -> bool:
+        """Explicit deregistration: drop the lease and its keys NOW
+        (clean shutdown beats waiting out the TTL)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return False
+            for key in sorted(lease.keys):
+                self._drop_key(key, "lease_revoked")
+            return True
+
+    # -- KV --
+    def put(self, key: str, value: Any, lease: Optional[str] = None,
+            now: Optional[float] = None) -> int:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            if lease is not None and lease not in self._leases:
+                raise KeyError(f"unknown lease {lease!r}")
+            joined = self._is_member_key(key) and key not in self._kv
+            entry = _Key(value, lease, self._next_rev(), now)
+            old = self._kv.get(key)
+            if old is not None and old.lease not in (None, lease):
+                stale = self._leases.get(old.lease)
+                if stale is not None:
+                    stale.keys.discard(key)
+            self._kv[key] = entry
+            if lease is not None:
+                self._leases[lease].keys.add(key)
+            if joined:
+                self._epoch += 1
+                self._append_event(
+                    "join", key=key, addr=key.split("/", 1)[1]
+                )
+                METRICS.add("cluster.members_joined")
+            return entry.rev
+
+    def get(self, key: str, now: Optional[float] = None) -> Optional[Any]:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            entry = self._kv.get(key)
+            return None if entry is None else entry.value
+
+    def delete(self, key: str, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            if key not in self._kv:
+                return False
+            self._drop_key(key, "deleted")
+            return True
+
+    def range(self, prefix: str, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            return {
+                k: e.value for k, e in self._kv.items() if k.startswith(prefix)
+            }
+
+    # -- membership --
+    def membership(self, now: Optional[float] = None) -> dict:
+        """The shared view coordinators subscribe to: the epoch plus
+        every live worker with its lease age (seconds since the owning
+        lease last refreshed)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            workers = {}
+            for key, entry in self._kv.items():
+                if not self._is_member_key(key):
+                    continue
+                info = dict(entry.value) if isinstance(entry.value, dict) else {}
+                info["lease_age_s"] = round(now - entry.refreshed, 3)
+                workers[key.split("/", 1)[1]] = info
+            return {"epoch": self._epoch, "rev": self._rev, "workers": workers}
+
+    # -- events / invalidation --
+    def _events_since(self, since: int) -> dict:
+        # lock held
+        out = {
+            "events": [e for e in self._events if e["rev"] > since],
+            "rev": self._rev,
+        }
+        if since and since + 1 < self._events_floor:
+            # consumer fell off the retained window: it missed events it
+            # can never fetch, so it must resync (drop caches) instead
+            # of silently continuing
+            out["truncated"] = True
+        return out
+
+    def events_since(self, since: int, now: Optional[float] = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            return self._events_since(since)
+
+    def invalidate(self, table: str, now: Optional[float] = None) -> dict:
+        """Coordinator-driven cache invalidation: drop shared-tier
+        results that scanned `table` and broadcast a
+        ``cache/invalidate`` event for workers' fragment caches."""
+        now = time.monotonic() if now is None else now
+        dropped = self.results.invalidate_tag(table)
+        with self._lock:
+            self._expire(now)
+            rev = self._append_event("invalidate", table=table)
+            METRICS.add("cluster.invalidations")
+            return {"rev": rev, "dropped": dropped}
+
+    # -- shared result tier --
+    def result_put(self, fingerprint: str, value: dict, nbytes: int,
+                   tables: tuple = ()) -> bool:
+        return self.results.put(
+            f"cache/result/{fingerprint}", value, nbytes, tags=tables
+        )
+
+    def result_get(self, fingerprint: str) -> Optional[dict]:
+        return self.results.get(f"cache/result/{fingerprint}")
+
+    # -- introspection --
+    def gauges(self) -> dict:
+        with self._lock:
+            out = {
+                "cluster.epoch": self._epoch,
+                "cluster.rev": self._rev,
+                "cluster.leases": len(self._leases),
+                "cluster.members": sum(
+                    1 for k in self._kv if self._is_member_key(k)
+                ),
+            }
+        out.update(self.results.gauges())
+        return out
+
+    def status(self, now: Optional[float] = None) -> dict:
+        from datafusion_tpu.obs.export import prometheus_text
+
+        view = self.membership(now)
+        return {
+            "type": "status",
+            "uptime_s": round(time.time() - self.started, 1),
+            "epoch": view["epoch"],
+            "rev": view["rev"],
+            "workers": view["workers"],
+            "results": self.results.stats(),
+            "prometheus": prometheus_text(METRICS, extra_gauges=self.gauges()),
+        }
+
+
+def handle_request(state: ClusterState, msg: dict) -> dict:
+    """One request -> one response, shared by the TCP handler and the
+    in-process `LocalClusterClient` so both deployment shapes run the
+    exact same semantics."""
+    kind = msg.get("type")
+    if kind == "ping":
+        return {"type": "pong", "epoch": state.membership()["epoch"]}
+    if kind == "lease_grant":
+        out = state.lease_grant(float(msg["ttl_s"]))
+        return {"type": "lease", **out}
+    if kind == "lease_refresh":
+        out = state.lease_refresh(msg["lease"], since=msg.get("since"))
+        return {"type": "lease", **out}
+    if kind == "lease_revoke":
+        return {"type": "ok", "found": state.lease_revoke(msg["lease"])}
+    if kind == "kv_put":
+        rev = state.put(msg["key"], msg.get("value"), lease=msg.get("lease"))
+        return {"type": "ok", "rev": rev}
+    if kind == "kv_get":
+        value = state.get(msg["key"])
+        return {"type": "kv", "found": value is not None, "value": value}
+    if kind == "kv_delete":
+        return {"type": "ok", "found": state.delete(msg["key"])}
+    if kind == "kv_range":
+        return {"type": "kv", "items": state.range(msg.get("prefix", ""))}
+    if kind == "membership":
+        return {"type": "membership", **state.membership()}
+    if kind == "events":
+        return {"type": "events", **state.events_since(int(msg.get("since", 0)))}
+    if kind == "invalidate":
+        return {"type": "ok", **state.invalidate(msg["table"])}
+    if kind == "result_put":
+        stored = state.result_put(
+            msg["key"], msg["value"], int(msg["nbytes"]),
+            tuple(msg.get("tables") or ()),
+        )
+        return {"type": "ok", "stored": stored}
+    if kind == "result_get":
+        value = state.result_get(msg["key"])
+        out = {"type": "kv", "found": value is not None}
+        if value is not None:
+            out["value"] = value
+        return out
+    if kind == "status":
+        return state.status()
+    return {"type": "error", "message": f"unknown request {kind!r}"}
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        from datafusion_tpu.errors import ExecutionError
+        from datafusion_tpu.parallel.wire import (
+            crc_for_peer,
+            recv_msg,
+            send_msg,
+        )
+
+        state: ClusterState = self.server.cluster_state  # type: ignore[attr-defined]
+        while True:
+            try:
+                msg = recv_msg(self.request)
+            except (ConnectionError, OSError, ExecutionError):
+                return
+            if msg is None:
+                return
+            try:
+                if msg.get("type") == "shutdown":
+                    send_msg(self.request, {"type": "bye"})
+                    threading.Thread(
+                        target=self.server.shutdown, daemon=True
+                    ).start()
+                    return
+                out = handle_request(state, msg)
+            except Exception as e:  # noqa: BLE001 — the service must not die on a bad request
+                out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
+            try:
+                send_msg(self.request, out, crc=crc_for_peer(msg))
+            except (ConnectionError, OSError):
+                return
+
+
+class ClusterStateService(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(bind: str = "127.0.0.1:0",
+          state: Optional[ClusterState] = None) -> ClusterStateService:
+    """Run the service on `bind`; returns the server (embed it, or call
+    `serve_forever` via ``python -m datafusion_tpu.cluster``)."""
+    host, _, port = bind.partition(":")
+    server = ClusterStateService((host, int(port or 0)), _Handler)
+    server.cluster_state = state or ClusterState()  # type: ignore[attr-defined]
+    return server
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="datafusion-tpu-cluster",
+        description="datafusion-tpu cluster state service "
+                    "(lease KV + membership + shared cache tier)",
+    )
+    ap.add_argument("--bind", default="127.0.0.1:8470",
+                    help="host:port to listen on (default 127.0.0.1:8470)")
+    args = ap.parse_args(argv)
+    server = serve(args.bind)
+    host, port = server.server_address[:2]
+    print(f"cluster service listening on {host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0

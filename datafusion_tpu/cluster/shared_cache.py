@@ -1,0 +1,176 @@
+"""Shared result-cache tier: fingerprint -> snapshot, across coordinators.
+
+The cache PR left the result cache per-context; this tier makes it a
+fleet resource.  `SharedResultTier` plugs into `CacheStore`'s pluggable
+``shared`` seam (`cache/store.py`):
+
+- **read-through**: a local miss consults ``cache/result/<fp>`` on the
+  cluster service; a hit decodes the wire snapshot, installs it in the
+  local store (so repeats stay local), and serves it — coordinator B
+  gets coordinator A's warm result without touching workers or devices.
+- **write-behind**: a local fill enqueues the snapshot for a background
+  publisher thread; the query path never blocks on the service (a slow
+  or partitioned service costs a dropped publication, counted, not
+  latency).
+
+Snapshots cross the wire in the protocol's inline array form
+(`enc_array` without a segment writer: dtype + shape + base64) inside
+ordinary JSON frames — no new encoding, and the CRC handshake covers
+them like any fragment payload.  Entries carry the scanned table names
+as tags so `invalidate(table)` on the service drops dependents.
+
+Fingerprint compatibility across coordinators is inherited from
+`cache/fingerprint.py`: the digest folds in the plan wire JSON, catalog
+versions, backing-file (mtime, size), device, batch size, and UDF
+registry version — two coordinators that registered the same tables
+over the same files the same way mint the same fingerprint, and any
+divergence (different file version, different batch size) misses
+instead of serving wrong bytes.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from datafusion_tpu.cache.result import CachedResult
+from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.obs import trace as obs_trace
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def encode_result(entry: CachedResult) -> dict:
+    """Wire-encode a `CachedResult` snapshot (JSON-able: arrays inline
+    base64 via the wire protocol's array form)."""
+    from datafusion_tpu.parallel.wire import enc_array
+
+    return {
+        "columns": [enc_array(c) for c in entry.columns],
+        "validity": [
+            None if v is None else enc_array(v) for v in entry.validity
+        ],
+        "dict_values": [
+            None if d is None else list(d) for d in entry.dict_values
+        ],
+        "num_rows": entry.num_rows,
+        "nbytes": entry.nbytes,
+    }
+
+
+def decode_result(obj: dict) -> CachedResult:
+    """Rebuild a `CachedResult` from its wire form; the result is
+    marked ``shared`` so EXPLAIN ANALYZE shows where it came from."""
+    from datafusion_tpu.parallel.wire import dec_array
+
+    return CachedResult(
+        [dec_array(c) for c in obj["columns"]],
+        [None if v is None else dec_array(v) for v in obj["validity"]],
+        [None if d is None else tuple(d) for d in obj["dict_values"]],
+        int(obj["num_rows"]),
+        int(obj["nbytes"]),
+        shared=True,
+    )
+
+
+class SharedResultTier:
+    """The `CacheStore.shared` plug-in backed by a cluster client.
+
+    Protocol (what `CacheStore` calls):
+      load(key)  -> (value, nbytes, tags) or None
+      store(key, value, nbytes, tags) -> None  (must not block)
+    """
+
+    def __init__(self, client, queue_depth: int = 64):
+        self.client = client
+        self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- read-through --
+    def load(self, key: str):
+        try:
+            with obs_trace.span("cluster.shared_cache", op="get"):
+                out = self.client.result_get(key)
+        except (ConnectionError, OSError, ExecutionError):
+            METRICS.add("coord.shared_cache_errors")
+            return None
+        if not out.get("found"):
+            METRICS.add("coord.shared_cache_misses")
+            return None
+        stored = out["value"]
+        try:
+            entry = decode_result(stored["snapshot"])
+        except (KeyError, TypeError, ValueError):
+            METRICS.add("coord.shared_cache_decode_errors")
+            return None
+        METRICS.add("coord.shared_cache_hits")
+        return entry, entry.nbytes, tuple(stored.get("tables") or ())
+
+    # -- write-behind --
+    def store(self, key: str, value, nbytes: int, tags: tuple) -> None:
+        if not isinstance(value, CachedResult):
+            return  # the tier only understands result snapshots
+        if value.shared:
+            return  # read-through install: already published, no echo
+        self._ensure_thread()
+        try:
+            self._q.put_nowait((key, value, int(nbytes), tuple(tags)))
+        except queue.Full:
+            # write-behind means best-effort: a backlogged publisher
+            # drops the publication, never stalls the query path
+            METRICS.add("coord.shared_cache_publish_dropped")
+
+    def _ensure_thread(self) -> None:
+        if self._thread is not None:
+            return
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._publish_loop,
+                    name="df-tpu-shared-cache", daemon=True,
+                )
+                self._thread.start()
+
+    def _publish_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            key, value, nbytes, tags = item
+            try:
+                with obs_trace.span("cluster.shared_cache", op="put"):
+                    self.client.result_put(
+                        key, {"snapshot": encode_result(value),
+                              "tables": list(tags)},
+                        nbytes, tables=tags,
+                    )
+                METRICS.add("coord.shared_cache_published")
+            except (ConnectionError, OSError, ExecutionError):
+                METRICS.add("coord.shared_cache_errors")
+            except Exception:  # noqa: BLE001 — the publisher must outlive bad entries
+                METRICS.add("coord.shared_cache_errors")
+            finally:
+                self._q.task_done()
+
+    def flush(self, timeout_s: float = 10.0) -> bool:
+        """Block until the publish queue drains (tests, smoke scripts —
+        write-behind made deterministic).  Returns False on timeout."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return True
+            time.sleep(0.01)
+        return self._q.unfinished_tasks == 0
+
+    def close(self) -> None:
+        if self._thread is not None:
+            self.flush(timeout_s=2.0)
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None

@@ -396,6 +396,7 @@ class ExecutionContext:
                 return CachedResultRelation(
                     plan.schema, entry, fp,
                     on_complete=lambda s: self._record_history(fp, s),
+                    batch_size=self.batch_size,
                 )
             rel = self._execute_plan(plan)
             attach_result_capture(

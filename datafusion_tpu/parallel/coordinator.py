@@ -28,6 +28,7 @@ as the remaining budget, bounding worker-side retries too.
 
 from __future__ import annotations
 
+import functools
 import socket
 import threading
 import time
@@ -149,6 +150,17 @@ class WorkerHandle:
         return self.request({"type": "status"}, timeout=10.0)
 
 
+@functools.lru_cache(maxsize=256)
+def _resolve_addr(addr: str) -> str:
+    """'host:port' with the host resolved to its IP (memoized; an
+    unresolvable host returns unchanged)."""
+    host, _, port = addr.rpartition(":")
+    try:
+        return f"{socket.gethostbyname(host)}:{port}"
+    except OSError:
+        return addr
+
+
 class HeartbeatMonitor:
     """Coordinator-side failure detection + worker re-admission.
 
@@ -167,14 +179,27 @@ class HeartbeatMonitor:
     coordinators doesn't align its probe bursts on a recovering worker.
     `poll_once()` runs one cycle synchronously — tests drive it
     deterministically without the thread.
+
+    **Cluster mode** (`membership` set): the monitor stops probing and
+    consumes the shared `MembershipView` instead — one request per
+    cycle replaces N probes, and every coordinator sharing the worker
+    pool learns liveness from the same epoch-stamped view instead of
+    re-learning it privately.  Worker state flips directly on view
+    membership (the service's lease TTL already is the
+    probation/fail-threshold debounce); a refresh that cannot reach the
+    service keeps the last view.  Dispatch's last-gasp re-probe is
+    unchanged either way — direct probes remain the final word before a
+    query is failed.
     """
 
     def __init__(self, workers: list[WorkerHandle], interval: float = 5.0,
-                 probation_pings: int = 1, fail_threshold: int = 2):
+                 probation_pings: int = 1, fail_threshold: int = 2,
+                 membership=None):
         self.workers = workers
         self.interval = interval
         self.probation_pings = probation_pings
         self.fail_threshold = fail_threshold
+        self.membership = membership
         self._ok: dict[int, int] = {}
         self._bad: dict[int, int] = {}
         self._seen_alive: dict[int, bool] = {}
@@ -182,6 +207,9 @@ class HeartbeatMonitor:
         self._thread: Optional[threading.Thread] = None
 
     def poll_once(self) -> None:
+        if self.membership is not None:
+            self._poll_view()
+            return
         for i, w in enumerate(self.workers):
             # dispatch failover (or a last-gasp re-probe) can flip a
             # worker's state between cycles; stale streaks must not
@@ -200,6 +228,27 @@ class HeartbeatMonitor:
                 if w.alive and self._bad[i] >= self.fail_threshold:
                     w.mark_down()
             self._seen_alive[i] = w.alive
+
+    def _poll_view(self) -> None:
+        """One cluster-mode cycle: refresh the shared view, flip worker
+        state to match it.  A failed refresh (partitioned service)
+        keeps the previous states — stale liveness beats flapping.
+        Addresses compare resolved (a worker registered as
+        '127.0.0.1:p' must match a handle configured as 'localhost:p' —
+        a spelling mismatch would flap the worker down every cycle)."""
+        if not self.membership.poll():
+            return
+        live = self.membership.live_addresses()
+        live = live | {_resolve_addr(a) for a in live}
+        for w in self.workers:
+            in_view = (
+                f"{w.host}:{w.port}" in live
+                or _resolve_addr(f"{w.host}:{w.port}") in live
+            )
+            if in_view and not w.alive:
+                w.readmit()
+            elif not in_view and w.alive:
+                w.mark_down()
 
     def _loop(self) -> None:
         import random
@@ -646,11 +695,21 @@ class DistributedContext(ExecutionContext):
     `query_deadline_s` (or env DATAFUSION_TPU_QUERY_DEADLINE_S) bounds
     every query end to end — dispatch, reassignment retries, and
     worker-side device retries all honor the remaining budget.
+
+    `cluster` (address string, `ClusterState`, or client; or env
+    DATAFUSION_TPU_CLUSTER) joins the cluster control plane
+    (`datafusion_tpu/cluster/`): worker liveness comes from the shared
+    `MembershipView` (the heartbeat monitor consumes it instead of
+    probing), `workers` may be omitted entirely (discovered from the
+    membership), the result cache gains the shared read-through/
+    write-behind tier, and `register_datasource` re-registrations
+    broadcast fragment-cache invalidations to every worker.  Unset, no
+    cluster code runs — no new threads, sockets, or allocations.
     """
 
     def __init__(
         self,
-        workers: Sequence[tuple[str, int]],
+        workers: Sequence[tuple[str, int]] = (),
         batch_size: int = 131072,
         request_timeout: Optional[float] = None,
         heartbeat_interval: Optional[float] = None,
@@ -658,11 +717,36 @@ class DistributedContext(ExecutionContext):
         fail_threshold: int = 2,
         query_deadline_s: Optional[float] = None,
         result_cache=None,
+        cluster=None,
     ):
         import os
 
         super().__init__(device=None, batch_size=batch_size,
                          result_cache=result_cache)
+        self.cluster = None
+        self.membership = None
+        self._shared_tier = None
+        if cluster is None:
+            cluster = os.environ.get("DATAFUSION_TPU_CLUSTER") or None
+        if cluster:
+            from datafusion_tpu import cluster as _cluster_mod
+            from datafusion_tpu.cluster.membership import MembershipView
+            from datafusion_tpu.cluster.shared_cache import SharedResultTier
+
+            self.cluster = _cluster_mod.connect(cluster)
+            self.membership = MembershipView(self.cluster)
+            # initial view is best-effort: a coordinator may come up
+            # before the service; liveness then starts from the probes
+            self.membership.poll()
+            if not workers:
+                workers = sorted(
+                    self._parse_addr(a)
+                    for a in self.membership.live_addresses()
+                )
+            if self._result_cache is not None:
+                self._shared_tier = SharedResultTier(self.cluster)
+                self._result_cache.shared = self._shared_tier
+        self._request_timeout = request_timeout
         self.workers = [WorkerHandle(h, p, request_timeout) for h, p in workers]
         if query_deadline_s is None:
             env = os.environ.get("DATAFUSION_TPU_QUERY_DEADLINE_S")
@@ -679,11 +763,19 @@ class DistributedContext(ExecutionContext):
                 interval=heartbeat_interval,
                 probation_pings=probation_pings,
                 fail_threshold=fail_threshold,
+                membership=self.membership,
             ).start()
+
+    @staticmethod
+    def _parse_addr(addr: str) -> tuple[str, int]:
+        host, _, port = addr.rpartition(":")
+        return host, int(port)
 
     def close(self) -> None:
         if self.heartbeat is not None:
             self.heartbeat.stop()
+        if self._shared_tier is not None:
+            self._shared_tier.close()
 
     def __enter__(self) -> "DistributedContext":
         return self
@@ -707,6 +799,72 @@ class DistributedContext(ExecutionContext):
             except (ConnectionError, OSError, ExecutionError):
                 out[f"{w.host}:{w.port}"] = None
         return out
+
+    # -- cluster control plane (datafusion_tpu/cluster) --
+    def cluster_epoch(self, refresh: bool = True) -> int:
+        """The shared membership epoch this coordinator has observed
+        (-1 before the first successful refresh).  Two coordinators at
+        the same epoch observed the same worker set."""
+        if self.membership is None:
+            raise ExecutionError("cluster mode is off (no cluster= / "
+                                 "DATAFUSION_TPU_CLUSTER)")
+        if refresh:
+            self.membership.poll()
+        return self.membership.epoch
+
+    def sync_workers(self) -> list[str]:
+        """Fold newly-registered cluster workers into the rotation
+        (workers that joined after this coordinator came up).  Returns
+        the addresses added; existing handles keep their state."""
+        if self.membership is None:
+            return []
+        self.membership.poll()
+        known = {f"{w.host}:{w.port}" for w in self.workers}
+        added = []
+        for addr in sorted(self.membership.live_addresses() - known):
+            host, port = self._parse_addr(addr)
+            self.workers.append(WorkerHandle(host, port, self._request_timeout))
+            added.append(addr)
+        if added:
+            METRICS.add("coord.workers_discovered", len(added))
+        return added
+
+    def broadcast_invalidate(self, table: str) -> int:
+        """Coordinator-driven cache invalidation broadcast: drop
+        shared-tier results that scanned `table` and queue a
+        fragment-cache invalidation event every worker applies on its
+        next lease refresh — stale entries die within one heartbeat
+        instead of one TTL.  Returns the shared-tier entries dropped."""
+        if self.cluster is None:
+            return 0
+        out = self.cluster.invalidate(table)
+        METRICS.add("coord.invalidations_broadcast")
+        return int(out.get("dropped", 0))
+
+    def register_datasource(self, name: str, ds) -> None:
+        """Re-registering a table in cluster mode additionally
+        broadcasts the invalidation fleet-wide (the local tag-drop in
+        the base method only covers THIS context's result cache)."""
+        rereg = self.catalog_version(name) > 0
+        super().register_datasource(name, ds)
+        if rereg and self.cluster is not None:
+            try:
+                self.broadcast_invalidate(name)
+            except (ConnectionError, OSError, ExecutionError):
+                # fingerprints still stop matching via file versions;
+                # the broadcast is the fast path, not the correctness —
+                # a failing (or error-answering) service must not fail
+                # the registration that already succeeded locally
+                METRICS.add("coord.invalidation_broadcast_errors")
+
+    def metrics_text(self) -> str:
+        """Prometheus text with the cluster gauges folded in (epoch,
+        live workers, watch lag) when cluster mode is on."""
+        if self.membership is None:
+            return super().metrics_text()
+        from datafusion_tpu.obs.export import prometheus_text
+
+        return prometheus_text(METRICS, extra_gauges=self.membership.gauges())
 
     def _execute_plan(self, plan: LogicalPlan) -> Relation:
         # unlike the single-host mesh matcher this one keeps Utf8

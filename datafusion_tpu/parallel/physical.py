@@ -102,6 +102,29 @@ class PlanFragment:
             "fragment_id": self.fragment_id,
         }
 
+    def table_names(self) -> list[str]:
+        """Table names the fragment's plan scans, read straight from
+        the wire JSON (no plan reconstruction) — the worker fragment
+        cache tags entries with them so a coordinator's invalidation
+        broadcast (`cluster/`) can drop exactly the dependents."""
+        names: set[str] = set()
+
+        def walk(node):
+            if isinstance(node, dict):
+                for key, body in node.items():
+                    if key == "TableScan" and isinstance(body, dict):
+                        name = body.get("table_name")
+                        if name:
+                            names.add(name)
+                    else:
+                        walk(body)
+            elif isinstance(node, list):
+                for item in node:
+                    walk(item)
+
+        walk(self.plan)
+        return sorted(names)
+
     def to_json_str(self) -> str:
         return json.dumps(
             {

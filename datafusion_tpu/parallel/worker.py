@@ -143,13 +143,19 @@ class WorkerState:
         # None when DATAFUSION_TPU_CACHE=0 (zero overhead).
         self.fragment_cache = qcache.make_store("fragment")
         self.cache_hits = 0
+        # cluster agent (cluster/agent.py): lease registration +
+        # invalidation apply; None outside cluster mode
+        self.cluster_agent = None
 
     def _gauges(self) -> dict:
         """Point-in-time gauges for the Prometheus rendering: span
-        buffer depth plus the fragment cache's levels."""
+        buffer depth plus the fragment cache's levels (and, in cluster
+        mode, the lease age / epoch / events-applied gauges)."""
         gauges = {"obs.span_buffer_depth": obs_trace.buffered()}
         if self.fragment_cache is not None:
             gauges.update(self.fragment_cache.gauges())
+        if self.cluster_agent is not None:
+            gauges.update(self.cluster_agent.gauges())
         return gauges
 
     def status(self) -> dict:
@@ -185,6 +191,11 @@ class WorkerState:
                 ),
                 "hits_served": self.cache_hits,
             },
+            "cluster": (
+                None
+                if self.cluster_agent is None
+                else self.cluster_agent.snapshot()
+            ),
             "metrics": {
                 "timings_s": {
                     k: round(v, 3) for k, v in snap["timings_s"].items()
@@ -244,7 +255,10 @@ class WorkerState:
             raw = compute(frag)
         if cache is not None:
             stored = _copy_raw(raw)
-            cache.put(key, stored, _raw_nbytes(stored))
+            # tagged by scanned table so a coordinator's invalidation
+            # broadcast (cluster mode) drops exactly the dependents
+            cache.put(key, stored, _raw_nbytes(stored),
+                      tags=frag.table_names())
         return raw, False
 
     def execute_fragment(self, fragment_str: str, bw: Optional[BinWriter] = None) -> dict:
@@ -458,11 +472,19 @@ def serve_http_status(state: WorkerState, host: str, port: int):
 
 
 def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
-          http_port: Optional[int] = None):
+          http_port: Optional[int] = None, cluster=None,
+          lease_ttl_s: Optional[float] = None,
+          advertise: Optional[str] = None):
     """Run a worker; returns (server, thread) for embedding, or call
     serve_forever via the CLI entry (python -m datafusion_tpu.worker).
     `http_port` (non-zero) additionally serves GET /status on the same
-    host."""
+    host.  `cluster` (service address, `ClusterState`, or client)
+    registers this worker in the cluster control plane under a TTL
+    lease kept alive by a heartbeat thread that also applies broadcast
+    cache invalidations (`cluster/agent.py`); `advertise` is the
+    host[:port] coordinators should DIAL — required knowledge when the
+    bind address is a wildcard (0.0.0.0 is not dialable from another
+    host) or NAT'd (containers)."""
     host, _, port = bind.partition(":")
     server = WorkerServer((host, int(port or 0)), _Handler)
     server.worker_state = WorkerState(device=device, batch_size=batch_size)  # type: ignore[attr-defined]
@@ -470,6 +492,31 @@ def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
         server.http_server = serve_http_status(  # type: ignore[attr-defined]
             server.worker_state, host, http_port
         )
+    if cluster:
+        from datafusion_tpu import cluster as _cluster_mod
+        from datafusion_tpu.cluster.agent import WorkerClusterAgent
+
+        bound_host, bound_port = server.server_address[:2]
+        if advertise:
+            adv_host, _, adv_port = advertise.partition(":")
+            addr = f"{adv_host or bound_host}:{adv_port or bound_port}"
+        else:
+            adv_host = bound_host
+            if adv_host in ("0.0.0.0", "::", ""):
+                # a wildcard bind is not a dialable address; fall back
+                # to this host's resolvable name so remote coordinators
+                # can reach us (--advertise overrides when that's wrong)
+                try:
+                    adv_host = socket.gethostbyname(socket.gethostname())
+                except OSError:
+                    adv_host = socket.gethostname()
+            addr = f"{adv_host}:{bound_port}"
+        server.worker_state.cluster_agent = WorkerClusterAgent(
+            _cluster_mod.connect(cluster),
+            addr,
+            server.worker_state,
+            ttl_s=lease_ttl_s,
+        ).start()
     return server
 
 
@@ -494,6 +541,16 @@ def main(argv=None) -> int:
     # multi-host accelerator bring-up (jax.distributed — the etcd
     # replacement, SURVEY §5.8): workers on a TPU pod join one global
     # mesh before serving fragments
+    # cluster control plane (datafusion_tpu/cluster): register under a
+    # TTL lease, apply coordinator invalidation broadcasts
+    ap.add_argument("--cluster", default=None,
+                    help="cluster state service address host:port "
+                         "(default: env DATAFUSION_TPU_CLUSTER; empty = "
+                         "cluster mode off)")
+    ap.add_argument("--advertise", default=None,
+                    help="host[:port] coordinators should dial for this "
+                         "worker (needed behind 0.0.0.0 binds / NAT; "
+                         "default: the bound address)")
     ap.add_argument("--coordinator", default=None,
                     help="jax.distributed coordinator address host:port "
                          "(omit on single-host deployments)")
@@ -525,12 +582,20 @@ def main(argv=None) -> int:
             f"{jax.process_count()}, global devices {jax.device_count()}",
             flush=True,
         )
+    cluster = args.cluster
+    if cluster is None:
+        from datafusion_tpu.cluster import cluster_address
+
+        cluster = cluster_address()
     server = serve(args.bind, device=args.device, batch_size=args.batch_size,
-                   http_port=args.http_port)
+                   http_port=args.http_port, cluster=cluster,
+                   advertise=args.advertise)
     host, port = server.server_address[:2]
     print(f"worker listening on {host}:{port}", flush=True)
     if args.http_port:
         print(f"worker status: http://{host}:{args.http_port}/status", flush=True)
+    if cluster:
+        print(f"worker cluster: registered with {cluster}", flush=True)
     from datafusion_tpu.native import native_available
 
     print(
@@ -542,4 +607,9 @@ def main(argv=None) -> int:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        agent = server.worker_state.cluster_agent  # type: ignore[attr-defined]
+        if agent is not None:
+            # revoke the lease so the membership epoch moves now
+            agent.close()
     return 0

@@ -6,8 +6,11 @@ misbehaves is untested code.  This module makes every failure path
 exercisable on demand: production code declares *named sites*
 (`faults.check("wire.recv")`) at the points where the real world can
 hurt it — wire send/recv, worker fragment execution, device dispatch,
-CSV/IO reads — and a process-global, seedable *fault plan* decides
-which sites fire and how.
+CSV/IO reads, and the cluster control plane (``cluster.request`` =
+service partition, ``cluster.lease.refresh`` = lease expiry /
+heartbeat loss, ``cluster.watch`` = stale membership view) — and a
+process-global, seedable *fault plan* decides which sites fire and
+how.
 
 Zero overhead when off: with no plan installed, `check()` is one module
 attribute read and a `None` test.  Nothing else in the engine changes.

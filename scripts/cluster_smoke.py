@@ -1,5 +1,7 @@
 #!/usr/bin/env python
-"""Local cluster smoketest: coordinator + 2 workers + kill-one failover.
+"""Local cluster smoketest: coordinator + 2 workers + kill-one failover,
+plus the cluster control plane (service + shared membership + cache
+coherence).
 
 The working version of the reference's intended harness
 (`/root/reference/scripts/smoketest.sh:30-66` wires etcd + worker +
@@ -12,7 +14,13 @@ distributed mode never worked).  Here:
 3. SIGKILL one worker mid-flight and re-run — the coordinator must
    fail over the dead worker's fragments to the survivor and still
    agree with the local engine;
-4. exit non-zero on any mismatch.
+4. (local mode) control-plane phase: spawn the cluster state service
+   (`python -m datafusion_tpu.cluster`) + 2 cluster-registered workers
+   + 2 coordinators; assert both coordinators see the same membership
+   epoch, coordinator B gets a shared-tier hit on a query warm in
+   coordinator A, and an invalidation broadcast drops worker
+   fragment-cache entries before TTL;
+5. exit non-zero on any mismatch.
 
 Run directly (processes, works anywhere python does):
 
@@ -53,14 +61,15 @@ def _write_partitions(tmpdir: str, n_parts: int = 4, rows_per: int = 2000):
     return paths
 
 
-def _start_worker(env):
+def _start_worker(env, module="datafusion_tpu.worker",
+                  extra_args=("--device", "cpu")):
     import threading
 
     stderr_path = tempfile.mktemp(prefix="dftpu_worker_err_")
     stderr_f = open(stderr_path, "w")
     proc = subprocess.Popen(
-        [sys.executable, "-m", "datafusion_tpu.worker",
-         "--bind", "127.0.0.1:0", "--device", "cpu"],
+        [sys.executable, "-m", module,
+         "--bind", "127.0.0.1:0", *extra_args],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=stderr_f, text=True,
     )
@@ -80,6 +89,105 @@ def _start_worker(env):
         )
     host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
     return proc, (host, int(port))
+
+
+def control_plane_smoke(schema, sql, paths, env) -> None:
+    """Phase 4: the cluster control plane — service + 2 registered
+    workers + 2 coordinators sharing membership and caches."""
+    import time
+
+    from datafusion_tpu.cache.result import CachedResultRelation
+    from datafusion_tpu.cluster import connect
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+    procs = []
+    try:
+        svc_proc, svc_addr = _start_worker(
+            env, module="datafusion_tpu.cluster", extra_args=()
+        )
+        procs.append(svc_proc)
+        svc = f"{svc_addr[0]}:{svc_addr[1]}"
+        wenv = dict(env)
+        wenv["DATAFUSION_TPU_CLUSTER"] = svc
+        # short lease so invalidations apply within a couple of seconds
+        wenv["DATAFUSION_TPU_CLUSTER_TTL_S"] = "2"
+        for _ in range(2):
+            proc, _addr = _start_worker(wenv)
+            procs.append(proc)
+        print(f"control plane up: service {svc} + 2 workers", flush=True)
+
+        client = connect(svc)
+        deadline = time.monotonic() + 120
+        while len(client.membership()["workers"]) < 2:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"workers never registered: {client.membership()}"
+                )
+            time.sleep(0.5)
+
+        def make_ctx():
+            ctx = DistributedContext(cluster=svc)
+            ctx.register_datasource(
+                "t",
+                PartitionedDataSource(
+                    [CsvDataSource(p, schema, True, 131072) for p in paths]
+                ),
+            )
+            return ctx
+
+        ca, cb = make_ctx(), make_ctx()
+        assert len(ca.workers) == 2, ca.workers  # discovered, not configured
+        # convergence, not an exact count: the epoch also counts leaves,
+        # and a slow machine can lapse-and-rejoin a short-TTL lease
+        ea, eb = ca.cluster_epoch(), cb.cluster_epoch()
+        assert ea == eb >= 2, (ea, eb)
+        assert len(ca.membership.live_addresses()) == 2
+        print(f"membership: both coordinators at epoch {ea}", flush=True)
+
+        # shared tier: warm in A, hit in B without dispatching fragments
+        want = sorted(collect(ca.sql(sql)).to_rows())
+        assert ca._shared_tier.flush(timeout_s=30), "publish never drained"
+        rel = cb.sql(sql)
+        assert isinstance(rel, CachedResultRelation) and rel.entry.shared, rel
+        got = sorted(collect(rel).to_rows())
+        assert got == want, f"shared-tier result diverges:\n{got}\nvs\n{want}"
+        print("shared result tier: coordinator B warm off A's query",
+              flush=True)
+
+        # invalidation broadcast: worker fragment caches drop before TTL
+        def frag_entries():
+            total = 0
+            for w in ca.workers:
+                frag = w.status()["cache"]["fragment"]
+                total += 0 if frag is None else frag["entries"]
+            return total
+
+        assert frag_entries() >= 2, "fragment caches never warmed"
+        ca.broadcast_invalidate("t")
+        deadline = time.monotonic() + 30  # lease refresh ~0.7s at TTL 2
+        while frag_entries() > 0:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"invalidation never applied ({frag_entries()} entries)"
+                )
+            time.sleep(0.2)
+        print("invalidation broadcast: worker fragment caches dropped",
+              flush=True)
+        ca.close()
+        cb.close()
+        print("CONTROL PLANE OK", flush=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
 
 
 def main(addrs=None) -> int:
@@ -188,6 +296,17 @@ def main(addrs=None) -> int:
                 "failover check SKIPPED (external workers, no "
                 "DFTPU_KILL_CMD provided)",
                 flush=True,
+            )
+
+        # -- control plane: service + shared membership + cache tiers --
+        if procs:  # local mode only: the phase spawns its own fleet
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env["JAX_PLATFORMS"] = "cpu"
+            control_plane_smoke(schema, sql, paths, env)
+        else:
+            print(
+                "control plane check SKIPPED (external workers)", flush=True
             )
         print("CLUSTER SMOKETEST PASSED", flush=True)
         return 0

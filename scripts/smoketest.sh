@@ -33,6 +33,9 @@ python scripts/trace_smoke.py
 echo "== cache smoke (result + fragment caches, invalidation, off-switch) =="
 python scripts/cache_smoke.py
 
+echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast) =="
+python scripts/cluster_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

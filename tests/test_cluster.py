@@ -1,0 +1,635 @@
+"""Cluster control plane (datafusion_tpu/cluster).
+
+Covers the lease-KV state machine (grants, refresh piggyback, lazy
+expiry with injectable time, epoch bumps on join/leave, event-log
+truncation), client parity (the in-process client and the TCP service
+run the same `handle_request`), the coordinator `MembershipView` (epoch
+subscription, stale-view tolerance, gauges), the shared result tier
+(wire snapshot roundtrip, read-through install, write-behind publish,
+cross-coordinator warm hit), the invalidation broadcast (worker
+fragment caches drop tagged entries on the next lease refresh, well
+before TTL), multi-coordinator convergence after a worker kill, and the
+chaos variants under `testing/faults` (service partition, lease expiry,
+stale watch).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.cache.result import CachedResult, CachedResultRelation
+from datafusion_tpu.cache.store import CacheStore
+from datafusion_tpu.cluster import (
+    ClusterState,
+    LocalClusterClient,
+    connect,
+)
+from datafusion_tpu.cluster.agent import WorkerClusterAgent
+from datafusion_tpu.cluster.membership import MembershipView
+from datafusion_tpu.cluster.shared_cache import (
+    SharedResultTier,
+    decode_result,
+    encode_result,
+)
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.datasource import CsvDataSource
+from datafusion_tpu.exec.materialize import collect
+from datafusion_tpu.parallel.coordinator import (
+    DistributedContext,
+    HeartbeatMonitor,
+)
+from datafusion_tpu.parallel.partition import PartitionedDataSource
+from datafusion_tpu.parallel.worker import serve
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.metrics import METRICS
+
+
+# -- state machine --------------------------------------------------------
+
+
+class TestClusterState:
+    def test_lease_bound_key_dies_with_lease(self):
+        st = ClusterState()
+        g = st.lease_grant(10.0, now=0.0)
+        st.put("workers/a:1", {"addr": "a:1"}, lease=g["lease"], now=0.0)
+        assert st.get("workers/a:1", now=5.0) is not None
+        # past the TTL: lazy expiry sweeps the lease and its keys
+        assert st.get("workers/a:1", now=10.5) is None
+        assert st.membership(now=10.5)["workers"] == {}
+
+    def test_refresh_extends_and_piggybacks_events(self):
+        st = ClusterState()
+        g = st.lease_grant(10.0, now=0.0)
+        st.put("workers/a:1", {}, lease=g["lease"], now=0.0)
+        out = st.lease_refresh(g["lease"], since=g["rev"], now=9.0)
+        assert out["found"] and out["epoch"] == 1
+        # the join event for our own key rides the refresh
+        assert [e["kind"] for e in out["events"]] == ["join"]
+        # refresh at t=9 extends to t=19
+        assert st.get("workers/a:1", now=18.0) is not None
+        assert st.get("workers/a:1", now=19.5) is None
+
+    def test_epoch_bumps_on_join_and_leave_only(self):
+        st = ClusterState()
+        assert st.membership(now=0.0)["epoch"] == 0
+        g = st.lease_grant(5.0, now=0.0)
+        st.put("workers/a:1", {}, lease=g["lease"], now=0.0)
+        assert st.membership(now=0.0)["epoch"] == 1
+        # non-member keys and value updates don't move the epoch
+        st.put("config/x", 1, now=0.0)
+        st.put("workers/a:1", {"v": 2}, lease=g["lease"], now=0.0)
+        assert st.membership(now=0.0)["epoch"] == 1
+        st.lease_revoke(g["lease"], now=1.0)
+        assert st.membership(now=1.0)["epoch"] == 2
+
+    def test_expiry_emits_leave_event_with_reason(self):
+        st = ClusterState()
+        g = st.lease_grant(1.0, now=0.0)
+        st.put("workers/a:1", {}, lease=g["lease"], now=0.0)
+        out = st.events_since(0, now=2.0)
+        kinds = [(e["kind"], e.get("reason")) for e in out["events"]]
+        assert ("join", None) in kinds
+        assert ("leave", "lease_expired") in kinds
+
+    def test_event_log_truncation_flagged(self):
+        st = ClusterState()
+        for i in range(1100):
+            st.invalidate(f"t{i}", now=0.0)
+        out = st.events_since(1, now=0.0)
+        assert out.get("truncated") is True
+        assert len(out["events"]) <= 1024
+
+    def test_invalidate_drops_tagged_results(self):
+        st = ClusterState()
+        st.result_put("fp1", {"snapshot": 1}, 10, tables=("t",))
+        st.result_put("fp2", {"snapshot": 2}, 10, tables=("u",))
+        out = st.invalidate("t", now=0.0)
+        assert out["dropped"] == 1
+        assert st.result_get("fp1") is None
+        assert st.result_get("fp2") is not None
+
+    def test_unknown_lease_put_rejected(self):
+        st = ClusterState()
+        with pytest.raises(KeyError):
+            st.put("workers/a:1", {}, lease="nope", now=0.0)
+
+
+# -- clients (in-process and TCP run the same handler) --------------------
+
+
+class TestClients:
+    def test_local_client_roundtrip(self):
+        c = LocalClusterClient(ClusterState())
+        assert c.ping()
+        g = c.lease_grant(30.0)
+        c.put("workers/x:1", {"addr": "x:1"}, lease=g["lease"])
+        view = c.membership()
+        assert view["epoch"] == 1 and "x:1" in view["workers"]
+        assert c.get("workers/x:1")["addr"] == "x:1"
+        assert c.range("workers/") == {"workers/x:1": {"addr": "x:1"}}
+        assert c.lease_revoke(g["lease"])
+        assert c.membership()["workers"] == {}
+
+    def test_tcp_service_parity(self):
+        from datafusion_tpu.cluster.service import serve as serve_cluster
+
+        server = serve_cluster("127.0.0.1:0")
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            host, port = server.server_address[:2]
+            c = connect(f"{host}:{port}")
+            assert c.ping()
+            g = c.lease_grant(30.0)
+            c.put("workers/y:2", {"addr": "y:2"}, lease=g["lease"])
+            assert c.membership()["workers"].keys() == {"y:2"}
+            # the shared tier over TCP: value survives the wire
+            assert c.result_put("fp", {"snapshot": {"n": 1}}, 8, ("t",))
+            out = c.result_get("fp")
+            assert out["found"] and out["value"]["snapshot"] == {"n": 1}
+            assert c.invalidate("t")["dropped"] == 1
+            status = c.status()
+            assert status["epoch"] == 1 and "cluster_epoch" in status["prometheus"]
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_connect_shapes(self):
+        st = ClusterState()
+        local = connect(st)
+        assert isinstance(local, LocalClusterClient)
+        assert connect(local) is local
+        with pytest.raises(TypeError):
+            connect(42)
+
+    def test_request_fault_site_is_a_partition(self):
+        c = LocalClusterClient(ClusterState())
+        with faults.scoped({"rules": [
+            {"site": "cluster.request", "op": "raise",
+             "exc": "ConnectionRefusedError", "count": 1},
+        ]}):
+            assert not c.ping()  # partition reports unhealthy, no raise
+        assert c.ping()
+
+
+# -- membership view ------------------------------------------------------
+
+
+class TestMembershipView:
+    def _cluster_with_worker(self):
+        st = ClusterState()
+        c = LocalClusterClient(st)
+        g = c.lease_grant(30.0)
+        c.put("workers/w:1", {"addr": "w:1"}, lease=g["lease"])
+        return st, c, g
+
+    def test_refresh_tracks_epoch_and_workers(self):
+        _, c, g = self._cluster_with_worker()
+        view = MembershipView(c)
+        assert view.epoch == -1
+        view.refresh()
+        assert view.epoch == 1 and view.live_addresses() == {"w:1"}
+        c.lease_revoke(g["lease"])
+        view.refresh()
+        assert view.epoch == 2 and view.live_addresses() == set()
+
+    def test_poll_keeps_stale_view_through_partition(self):
+        _, c, _ = self._cluster_with_worker()
+        view = MembershipView(c)
+        view.refresh()
+        with faults.scoped({"rules": [
+            {"site": "cluster.watch", "op": "raise",
+             "exc": "ConnectionResetError", "count": 1},
+        ]}):
+            assert not view.poll()
+        # stale view preserved, error counted, gauges stay coherent
+        assert view.live_addresses() == {"w:1"}
+        assert view.refresh_errors == 1
+        g = view.gauges()
+        assert g["cluster.workers_live"] == 1
+        assert g["cluster.watch_errors"] == 1
+        assert g["cluster.watch_lag_s"] >= 0
+        assert view.poll()
+
+    def test_view_matches_workers_by_resolved_address(self):
+        """A handle configured as 'localhost' must match a worker that
+        registered its bound '127.0.0.1' — a spelling mismatch would
+        flap a live worker down every cycle."""
+        from datafusion_tpu.parallel.coordinator import WorkerHandle
+
+        st = ClusterState()
+        c = LocalClusterClient(st)
+        g = c.lease_grant(30.0)
+        c.put("workers/127.0.0.1:9000", {}, lease=g["lease"])
+        w = WorkerHandle("localhost", 9000)
+        mon = HeartbeatMonitor([w], membership=MembershipView(c))
+        mon.poll_once()
+        assert w.alive
+
+    def test_heartbeat_monitor_consumes_view(self):
+        from datafusion_tpu.parallel.coordinator import WorkerHandle
+
+        _, c, g = self._cluster_with_worker()
+        view = MembershipView(c)
+        w = WorkerHandle("w", 1)
+        mon = HeartbeatMonitor([w], membership=view)
+        mon.poll_once()
+        assert w.alive
+        c.lease_revoke(g["lease"])
+        mon.poll_once()
+        assert not w.alive  # no probe ran; the shared view decided
+        # rejoin: a fresh lease re-admits without probation counting
+        g2 = c.lease_grant(30.0)
+        c.put("workers/w:1", {"addr": "w:1"}, lease=g2["lease"])
+        mon.poll_once()
+        assert w.alive
+
+
+# -- shared result tier ---------------------------------------------------
+
+
+def _snapshot(num_rows=3):
+    return CachedResult(
+        [np.arange(num_rows, dtype=np.int64),
+         np.asarray([0, 1, 0][:num_rows], np.int32)],
+        [None, np.asarray([True, False, True][:num_rows])],
+        [None, ("x", "y")],
+        num_rows,
+        64,
+    )
+
+
+class TestSharedResultTier:
+    def test_snapshot_wire_roundtrip(self):
+        entry = _snapshot()
+        back = decode_result(encode_result(entry))
+        assert back.shared is True and back.num_rows == 3
+        np.testing.assert_array_equal(back.columns[0], entry.columns[0])
+        np.testing.assert_array_equal(back.validity[1], entry.validity[1])
+        assert back.dict_values == [None, ("x", "y")]
+
+    def test_read_through_installs_locally_without_echo(self):
+        c = LocalClusterClient(ClusterState())
+        tier = SharedResultTier(c)
+        c.result_put(
+            "fp", {"snapshot": encode_result(_snapshot()), "tables": ["t"]},
+            64, ("t",),
+        )
+        store = CacheStore(1 << 20, name="rt")
+        store.shared = tier
+        published = METRICS.counts.get("coord.shared_cache_published", 0)
+        got = store.get("fp")
+        assert got is not None and got.shared
+        assert store.entries == 1 and store.shared_hits == 1
+        # the install must not re-publish (shared snapshots skip store())
+        tier.flush()
+        assert METRICS.counts.get(
+            "coord.shared_cache_published", 0) == published
+        # second get: purely local
+        assert store.get("fp") is not None and store.shared_hits == 1
+        tier.close()
+
+    def test_write_behind_publishes(self):
+        st = ClusterState()
+        tier = SharedResultTier(LocalClusterClient(st))
+        store = CacheStore(1 << 20, name="wb")
+        store.shared = tier
+        store.put("fp", _snapshot(), 64, tags=("t",))
+        assert tier.flush(timeout_s=10.0)
+        assert st.result_get("fp") is not None
+        # a second store with a fresh local cache reads it back
+        other = CacheStore(1 << 20, name="wb2")
+        other.shared = SharedResultTier(LocalClusterClient(st))
+        assert other.get("fp").shared
+        tier.close()
+
+    def test_partitioned_service_degrades_to_miss(self):
+        tier = SharedResultTier(LocalClusterClient(ClusterState()))
+        store = CacheStore(1 << 20, name="pt")
+        store.shared = tier
+        with faults.scoped({"rules": [
+            {"site": "cluster.request", "op": "raise",
+             "exc": "ConnectionResetError", "count": 1},
+        ]}):
+            assert store.get("fp") is None  # error -> miss, not raise
+        tier.close()
+
+    def test_non_snapshot_values_not_published(self):
+        st = ClusterState()
+        tier = SharedResultTier(LocalClusterClient(st))
+        store = CacheStore(1 << 20, name="ns")
+        store.shared = tier
+        store.put("raw", {"not": "a snapshot"}, 8)
+        tier.flush()
+        assert st.result_get("raw") is None
+        tier.close()
+
+
+# -- chunked replay (satellite) -------------------------------------------
+
+
+class TestChunkedReplay:
+    def test_replay_respects_batch_size(self):
+        entry = CachedResult(
+            [np.arange(10, dtype=np.int64)], [None], [None], 10, 80
+        )
+        schema = Schema([Field("v", DataType.INT64, False)])
+        rel = CachedResultRelation(schema, entry, "fp", batch_size=4)
+        batches = list(rel.batches())
+        assert [b.num_rows for b in batches] == [4, 4, 2]
+        out = np.concatenate(
+            [np.asarray(b.data[0])[: b.num_rows] for b in batches]
+        )
+        np.testing.assert_array_equal(out, np.arange(10))
+        assert rel.stats.attrs.get("cache.batches") == 3
+
+    def test_cached_repeat_streams_chunks_and_matches(self, tmp_path):
+        schema = Schema([Field("v", DataType.INT64, False)])
+        path = str(tmp_path / "v.csv")
+        with open(path, "w") as f:
+            f.write("v\n" + "\n".join(str(i) for i in range(1000)) + "\n")
+        from datafusion_tpu import cache as qcache
+
+        with qcache.configured(enabled=True):
+            ctx = ExecutionContext(device="cpu", batch_size=256)
+            ctx.register_csv("t", path, schema)
+            cold = sorted(collect(ctx.sql("SELECT v FROM t WHERE v < 999")).to_rows())
+            rel = ctx.sql("SELECT v FROM t WHERE v < 999")
+            assert isinstance(rel, CachedResultRelation)
+            batches = list(rel.batches())
+            assert len(batches) == 4  # 999 rows in 256-row chunks
+            assert all(b.num_rows <= 256 for b in batches)
+            rel2 = ctx.sql("SELECT v FROM t WHERE v < 999")
+            assert sorted(collect(rel2).to_rows()) == cold
+
+
+# -- integration: workers + coordinators over one control plane ----------
+
+
+DSCHEMA = Schema(
+    [Field("region", DataType.UTF8, False), Field("v", DataType.INT64, False)]
+)
+DSQL = "SELECT region, COUNT(1), SUM(v) FROM t GROUP BY region"
+
+
+def _write_parts(tmp_path, n=2, rows=400):
+    rng = np.random.default_rng(11)
+    paths = []
+    for p in range(n):
+        path = tmp_path / f"part{p}.csv"
+        with open(path, "w") as f:
+            f.write("region,v\n")
+            for _ in range(rows):
+                f.write(f"r{rng.integers(0, 4)},{rng.integers(-50, 50)}\n")
+        paths.append(str(path))
+    return paths
+
+
+def _register(ctx, paths):
+    ctx.register_datasource(
+        "t",
+        PartitionedDataSource(
+            [CsvDataSource(p, DSCHEMA, True, 131072) for p in paths]
+        ),
+    )
+    return ctx
+
+
+class _Cluster:
+    """Two in-process workers registered on one shared ClusterState."""
+
+    def __init__(self, ttl_s=1.0):
+        self.state = ClusterState()
+        self.client = LocalClusterClient(self.state)
+        self.servers = []
+        for _ in range(2):
+            server = serve("127.0.0.1:0", device="cpu",
+                           cluster=self.client, lease_ttl_s=ttl_s)
+            threading.Thread(target=server.serve_forever, daemon=True).start()
+            self.servers.append(server)
+
+    def agent(self, i):
+        return self.servers[i].worker_state.cluster_agent
+
+    def kill(self, i):
+        """Abrupt worker death: no lease revocation — the TTL must
+        notice (SIGKILL semantics, in-process)."""
+        self.agent(i).stop()
+        self.servers[i].shutdown()
+        self.servers[i].server_close()
+
+    def close(self):
+        for server in self.servers:
+            agent = server.worker_state.cluster_agent
+            if agent is not None:
+                agent.close()
+            try:
+                server.shutdown()
+                server.server_close()
+            except OSError:
+                pass
+
+
+@pytest.fixture()
+def cluster():
+    c = _Cluster()
+    try:
+        yield c
+    finally:
+        c.close()
+
+
+class TestClusterIntegration:
+    def test_worker_discovery_from_membership(self, cluster, tmp_path):
+        paths = _write_parts(tmp_path)
+        want = sorted(
+            collect(_register(ExecutionContext(device="cpu"), paths).sql(DSQL))
+            .to_rows()
+        )
+        with DistributedContext(cluster=cluster.client,
+                                result_cache=False) as ctx:
+            assert len(ctx.workers) == 2  # no explicit worker list
+            _register(ctx, paths)
+            assert sorted(collect(ctx.sql(DSQL)).to_rows()) == want
+
+    def test_two_coordinators_converge_after_kill(self, cluster, tmp_path):
+        """The acceptance bar: a worker dies abruptly; both coordinators
+        observe the SAME bumped epoch within one lease TTL, and their
+        heartbeat monitors flip the dead worker without probing."""
+        paths = _write_parts(tmp_path)
+        ca = DistributedContext(cluster=cluster.client, result_cache=False)
+        cb = DistributedContext(cluster=cluster.client, result_cache=False)
+        try:
+            e0 = ca.cluster_epoch()
+            assert e0 == cb.cluster_epoch() == 2  # two joins
+            killed_addr = cluster.agent(0).addr
+            cluster.kill(0)
+            deadline = time.monotonic() + 5.0  # TTL 1s + CI slack
+            while time.monotonic() < deadline:
+                ca.cluster_epoch()
+                if killed_addr not in ca.membership.live_addresses():
+                    break
+                time.sleep(0.05)
+            # both coordinators observe the same bumped epoch from the
+            # same shared view (>= tolerates unrelated churn of the
+            # survivor's lease under a stalled CI machine)
+            assert ca.cluster_epoch() >= e0 + 1
+            assert cb.cluster_epoch() == ca.cluster_epoch()
+            assert killed_addr not in cb.membership.live_addresses()
+            mon_a = HeartbeatMonitor(ca.workers, membership=ca.membership)
+            mon_a.poll_once()
+            assert sum(w.alive for w in ca.workers) == 1
+            # queries keep working on the survivor
+            want = sorted(
+                collect(
+                    _register(ExecutionContext(device="cpu"), paths).sql(DSQL)
+                ).to_rows()
+            )
+            _register(ca, paths)
+            assert sorted(collect(ca.sql(DSQL)).to_rows()) == want
+        finally:
+            ca.close()
+            cb.close()
+
+    def test_shared_tier_warm_hit_across_coordinators(self, cluster, tmp_path):
+        """A query warm in coordinator A's result cache is a shared-tier
+        hit in coordinator B: no fragment dispatch, `cache.shared=True`
+        in the replay relation, `coord.shared_cache_hits` counted."""
+        from datafusion_tpu import cache as qcache
+
+        paths = _write_parts(tmp_path)
+        with qcache.configured(enabled=True):
+            ca = DistributedContext(cluster=cluster.client)
+            cb = DistributedContext(cluster=cluster.client)
+            try:
+                _register(ca, paths)
+                _register(cb, paths)
+                want = sorted(collect(ca.sql(DSQL)).to_rows())
+                assert ca._shared_tier.flush(timeout_s=10.0)
+                base = METRICS.counts.get("coord.shared_cache_hits", 0)
+                rel = cb.sql(DSQL)
+                assert isinstance(rel, CachedResultRelation)
+                assert rel.entry.shared
+                assert "cache.shared" in rel.stats.attrs
+                assert sorted(collect(rel).to_rows()) == want
+                assert METRICS.counts["coord.shared_cache_hits"] == base + 1
+                # B's stats history records the warm run as a hit
+                runs = cb.stats_history(cb.last_fingerprint)
+                assert runs and runs[-1]["cache_hit"] is True
+            finally:
+                ca.close()
+                cb.close()
+
+    def test_invalidation_broadcast_beats_ttl(self, cluster, tmp_path):
+        """A worker's stale fragment-cache entry dies on the lease
+        refresh FOLLOWING the broadcast — the fragment cache TTL (5
+        minutes by default) never has to pass."""
+        paths = _write_parts(tmp_path)
+        with DistributedContext(cluster=cluster.client,
+                                result_cache=False) as ctx:
+            _register(ctx, paths)
+            collect(ctx.sql(DSQL))
+            caches = [s.worker_state.fragment_cache for s in cluster.servers]
+            assert sum(c.entries for c in caches) >= 2  # one per partition
+            dropped_shared = ctx.broadcast_invalidate("t")
+            assert dropped_shared == 0  # result cache off in this test
+            for i in range(2):
+                cluster.agent(i).poll_once()  # the next heartbeat
+            assert all(c.entries == 0 for c in caches)
+            assert METRICS.counts.get(
+                "worker.cluster_invalidations_applied", 0) >= 2
+
+    def test_reregistration_broadcasts(self, cluster, tmp_path):
+        paths = _write_parts(tmp_path)
+        with DistributedContext(cluster=cluster.client,
+                                result_cache=False) as ctx:
+            _register(ctx, paths)
+            collect(ctx.sql(DSQL))
+            caches = [s.worker_state.fragment_cache for s in cluster.servers]
+            assert sum(c.entries for c in caches) >= 2
+            _register(ctx, paths)  # re-register the same name
+            for i in range(2):
+                cluster.agent(i).poll_once()
+            assert all(c.entries == 0 for c in caches)
+
+    def test_lease_expiry_chaos_reregisters(self, cluster):
+        """Chaos: injected heartbeat failures outlast the TTL; the lease
+        expires (leave event, epoch bump), and the recovering agent
+        re-registers with a cleared fragment cache (it may have missed
+        invalidations while deregistered)."""
+        agent = cluster.agent(0)
+        agent.stop()  # drive the heartbeat by hand
+        cache = cluster.servers[0].worker_state.fragment_cache
+        cache.put("stale", b"x", 1)
+        view = MembershipView(cluster.client).refresh()
+        e0 = view.epoch
+        with faults.scoped({"rules": [
+            {"site": "cluster.lease.refresh", "op": "raise",
+             "exc": "ConnectionResetError", "count": 3,
+             "where": {"addr": agent.addr}},
+        ]}):
+            for _ in range(3):
+                with pytest.raises(ConnectionError):
+                    agent.poll_once()
+        # hold the OTHER worker's lease alive while this one lapses
+        time.sleep(1.1)
+        cluster.agent(1).poll_once()
+        view = MembershipView(cluster.client).refresh()
+        assert view.epoch > e0  # the leave was observed fleet-wide
+        assert agent.addr not in view.live_addresses()
+        agent.poll_once()  # recovery: re-register
+        assert agent.reregistrations == 1
+        assert cache.entries == 0  # suspect cache cleared on resync
+        view.refresh()
+        assert agent.addr in view.live_addresses()
+
+    def test_off_means_off(self, tmp_path, monkeypatch):
+        """No cluster configured: no client, no membership, no shared
+        tier, no new threads — the existing paths byte-identical."""
+        monkeypatch.delenv("DATAFUSION_TPU_CLUSTER", raising=False)
+        ctx = DistributedContext([("127.0.0.1", 1)], result_cache=False)
+        assert ctx.cluster is None and ctx.membership is None
+        assert ctx._shared_tier is None
+        with pytest.raises(Exception):
+            ctx.cluster_epoch()
+        assert ctx.sync_workers() == []
+        assert ctx.broadcast_invalidate("t") == 0
+        server = serve("127.0.0.1:0", device="cpu")
+        try:
+            assert server.worker_state.cluster_agent is None
+        finally:
+            server.server_close()
+
+    def test_worker_status_and_gauges_carry_cluster_block(self, cluster):
+        state = cluster.servers[0].worker_state
+        snap = state.status()["cluster"]
+        assert snap["registered"] and snap["lease_age_s"] is not None
+        gauges = state._gauges()
+        assert gauges["cluster.lease_ttl_s"] == 1.0
+        assert gauges["cluster.lease_age_s"] >= 0
+
+    def test_coordinator_metrics_text_has_cluster_gauges(self, cluster):
+        with DistributedContext(cluster=cluster.client,
+                                result_cache=False) as ctx:
+            text = ctx.metrics_text()
+            assert "cluster_epoch" in text
+            assert "cluster_watch_lag_s" in text
+
+    def test_sync_workers_discovers_late_joiner(self, cluster):
+        with DistributedContext(cluster=cluster.client,
+                                result_cache=False) as ctx:
+            assert len(ctx.workers) == 2
+            server = serve("127.0.0.1:0", device="cpu",
+                           cluster=cluster.client, lease_ttl_s=1.0)
+            try:
+                added = ctx.sync_workers()
+                assert len(added) == 1 and len(ctx.workers) == 3
+                assert ctx.sync_workers() == []  # idempotent
+            finally:
+                server.worker_state.cluster_agent.close()
+                server.server_close()
