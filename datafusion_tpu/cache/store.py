@@ -154,6 +154,31 @@ class CacheStore:
             self.shared.store(key, value, nbytes, tags)
         return True
 
+    def peek(self, key: str) -> Optional[Any]:
+        """Value for `key` without touching hit/miss counters, LRU
+        order, or the shared tier — replication reads (the cluster
+        service attaching result values to a log-shipping response)
+        must not skew the cache's own statistics."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None or (entry.expires is not None
+                                 and now >= entry.expires):
+                return None
+            return entry.value
+
+    def export_entries(self) -> list:
+        """Snapshot of every live entry as (key, value, nbytes, tags)
+        tuples, MRU last — the cluster service's full-state snapshot
+        uses this to ship the result tier to a catching-up standby."""
+        now = time.monotonic()
+        with self._lock:
+            return [
+                (k, e.value, e.nbytes, e.tags)
+                for k, e in self._entries.items()
+                if e.expires is None or now < e.expires
+            ]
+
     def invalidate(self, key: str) -> bool:
         with self._lock:
             entry = self._entries.pop(key, None)

@@ -150,6 +150,14 @@ class Console:
             f"{len(status['workers'])} live worker(s), "
             f"service up {status['uptime_s']}s"
         )
+        if "role" in status:
+            lag = status.get("replication_lag_revisions", 0)
+            self._print(
+                f"Replica role {status['role']}, term {status.get('term')}, "
+                f"replication lag {lag} revision(s)"
+                + (f", standby of {status['standby_of']}"
+                   if status.get("standby_of") else "")
+            )
         for addr, info in sorted(status["workers"].items()):
             self._print(
                 f"  worker {addr}: lease age {info.get('lease_age_s')}s"

@@ -1,19 +1,21 @@
-"""Cluster control plane: shared membership, lease KV, cache coherence.
+"""Cluster control plane: shared membership, lease KV, cache coherence —
+replicated, with primary/standby failover.
 
 The reference scaffolded an etcd-based distributed mode — membership
 and worker discovery wired into `scripts/smoketest.sh:30-66` and named
 in `README.md:33-35` — then commented it out because distributed mode
 never worked.  This package is a lightweight, TPU-native realization of
 that intent over the engine's own versioned wire protocol (CRC'd
-frames, `parallel/wire.py`): one small `ClusterStateService` holds a
+frames, `parallel/wire.py`): a small `ClusterStateService` holds a
 lease-based KV that three concerns ride together ("namespaces on one
 bus"):
 
 - ``workers/<addr>``        worker membership.  A worker registers its
   address under a TTL lease and refreshes it from a heartbeat thread;
   a lease that lapses drops the key and bumps the membership *epoch*.
-  Coordinators subscribe through a `MembershipView` instead of each
-  privately probing every worker (`cluster/membership.py`).
+  Coordinators subscribe through a `MembershipView` — long-poll push
+  watches when cluster mode is on, so a join/leave reaches every
+  watcher one round trip after it happens (`cluster/membership.py`).
 - ``cache/invalidate/*``    coordinator-driven fragment-cache
   invalidation broadcast.  Events append to a revision-numbered log;
   workers pick them up piggybacked on their next lease refresh (one
@@ -23,25 +25,45 @@ bus"):
   existing plan fingerprint (`cache/fingerprint.py`), so a fleet of
   coordinators behind a load balancer gets warm hits from each other's
   queries (`cluster/shared_cache.py` plugs it into `CacheStore` as a
-  read-through/write-behind tier).
+  read-through/write-behind tier; snapshots cross the wire as CRC'd
+  RAW binary segments, not inline base64).
 
-Deployment shapes: in-process (`ClusterState` + `LocalClusterClient` —
-tests, single-binary demos) or standalone TCP service
-(``python -m datafusion_tpu.cluster --bind host:port``) that workers
-and coordinators dial with `ClusterClient`.
+**HA** (`cluster/service.py`): the service replicates.  A standby
+instance (``--standby-of``) tails the primary's revision-numbered event
+log (log-shipping, with full-state snapshots for catch-up after
+truncation), promotes itself on primary silence via a lease-based
+election, and re-arms every replicated lease on takeover — so a SIGKILL
+of the primary costs a gauge blip, not a membership outage or a cold
+shared cache.  A monotonically increasing **term** fences the deposed
+primary: every mutation is term-stamped, stale-term writes are
+rejected, and the peer term-exchange (``--peers``) demotes a revived
+old primary before it can split-brain the KV.  Clients take a
+comma-separated endpoint list and fail over automatically
+(redirect-on-``not_primary``, capped-backoff sweeps).
+
+Deployment shapes: in-process (`ClusterState` / `ClusterNode` +
+`LocalClusterClient` — tests, single-binary demos) or standalone TCP
+services (``python -m datafusion_tpu.cluster --bind host:port
+[--standby-of host:port] [--peers h1:p1,h2:p2]``) that workers and
+coordinators dial with `ClusterClient`.
 
 Env knobs (all off by default = zero overhead, zero new threads or
 sockets; existing single-coordinator paths are byte-identical):
 
-    DATAFUSION_TPU_CLUSTER            service address host:port; set on
+    DATAFUSION_TPU_CLUSTER            service address(es), comma-
+                                      separated host:port list; set on
                                       coordinators AND workers
     DATAFUSION_TPU_CLUSTER_TTL_S      worker lease TTL (default 10)
+    DATAFUSION_TPU_CLUSTER_ELECTION_S standby promotes after this much
+                                      primary silence (default TTL/2)
     DATAFUSION_TPU_CLUSTER_CACHE_BYTES  shared result tier byte budget
                                       (default 256 MiB)
 
 Fault sites (`testing/faults.py`): ``cluster.request`` (service
 partition), ``cluster.lease.refresh`` (lease expiry), ``cluster.watch``
-(stale membership view).
+(stale membership view), ``cluster.replicate`` (log-shipping failure),
+``cluster.election`` (promotion abort), ``cluster.snapshot`` (catch-up
+snapshot failure).
 """
 
 from __future__ import annotations
@@ -54,6 +76,7 @@ from datafusion_tpu.cluster.client import (  # noqa: F401 — subsystem API
     LocalClusterClient,
 )
 from datafusion_tpu.cluster.service import (  # noqa: F401
+    ClusterNode,
     ClusterState,
     ClusterStateService,
     serve,
@@ -64,7 +87,8 @@ DEFAULT_CACHE_BYTES = 256 << 20
 
 
 def cluster_address() -> Optional[str]:
-    """The env-configured service address, or None (cluster mode off)."""
+    """The env-configured service address (possibly a comma-separated
+    endpoint list), or None (cluster mode off)."""
     return os.environ.get("DATAFUSION_TPU_CLUSTER") or None
 
 
@@ -73,16 +97,31 @@ def lease_ttl_s() -> float:
     return float(env) if env else DEFAULT_LEASE_TTL_S
 
 
+def election_timeout_s() -> float:
+    """How long a standby tolerates primary silence before promoting
+    itself.  Defaults to half the lease TTL so a takeover (plus the
+    lease re-arm it performs) completes within one TTL of the kill —
+    the acceptance bar for 'coordinators never notice'."""
+    env = os.environ.get("DATAFUSION_TPU_CLUSTER_ELECTION_S", "")
+    if env:
+        return float(env)
+    return max(0.5, lease_ttl_s() / 2.0)
+
+
 def connect(target):
-    """A client for `target`: a "host:port" string dials the TCP
-    service, a `ClusterState` wraps in-process, an existing client
-    passes through — so every cluster-aware constructor takes one
-    `cluster=` argument regardless of deployment shape."""
+    """A client for `target`: a "host:port[,host:port...]" string dials
+    the TCP service fleet (failover order = list order), a
+    `ClusterState`/`ClusterNode` (or list of them) wraps in-process, an
+    existing client passes through — so every cluster-aware constructor
+    takes one `cluster=` argument regardless of deployment shape."""
     if isinstance(target, (ClusterClient, LocalClusterClient)):
         return target
-    if isinstance(target, ClusterState):
+    if isinstance(target, (ClusterState, ClusterNode)):
         return LocalClusterClient(target)
+    if isinstance(target, (list, tuple)) and target and all(
+        isinstance(t, (ClusterState, ClusterNode)) for t in target
+    ):
+        return LocalClusterClient(list(target))
     if isinstance(target, str):
-        host, _, port = target.partition(":")
-        return ClusterClient(host or "127.0.0.1", int(port))
+        return ClusterClient(target)
     raise TypeError(f"cannot connect to cluster target {target!r}")

@@ -15,6 +15,13 @@ fault site) re-registers from scratch — the membership epoch records
 the leave/join pair, and the agent clears the fragment cache first
 because it may have missed invalidation events while deregistered
 (the event log is only guaranteed to cover a held lease).
+
+HA: the client underneath handles primary failover (multi-endpoint
+sweep + redirect-on-``not_primary``), and a promoted standby re-arms
+every replicated lease with a fresh TTL on takeover — so a primary
+SIGKILL costs at most one errored heartbeat cycle, never the lease.
+The agent tracks the leadership ``term`` it last observed
+(`cluster.term` gauge): a bump is the visible trace of a failover.
 """
 
 from __future__ import annotations
@@ -49,6 +56,7 @@ class WorkerClusterAgent:
         self.lease: Optional[str] = None
         self.last_rev = 0
         self.epoch = -1
+        self.term = 0  # leadership term last observed (bumps on failover)
         self.events_applied = 0
         self.reregistrations = 0
         self._lease_refreshed: Optional[float] = None
@@ -91,6 +99,23 @@ class WorkerClusterAgent:
             resp = self.client.lease_refresh(self.lease, since=self.last_rev)
         self._lease_refreshed = time.monotonic()
         self.epoch = resp.get("epoch", self.epoch)
+        new_term = int(resp.get("term", self.term))
+        if self.term and new_term > self.term:
+            # the control plane failed over under us; the lease
+            # survived (the new primary re-armed it) — just record it
+            METRICS.add("worker.cluster_term_changes")
+        self.term = max(self.term, new_term)
+        if resp.get("rev", self.last_rev) < self.last_rev:
+            # the service's revision counter went BACKWARDS: a failover
+            # landed on a standby whose replicated log was behind what
+            # we had already consumed.  Events issued on the new
+            # primary at revisions <= our old cursor are filtered out
+            # of every future `since` tail — unobservable, exactly like
+            # a truncation — so the cache is suspect and must clear
+            cache = self.worker_state.fragment_cache
+            if cache is not None:
+                cache.clear()
+            METRICS.add("worker.cluster_rev_regressions")
         if resp.get("truncated"):
             # fell off the retained event window: same cache-suspect
             # resync as a lapsed lease
@@ -166,6 +191,7 @@ class WorkerClusterAgent:
             "cluster.lease_age_s": round(age, 3) if age is not None else -1,
             "cluster.lease_ttl_s": self.ttl_s,
             "cluster.epoch": self.epoch,
+            "cluster.term": self.term,
             "cluster.events_applied": self.events_applied,
         }
 
@@ -178,6 +204,7 @@ class WorkerClusterAgent:
             "lease_ttl_s": self.ttl_s,
             "lease_age_s": round(age, 3) if age is not None else None,
             "epoch": self.epoch,
+            "term": self.term,
             "events_applied": self.events_applied,
             "reregistrations": self.reregistrations,
         }

@@ -2,36 +2,122 @@
 (`LocalClusterClient`).
 
 Both expose the same typed surface over the same request dicts —
-`LocalClusterClient` routes them through `service.handle_request`
-directly, so in-process tests exercise the exact wire semantics minus
-the sockets.  The TCP client mirrors `WorkerHandle`'s discipline: one
-connection per request (the control plane is low-rate; no pooled
-sockets to leak), the `wire_version` CRC handshake, and a bounded
-connect timeout so a partitioned service surfaces as `ConnectionError`
-instead of a hang.
+`LocalClusterClient` routes them through the node's `handle_request`
+directly (fencing included: an in-process standby rejects writes with
+``not_primary`` exactly like a TCP one), so in-process tests exercise
+the exact wire semantics minus the sockets.  The TCP client mirrors
+`WorkerHandle`'s discipline: one connection per request (the control
+plane is low-rate; no pooled sockets to leak), the `wire_version` CRC
+handshake, and a bounded connect timeout so a partitioned service
+surfaces as `ConnectionError` instead of a hang.
 
-The fault site ``cluster.request`` fires per request with the request
-type as context — a chaos rule raising `ConnectionRefusedError` at
-``{"where": {"op": "membership"}}`` simulates a service partition for
-exactly the membership path.
+**HA failover** lives here, shared by both transports: a client holds a
+*list* of endpoints (``DATAFUSION_TPU_CLUSTER=host1:p1,host2:p2``), and
+every request sweeps them — a dead endpoint (`ConnectionError`/OSError)
+advances to the next; a ``not_primary`` rejection follows the replica's
+redirect hint; sweeps are separated by capped full-jitter backoff
+(`utils/retry.backoff_s`, the `TransientError` taxonomy's policy).  A
+primary kill therefore costs one retried round inside the client, not a
+failed lease refresh or membership poll.
+
+The fault site ``cluster.request`` fires per request attempt with the
+request type as context — a chaos rule raising
+`ConnectionRefusedError` at ``{"where": {"op": "membership"}}``
+simulates a partition of the whole endpoint set for exactly the
+membership path (the injection sits above the failover sweep: it
+models "the request failed after every endpoint", so rules keep their
+one-raise-one-failure determinism).  Per-endpoint chaos uses
+`ClusterNode.partitioned` (in-process) or a killed service process.
 """
 
 from __future__ import annotations
 
 import socket
+import time
 from typing import Any, Optional
 
-from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.errors import (
+    ClusterNotPrimaryError,
+    ExecutionError,
+    StaleTermError,
+)
 from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import backoff_s
+
+# full endpoint sweeps before a request gives up (per request, not per
+# client: the next request starts a fresh sweep at the active endpoint)
+_FAILOVER_SWEEPS = 3
+
+
+def _raise_error_reply(out: dict) -> dict:
+    """Map an error reply onto the typed taxonomy (`not_primary` ->
+    transient redirect, `stale_term` -> permanent fence)."""
+    if out.get("type") == "error":
+        code = out.get("code")
+        if code == "not_primary":
+            raise ClusterNotPrimaryError(
+                f"cluster service: {out.get('message')}",
+                primary=out.get("primary"),
+            )
+        if code == "stale_term":
+            raise StaleTermError(f"cluster service: {out.get('message')}")
+        raise ExecutionError(f"cluster service: {out['message']}")
+    return out
 
 
 class _ClientApi:
-    """Typed helpers shared by both transports; subclasses implement
-    `request(msg) -> dict`."""
+    """Typed helpers + the endpoint-failover sweep, shared by both
+    transports; subclasses implement `_endpoint_count()` and
+    `_request_endpoint(idx, msg, timeout, bw)`."""
 
-    def request(self, msg: dict) -> dict:  # pragma: no cover — interface
+    _active = 0
+
+    def _endpoint_count(self) -> int:  # pragma: no cover — interface
         raise NotImplementedError
+
+    def _request_endpoint(self, idx: int, msg: dict,
+                          timeout: Optional[float], bw=None,
+                          sent_box=None) -> dict:  # pragma: no cover — interface
+        raise NotImplementedError
+
+    def _endpoint_index_for(self, addr) -> Optional[int]:
+        """Index of the endpoint matching a redirect hint, if known."""
+        return None
+
+    def request(self, msg: dict, timeout: Optional[float] = None,
+                bw=None, sent_box: Optional[list] = None) -> dict:
+        """One request with the endpoint-failover sweep.  `sent_box`
+        (a caller-owned single-slot list) receives the byte count of
+        the attempt that succeeded — per call, so concurrent requests
+        on a shared client never read each other's sizes."""
+        n = self._endpoint_count()
+        max_attempts = n * _FAILOVER_SWEEPS
+        attempts = 0
+        last: Optional[Exception] = None
+        while True:
+            idx = self._active % n
+            faults.check("cluster.request", op=msg.get("type"), endpoint=idx)
+            try:
+                return self._request_endpoint(idx, msg, timeout, bw, sent_box)
+            except ClusterNotPrimaryError as e:
+                last = e
+                hinted = self._endpoint_index_for(e.primary)
+                self._active = hinted if hinted is not None else idx + 1
+                METRICS.add("cluster.client_redirects")
+            except (ConnectionError, OSError) as e:
+                last = e
+                self._active = idx + 1
+                METRICS.add("cluster.client_failovers")
+            attempts += 1
+            if attempts >= max_attempts:
+                raise last
+            if attempts % n == 0:
+                # a full sweep failed (dead primary, election still in
+                # flight): back off before the next one — capped, full
+                # jitter, same policy as every other transient retry
+                time.sleep(backoff_s(attempts // n, base=0.05, cap=0.5))
 
     def ping(self) -> bool:
         try:
@@ -72,6 +158,16 @@ class _ClientApi:
     def events_since(self, since: int) -> dict:
         return self.request({"type": "events", "since": since})
 
+    def watch(self, since: int, timeout_s: float = 10.0) -> dict:
+        """Long-poll push watch: the service answers on the next
+        membership/invalidation event past `since`, or at `timeout_s`.
+        The socket timeout is widened past the park interval so the
+        park itself never reads as a dead service."""
+        return self.request(
+            {"type": "watch", "since": since, "timeout_s": timeout_s},
+            timeout=timeout_s + 10.0,
+        )
+
     def invalidate(self, table: str) -> dict:
         return self.request({"type": "invalidate", "table": table})
 
@@ -85,48 +181,150 @@ class _ClientApi:
     def result_get(self, key: str) -> dict:
         return self.request({"type": "result_get", "key": key})
 
+    def result_publish(self, key: str, entry, nbytes: int,
+                       tables: tuple = ()) -> int:
+        """Publish a `CachedResult` snapshot; returns the bytes that
+        actually crossed the transport (the in-process client moves
+        references, not bytes)."""
+        from datafusion_tpu.cluster.shared_cache import result_raw
+
+        self.request({
+            "type": "result_put", "key": key,
+            "value": {"snapshot": result_raw(entry), "tables": list(tables)},
+            "nbytes": nbytes, "tables": list(tables),
+        })
+        return 0  # in-process: nothing serialized
+
+    def result_fetch(self, key: str):
+        """Fetch a published snapshot: (CachedResult, tables) or None."""
+        from datafusion_tpu.cluster.shared_cache import decode_result
+
+        out = self.result_get(key)
+        if not out.get("found"):
+            return None
+        value = out.get("value")
+        if not isinstance(value, dict):
+            return None
+        snap = value.get("snapshot")
+        if not isinstance(snap, dict) or "columns" not in snap:
+            return None
+        return decode_result(snap), tuple(value.get("tables") or ())
+
     def status(self) -> dict:
         return self.request({"type": "status"})
 
 
 class LocalClusterClient(_ClientApi):
-    """In-process client over a shared `ClusterState` — the deployment
-    shape for tests and single-binary demos (several coordinators and
-    embedded workers sharing one state object)."""
+    """In-process client over shared `ClusterNode`s (a bare
+    `ClusterState` wraps in an implicit primary node) — the deployment
+    shape for tests and single-binary demos.  Accepts a list of nodes
+    for in-process HA: the same failover sweep the TCP client runs,
+    with a `partitioned` node raising the `ConnectionRefusedError` a
+    dead endpoint would."""
 
-    def __init__(self, state):
-        self.state = state
+    def __init__(self, target):
+        from datafusion_tpu.cluster.service import ClusterNode, ClusterState
+
+        def as_node(t):
+            if isinstance(t, ClusterNode):
+                return t
+            if isinstance(t, ClusterState):
+                return ClusterNode(state=t)
+            raise TypeError(f"cannot serve cluster target {t!r} in-process")
+
+        targets = target if isinstance(target, (list, tuple)) else [target]
+        if not targets:
+            raise ValueError("LocalClusterClient needs at least one node")
+        self.nodes = [as_node(t) for t in targets]
+        self._active = 0
+
+    @property
+    def state(self):
+        """The first node's state machine (single-node back-compat)."""
+        return self.nodes[0].state
 
     def __repr__(self):
-        return f"LocalClusterClient({self.state!r})"
+        return f"LocalClusterClient({self.nodes!r})"
 
-    def request(self, msg: dict) -> dict:
-        from datafusion_tpu.cluster.service import handle_request
+    def _endpoint_count(self) -> int:
+        return len(self.nodes)
 
-        faults.check("cluster.request", op=msg.get("type"))
-        out = handle_request(self.state, msg)
-        if out.get("type") == "error":
-            raise ExecutionError(f"cluster service: {out['message']}")
-        return out
+    def _endpoint_index_for(self, addr) -> Optional[int]:
+        if addr is None:
+            return None
+        for i, node in enumerate(self.nodes):
+            if node.addr == addr or node is addr:
+                return i
+        return None
+
+    def _request_endpoint(self, idx: int, msg: dict,
+                          timeout: Optional[float], bw=None,
+                          sent_box=None) -> dict:
+        node = self.nodes[idx]
+        if node.partitioned:
+            raise ConnectionRefusedError(
+                f"cluster node {node.addr or idx} is partitioned (injected)"
+            )
+        return _raise_error_reply(node.handle_request(msg))
 
 
 class ClusterClient(_ClientApi):
-    """TCP client for a standalone `ClusterStateService`."""
+    """TCP client for one or more `ClusterStateService` replicas."""
 
-    def __init__(self, host: str, port: int,
+    def __init__(self, host, port: Optional[int] = None,
                  request_timeout: Optional[float] = 10.0):
-        self.host = host
-        self.port = port
+        if port is not None:
+            endpoints = [(host, int(port))]
+        elif isinstance(host, str):
+            endpoints = []
+            for spec in host.split(","):
+                spec = spec.strip()
+                if not spec:
+                    continue
+                h, _, p = spec.rpartition(":")
+                endpoints.append((h or "127.0.0.1", int(p)))
+        else:
+            endpoints = [(h, int(p)) for h, p in host]
+        if not endpoints:
+            raise ValueError(f"no cluster endpoints in {host!r}")
+        self.endpoints = endpoints
         self.request_timeout = request_timeout
+        self._active = 0
 
     def __repr__(self):
-        return f"ClusterClient({self.host}:{self.port})"
+        return f"ClusterClient({self.address})"
+
+    @property
+    def host(self) -> str:
+        return self.endpoints[self._active % len(self.endpoints)][0]
+
+    @property
+    def port(self) -> int:
+        return self.endpoints[self._active % len(self.endpoints)][1]
 
     @property
     def address(self) -> str:
-        return f"{self.host}:{self.port}"
+        return ",".join(f"{h}:{p}" for h, p in self.endpoints)
 
-    def request(self, msg: dict) -> dict:
+    def _endpoint_count(self) -> int:
+        return len(self.endpoints)
+
+    def _endpoint_index_for(self, addr) -> Optional[int]:
+        if not isinstance(addr, str) or ":" not in addr:
+            return None
+        h, _, p = addr.rpartition(":")
+        try:
+            target = (h, int(p))
+        except ValueError:
+            return None
+        for i, ep in enumerate(self.endpoints):
+            if ep == target:
+                return i
+        return None
+
+    def _request_endpoint(self, idx: int, msg: dict,
+                          timeout: Optional[float], bw=None,
+                          sent_box=None) -> dict:
         from datafusion_tpu.parallel.wire import (
             CRC_ENABLED,
             WIRE_VERSION,
@@ -134,18 +332,37 @@ class ClusterClient(_ClientApi):
             send_msg,
         )
 
-        faults.check("cluster.request", op=msg.get("type"))
         if CRC_ENABLED and "wire_version" not in msg:
             msg = {**msg, "wire_version": WIRE_VERSION}
-        with obs_trace.span("cluster.request", op=msg.get("type")):
-            with socket.create_connection(
-                (self.host, self.port), timeout=5.0
-            ) as s:
-                s.settimeout(self.request_timeout)
-                send_msg(s, msg)
+        host, port = self.endpoints[idx]
+        with obs_trace.span("cluster.request", op=msg.get("type"),
+                            endpoint=f"{host}:{port}"):
+            with socket.create_connection((host, port), timeout=5.0) as s:
+                s.settimeout(timeout if timeout is not None
+                             else self.request_timeout)
+                sent = send_msg(s, msg, bw, crc=CRC_ENABLED)
+                if sent_box is not None:
+                    sent_box[0] = sent
                 out = recv_msg(s)
         if out is None:
             raise ConnectionError("cluster service closed the connection")
-        if out.get("type") == "error":
-            raise ExecutionError(f"cluster service: {out['message']}")
-        return out
+        return _raise_error_reply(out)
+
+    def result_publish(self, key: str, entry, nbytes: int,
+                       tables: tuple = ()) -> int:
+        """Publish with the snapshot columns as RAW binary wire
+        segments (CRC'd like any fragment payload) instead of inline
+        base64 JSON — for large results this is the difference between
+        shipping the bytes and shipping the bytes plus a third."""
+        from datafusion_tpu.cluster.shared_cache import raw_to_wire, result_raw
+        from datafusion_tpu.parallel.wire import BinWriter
+
+        bw = BinWriter()
+        wire_snap = raw_to_wire(result_raw(entry), bw)
+        sent_box = [0]
+        self.request({
+            "type": "result_put", "key": key,
+            "value": {"snapshot": wire_snap, "tables": list(tables)},
+            "nbytes": nbytes, "tables": list(tables),
+        }, bw=bw, sent_box=sent_box)
+        return sent_box[0]

@@ -11,6 +11,19 @@ plus the live worker set — the view all coordinators share.  The
 unaffected (a coordinator facing an all-dead view still probes workers
 directly before failing a query).
 
+**Push watch**: `watch(timeout_s)` parks a long-poll at the view's last
+seen revision — the service answers on the next membership or
+invalidation event (or at the timeout) with the event tail AND the
+fresh membership in one response, so a join/leave reaches every watcher
+one round trip after it happens instead of one poll interval later.
+The heartbeat monitor uses it when cluster mode is on; `poll()` remains
+for callers that want an immediate pull.
+
+**Change callbacks**: `subscribe(fn)` registers a callback fired (from
+whatever thread refreshed the view) whenever the epoch moves —
+`DistributedContext` hangs its automatic `sync_workers()` off this, so
+a fleet scales out and shrinks with zero coordinator intervention.
+
 A refresh that cannot reach the service keeps the last view (stale
 liveness beats no liveness) and the staleness is observable: the
 ``cluster.watch_lag_s`` gauge is the age of the last successful
@@ -22,7 +35,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from datafusion_tpu.errors import ExecutionError
 from datafusion_tpu.obs import trace as obs_trace
@@ -37,10 +50,38 @@ class MembershipView:
         self.client = client
         self.epoch = -1  # -1 = never refreshed
         self.rev = 0
+        self.term = 0  # leadership term last observed on the service
         self.workers: dict[str, dict] = {}  # addr -> info (lease_age_s, ...)
         self._lock = threading.Lock()
         self._last_refresh: Optional[float] = None
         self.refresh_errors = 0
+        self._callbacks: list[Callable[["MembershipView"], None]] = []
+
+    def subscribe(self, fn: Callable[["MembershipView"], None]) -> None:
+        """Call `fn(view)` after every refresh/watch that observed an
+        epoch change (runs on the refreshing thread — keep it cheap and
+        re-entrant-safe; it must NOT call `poll`/`refresh` itself)."""
+        self._callbacks.append(fn)
+
+    def _ingest(self, out: dict) -> bool:
+        """Fold a membership-bearing response into the view; returns
+        whether the epoch moved (and fires subscribers if so)."""
+        with self._lock:
+            changed = out["epoch"] != self.epoch
+            if changed:
+                METRICS.add("coord.membership_epoch_changes")
+            self.epoch = out["epoch"]
+            self.rev = out.get("rev", self.rev)
+            self.term = out.get("term", self.term)
+            self.workers = out.get("workers", {})
+            self._last_refresh = time.monotonic()
+        if changed:
+            for fn in self._callbacks:
+                try:
+                    fn(self)
+                except Exception:  # noqa: BLE001 — a bad subscriber must not kill the watch
+                    METRICS.add("coord.membership_callback_errors")
+        return changed
 
     def refresh(self) -> "MembershipView":
         """Pull the current view from the service.  Raises
@@ -49,13 +90,7 @@ class MembershipView:
         faults.check("cluster.watch", epoch=self.epoch)
         with obs_trace.span("cluster.watch", epoch=self.epoch):
             out = self.client.membership()
-        with self._lock:
-            if out["epoch"] != self.epoch:
-                METRICS.add("coord.membership_epoch_changes")
-            self.epoch = out["epoch"]
-            self.rev = out.get("rev", self.rev)
-            self.workers = out.get("workers", {})
-            self._last_refresh = time.monotonic()
+        self._ingest(out)
         return self
 
     def poll(self) -> bool:
@@ -69,6 +104,25 @@ class MembershipView:
                 self.refresh_errors += 1
             METRICS.add("coord.membership_refresh_errors")
             return False
+
+    def watch(self, timeout_s: float = 10.0) -> bool:
+        """Park a long-poll at the last seen revision; the view updates
+        the moment the service logs a membership/invalidation event.
+        Returns True when the view refreshed (event OR clean timeout —
+        both carry a fresh membership), False when the service was
+        unreachable (stale view kept, like `poll`)."""
+        faults.check("cluster.watch", epoch=self.epoch)
+        try:
+            with obs_trace.span("cluster.watch", epoch=self.epoch,
+                                long_poll=True):
+                out = self.client.watch(self.rev, timeout_s=timeout_s)
+        except (ConnectionError, OSError, ExecutionError):
+            with self._lock:
+                self.refresh_errors += 1
+            METRICS.add("coord.membership_refresh_errors")
+            return False
+        self._ingest(out)
+        return True
 
     def live_addresses(self) -> set[str]:
         with self._lock:
@@ -88,6 +142,7 @@ class MembershipView:
         with self._lock:
             return {
                 "cluster.epoch": self.epoch,
+                "cluster.term": self.term,
                 "cluster.workers_live": len(self.workers),
                 "cluster.watch_lag_s": round(lag, 3) if lag is not None else -1,
                 "cluster.watch_errors": self.refresh_errors,
@@ -95,6 +150,6 @@ class MembershipView:
 
     def __repr__(self):
         return (
-            f"MembershipView(epoch={self.epoch}, "
+            f"MembershipView(epoch={self.epoch}, term={self.term}, "
             f"workers={sorted(self.workers)})"
         )

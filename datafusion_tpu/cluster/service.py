@@ -1,9 +1,12 @@
-"""The cluster state service: a lease-based KV with a membership epoch.
+"""The cluster state service: a replicated lease-KV with a membership
+epoch, leadership terms, and primary/standby failover.
 
 `ClusterState` is the pure, thread-safe state machine (run it in-process
-for tests); `ClusterStateService` serves it over TCP reusing the
-engine's versioned wire protocol (`parallel/wire.py` length-prefixed
-frames — requests advertise `wire_version` and corrupt frames raise
+for tests); `ClusterNode` wraps it with a replication *role* (primary or
+standby), term fencing, and the log-shipping machinery; and
+`ClusterStateService` serves a node over TCP reusing the engine's
+versioned wire protocol (`parallel/wire.py` length-prefixed frames —
+requests advertise `wire_version` and corrupt frames raise
 `ProtocolError`, exactly like the fragment protocol).
 
 Semantics (the useful subset of etcd's):
@@ -17,18 +20,35 @@ Semantics (the useful subset of etcd's):
 - **Epoch**: a counter bumped by every membership change (a
   ``workers/*`` key appearing or disappearing).  Two coordinators that
   observe the same epoch observed the same worker set.
-- **Event log**: revision-numbered, bounded; carries membership changes
-  and ``cache/invalidate`` broadcasts.  Consumers poll with their last
-  seen revision (`events_since`); a consumer that fell off the retained
-  window gets `truncated=True` and should resync from scratch.
+- **Event log**: revision-numbered, bounded.  Every mutation appends an
+  event — membership joins/leaves and ``cache/invalidate`` broadcasts
+  (the *client-visible* kinds), plus grants, puts, deletes, and result
+  publications (the replication kinds a standby needs to mirror the
+  whole state machine).  Client consumers poll with their last seen
+  revision (`events_since`) and see only the client-visible kinds; a
+  consumer that fell off the retained window gets `truncated=True` and
+  resyncs from scratch.  A standby tails the FULL log (`replicate_pull`)
+  and falls back to a complete state snapshot after truncation.
+- **Term**: a monotonically increasing leadership counter, stamped on
+  every event.  A standby that promotes itself bumps the term; writes
+  carrying an explicit stale term are rejected (`StaleTermError`), and
+  the term exchange on every replication/peer round demotes a revived
+  old primary before it can split-brain the KV.
+- **Watches**: ``watch(since, timeout_s)`` parks until a client-visible
+  event lands past `since` (or the timeout lapses) and answers with the
+  event tail plus the current membership — long-poll push, so watch lag
+  is one network round trip instead of one poll interval.
 - **Result tier**: ``cache/result/<fingerprint>`` entries live in a
   byte-accounted `CacheStore` (LRU+TTL, tagged by table name) holding
-  wire-encoded snapshots — `invalidate(table)` drops dependent results
-  here and broadcasts the fragment-cache invalidation to workers.
+  result snapshots with raw numpy columns — `invalidate(table)` drops
+  dependent results here and broadcasts the fragment-cache invalidation
+  to workers.  Over TCP the columns travel as CRC'd binary RAW wire
+  segments, not inline base64.
 """
 
 from __future__ import annotations
 
+import math
 import os
 import socketserver
 import threading
@@ -37,9 +57,14 @@ import uuid
 from typing import Any, Optional
 
 from datafusion_tpu.cache.store import CacheStore
+from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.metrics import METRICS
 
 _EVENT_LOG_CAP = 1024
+# event kinds surfaced to workers/coordinators (lease_refresh piggyback,
+# `events`, `watch`); the remaining kinds exist for log-shipping only
+CLIENT_EVENT_KINDS = ("join", "leave", "invalidate")
+_WATCH_TIMEOUT_CAP_S = 60.0
 
 
 class _Lease:
@@ -75,14 +100,20 @@ class ClusterState:
 
             result_cache_bytes = int(env) if env else DEFAULT_CACHE_BYTES
         self._lock = threading.Lock()
+        # watchers park here; notified on every appended event
+        self._watch_cond = threading.Condition(self._lock)
         self._kv: dict[str, _Key] = {}
         self._leases: dict[str, _Lease] = {}
         self._epoch = 0
         self._rev = 0
+        self.term = 1  # leadership term; stamped on every event
         self._events: list[dict] = []
         self._events_floor = 0  # oldest revision still in the log
+        # revision of the newest client-visible event — watchers'
+        # wakeup predicate is one comparison, not a log scan
+        self._last_client_rev = 0
         self.started = time.time()
-        # the shared result tier: wire-encoded snapshots, tagged by the
+        # the shared result tier: raw numpy snapshots, tagged by the
         # tables they scanned so invalidate(table) drops exactly them
         self.results = CacheStore(
             result_cache_bytes, result_ttl_s, name="cluster_result"
@@ -95,11 +126,20 @@ class ClusterState:
 
     def _append_event(self, kind: str, **payload) -> int:
         rev = self._next_rev()
-        self._events.append({"rev": rev, "kind": kind, **payload})
+        self._events.append(
+            {"rev": rev, "kind": kind, "term": self.term, **payload}
+        )
         if len(self._events) > _EVENT_LOG_CAP:
             del self._events[0]
         if self._events:
             self._events_floor = self._events[0]["rev"]
+        if kind in CLIENT_EVENT_KINDS:
+            # watchers only unpark for client-visible kinds; waking
+            # every parked handler thread per shared-tier publication
+            # or lease grant would be F wakeups + F log scans for
+            # nothing (standbys pull — they never park here)
+            self._last_client_rev = rev
+            self._watch_cond.notify_all()
         return rev
 
     def _is_member_key(self, key: str) -> bool:
@@ -127,6 +167,11 @@ class ClusterState:
                 lease.keys.discard(key)
                 self._drop_key(key, "lease_expired")
             del self._leases[lease.lease_id]
+            # non-member lease keys leave no per-key event; the
+            # lease_gone event lets a standby drop them too
+            self._append_event(
+                "lease_gone", lease=lease.lease_id, reason="lease_expired"
+            )
             METRICS.add("cluster.leases_expired")
 
     # -- leases --
@@ -138,10 +183,13 @@ class ClusterState:
         with self._lock:
             self._expire(now)
             self._leases[lease_id] = _Lease(lease_id, float(ttl_s), now)
+            self._append_event("lease_grant", lease=lease_id,
+                               ttl_s=float(ttl_s))
             METRICS.add("cluster.leases_granted")
             # a fresh registrant has no cache to invalidate: it resumes
             # the event log from *here*, not from history
-            return {"lease": lease_id, "ttl_s": float(ttl_s), "rev": self._rev}
+            return {"lease": lease_id, "ttl_s": float(ttl_s),
+                    "rev": self._rev, "term": self.term}
 
     def lease_refresh(self, lease_id: str, since: Optional[int] = None,
                       now: Optional[float] = None) -> dict:
@@ -152,15 +200,17 @@ class ClusterState:
             self._expire(now)
             lease = self._leases.get(lease_id)
             if lease is None:
-                return {"found": False, "epoch": self._epoch, "rev": self._rev}
+                return {"found": False, "epoch": self._epoch,
+                        "rev": self._rev, "term": self.term}
             lease.expires = now + lease.ttl_s
             for key in lease.keys:
                 entry = self._kv.get(key)
                 if entry is not None:
                     entry.refreshed = now
-            out: dict = {"found": True, "epoch": self._epoch, "rev": self._rev}
+            out: dict = {"found": True, "epoch": self._epoch,
+                         "rev": self._rev, "term": self.term}
             if since is not None:
-                out.update(self._events_since(since))
+                out.update(self._events_since(since, CLIENT_EVENT_KINDS))
             return out
 
     def lease_revoke(self, lease_id: str, now: Optional[float] = None) -> bool:
@@ -174,6 +224,9 @@ class ClusterState:
                 return False
             for key in sorted(lease.keys):
                 self._drop_key(key, "lease_revoked")
+            self._append_event(
+                "lease_gone", lease=lease_id, reason="lease_revoked"
+            )
             return True
 
     # -- KV --
@@ -197,9 +250,13 @@ class ClusterState:
             if joined:
                 self._epoch += 1
                 self._append_event(
-                    "join", key=key, addr=key.split("/", 1)[1]
+                    "join", key=key, addr=key.split("/", 1)[1],
+                    value=value, lease=lease,
                 )
                 METRICS.add("cluster.members_joined")
+            else:
+                # updates and non-member keys replicate via "put"
+                self._append_event("put", key=key, value=value, lease=lease)
             return entry.rev
 
     def get(self, key: str, now: Optional[float] = None) -> Optional[Any]:
@@ -215,7 +272,9 @@ class ClusterState:
             self._expire(now)
             if key not in self._kv:
                 return False
-            self._drop_key(key, "deleted")
+            self._drop_key(key, "deleted")  # member keys emit "leave"
+            if not self._is_member_key(key):
+                self._append_event("delete", key=key)
             return True
 
     def range(self, prefix: str, now: Optional[float] = None) -> dict:
@@ -227,6 +286,18 @@ class ClusterState:
             }
 
     # -- membership --
+    def _membership(self, now: float) -> dict:
+        # lock held
+        workers = {}
+        for key, entry in self._kv.items():
+            if not self._is_member_key(key):
+                continue
+            info = dict(entry.value) if isinstance(entry.value, dict) else {}
+            info["lease_age_s"] = round(now - entry.refreshed, 3)
+            workers[key.split("/", 1)[1]] = info
+        return {"epoch": self._epoch, "rev": self._rev, "term": self.term,
+                "workers": workers}
+
     def membership(self, now: Optional[float] = None) -> dict:
         """The shared view coordinators subscribe to: the epoch plus
         every live worker with its lease age (seconds since the owning
@@ -234,22 +305,15 @@ class ClusterState:
         now = time.monotonic() if now is None else now
         with self._lock:
             self._expire(now)
-            workers = {}
-            for key, entry in self._kv.items():
-                if not self._is_member_key(key):
-                    continue
-                info = dict(entry.value) if isinstance(entry.value, dict) else {}
-                info["lease_age_s"] = round(now - entry.refreshed, 3)
-                workers[key.split("/", 1)[1]] = info
-            return {"epoch": self._epoch, "rev": self._rev, "workers": workers}
+            return self._membership(now)
 
-    # -- events / invalidation --
-    def _events_since(self, since: int) -> dict:
+    # -- events / invalidation / watches --
+    def _events_since(self, since: int, kinds=None) -> dict:
         # lock held
-        out = {
-            "events": [e for e in self._events if e["rev"] > since],
-            "rev": self._rev,
-        }
+        events = [e for e in self._events if e["rev"] > since]
+        if kinds is not None:
+            events = [e for e in events if e["kind"] in kinds]
+        out = {"events": events, "rev": self._rev}
         if since and since + 1 < self._events_floor:
             # consumer fell off the retained window: it missed events it
             # can never fetch, so it must resync (drop caches) instead
@@ -257,11 +321,42 @@ class ClusterState:
             out["truncated"] = True
         return out
 
-    def events_since(self, since: int, now: Optional[float] = None) -> dict:
+    def events_since(self, since: int, now: Optional[float] = None,
+                     kinds=CLIENT_EVENT_KINDS) -> dict:
         now = time.monotonic() if now is None else now
         with self._lock:
             self._expire(now)
-            return self._events_since(since)
+            return self._events_since(since, kinds)
+
+    def watch(self, since: int, timeout_s: float,
+              now: Optional[float] = None) -> dict:
+        """Long-poll push watch: park until a client-visible event past
+        `since` lands (or `timeout_s` lapses), then answer with the
+        event tail AND the current membership in one response — a
+        watcher learns of a join/leave one round trip after it happens
+        instead of one poll interval later."""
+        timeout_s = max(0.0, min(float(timeout_s), _WATCH_TIMEOUT_CAP_S))
+
+        def pending() -> bool:
+            if since and since + 1 < self._events_floor:
+                return True  # truncated: answer now, the client resyncs
+            # O(1): every wakeup holds the global state lock, so a log
+            # scan here would serialize W watchers x 1024 entries
+            # against every KV/lease request
+            return self._last_client_rev > since
+
+        with self._watch_cond:
+            self._expire(time.monotonic() if now is None else now)
+            fired = self._watch_cond.wait_for(pending, timeout=timeout_s)
+            # a lease may have lapsed while we were parked and nothing
+            # else swept it: expire at wake so the timeout path still
+            # notices silent deaths
+            wake = time.monotonic() if now is None else now
+            self._expire(wake)
+            out = self._events_since(since, CLIENT_EVENT_KINDS)
+            out.update(self._membership(wake))
+            out["fired"] = bool(fired or out["events"])
+            return out
 
     def invalidate(self, table: str, now: Optional[float] = None) -> dict:
         """Coordinator-driven cache invalidation: drop shared-tier
@@ -278,12 +373,170 @@ class ClusterState:
     # -- shared result tier --
     def result_put(self, fingerprint: str, value: dict, nbytes: int,
                    tables: tuple = ()) -> bool:
-        return self.results.put(
+        stored = self.results.put(
             f"cache/result/{fingerprint}", value, nbytes, tags=tables
         )
+        if stored:
+            with self._lock:
+                self._append_event(
+                    "result_put", key=fingerprint, nbytes=int(nbytes),
+                    tables=list(tables),
+                )
+        return stored
 
     def result_get(self, fingerprint: str) -> Optional[dict]:
         return self.results.get(f"cache/result/{fingerprint}")
+
+    # -- replication (log shipping + snapshots) --
+    def apply_event(self, ev: dict, value: Any = None,
+                    now: Optional[float] = None) -> None:
+        """Apply one replicated event verbatim: state transitions mirror
+        the primary's, the event lands in OUR log under ITS revision
+        (so post-promotion consumers resume seamlessly), and leases get
+        an infinite local expiry — the primary decides lease life; a
+        standby never expires one on its own clock (`promote()` re-arms
+        them all when this replica takes over).  `value` carries the
+        out-of-band payload for ``result_put`` events."""
+        now = time.monotonic() if now is None else now
+        kind = ev.get("kind")
+        if kind == "invalidate":
+            self.results.invalidate_tag(str(ev.get("table", "")))
+        elif kind == "result_put" and value is not None:
+            self.results.put(
+                f"cache/result/{ev['key']}", value, int(ev.get("nbytes", 0)),
+                tags=tuple(ev.get("tables") or ()),
+            )
+        with self._lock:
+            if kind == "lease_grant":
+                lease = _Lease(ev["lease"], float(ev.get("ttl_s", 10.0)), now)
+                lease.expires = math.inf
+                self._leases[ev["lease"]] = lease
+            elif kind == "lease_gone":
+                lease = self._leases.pop(ev["lease"], None)
+                if lease is not None:
+                    for key in sorted(lease.keys):
+                        entry = self._kv.get(key)
+                        if entry is not None and entry.lease == ev["lease"]:
+                            del self._kv[key]
+            elif kind in ("join", "put"):
+                key = ev["key"]
+                joined = self._is_member_key(key) and key not in self._kv
+                entry = _Key(ev.get("value"), ev.get("lease"), ev["rev"], now)
+                self._kv[key] = entry
+                if entry.lease is not None:
+                    lease = self._leases.get(entry.lease)
+                    if lease is None:
+                        # grant fell off the shipped tail (shouldn't
+                        # happen in-order, but never KeyError on replay)
+                        lease = _Lease(entry.lease, 10.0, now)
+                        lease.expires = math.inf
+                        self._leases[entry.lease] = lease
+                    lease.keys.add(key)
+                if joined:
+                    self._epoch += 1
+            elif kind in ("leave", "delete"):
+                key = ev["key"]
+                entry = self._kv.pop(key, None)
+                if entry is not None:
+                    if entry.lease is not None:
+                        lease = self._leases.get(entry.lease)
+                        if lease is not None:
+                            lease.keys.discard(key)
+                    if self._is_member_key(key):
+                        self._epoch += 1
+            # every event carries its writer's term ("promoted" included)
+            self.term = max(self.term, int(ev.get("term", 0)))
+            self._rev = max(self._rev, int(ev["rev"]))
+            self._events.append(ev)
+            if len(self._events) > _EVENT_LOG_CAP:
+                del self._events[0]
+            if self._events:
+                self._events_floor = self._events[0]["rev"]
+            if kind in CLIENT_EVENT_KINDS:
+                self._last_client_rev = max(
+                    self._last_client_rev, int(ev["rev"])
+                )
+                self._watch_cond.notify_all()
+
+    def snapshot_state(self) -> dict:
+        """Full-state snapshot for standby catch-up past the retained
+        log window (result values ride separately — the transport
+        decides how to encode the arrays)."""
+        with self._lock:
+            snap = {
+                "term": self.term,
+                "epoch": self._epoch,
+                "rev": self._rev,
+                "events": [dict(e) for e in self._events],
+                "events_floor": self._events_floor,
+                "leases": [
+                    {"lease": l.lease_id, "ttl_s": l.ttl_s}
+                    for l in self._leases.values()
+                ],
+                "kv": [
+                    {"key": k, "value": e.value, "lease": e.lease,
+                     "rev": e.rev}
+                    for k, e in self._kv.items()
+                ],
+            }
+        snap["results"] = [
+            {"key": k, "value": v, "nbytes": n, "tables": list(tags)}
+            for k, v, n, tags in self.results.export_entries()
+        ]
+        return snap
+
+    def apply_snapshot(self, snap: dict, now: Optional[float] = None) -> None:
+        """Replace this replica's entire state with a primary snapshot
+        (leases arrive with infinite local expiry, exactly like
+        event-applied ones)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._kv.clear()
+            self._leases.clear()
+            self.term = max(self.term, int(snap.get("term", 1)))
+            self._epoch = int(snap.get("epoch", 0))
+            self._rev = int(snap.get("rev", 0))
+            self._events = [dict(e) for e in snap.get("events", [])]
+            self._events_floor = int(snap.get("events_floor", 0))
+            self._last_client_rev = max(
+                (e["rev"] for e in self._events
+                 if e.get("kind") in CLIENT_EVENT_KINDS),
+                default=0,
+            )
+            for spec in snap.get("leases", []):
+                lease = _Lease(spec["lease"], float(spec["ttl_s"]), now)
+                lease.expires = math.inf
+                self._leases[lease.lease_id] = lease
+            for spec in snap.get("kv", []):
+                entry = _Key(spec.get("value"), spec.get("lease"),
+                             int(spec.get("rev", 0)), now)
+                self._kv[spec["key"]] = entry
+                if entry.lease is not None and entry.lease in self._leases:
+                    self._leases[entry.lease].keys.add(spec["key"])
+            self._watch_cond.notify_all()
+        self.results.clear()
+        for spec in snap.get("results", []):
+            self.results.put(
+                spec["key"], spec["value"], int(spec.get("nbytes", 0)),
+                tags=tuple(spec.get("tables") or ()),
+            )
+
+    def promote(self, new_term: int, now: Optional[float] = None) -> None:
+        """This replica takes over as primary: adopt the new term,
+        re-arm every replicated lease with a fresh full TTL (holders
+        refresh within TTL/3, so nothing live is lost; genuinely dead
+        holders expire one TTL after the takeover), and log the term
+        change so it ships to any remaining standbys."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self.term = max(self.term + 1, int(new_term))
+            for lease in self._leases.values():
+                lease.expires = now + lease.ttl_s
+                for key in lease.keys:
+                    entry = self._kv.get(key)
+                    if entry is not None:
+                        entry.refreshed = now
+            self._append_event("promoted", term=self.term)
 
     # -- introspection --
     def gauges(self) -> dict:
@@ -291,6 +544,7 @@ class ClusterState:
             out = {
                 "cluster.epoch": self._epoch,
                 "cluster.rev": self._rev,
+                "cluster.term": self.term,
                 "cluster.leases": len(self._leases),
                 "cluster.members": sum(
                     1 for k in self._kv if self._is_member_key(k)
@@ -299,25 +553,60 @@ class ClusterState:
         out.update(self.results.gauges())
         return out
 
-    def status(self, now: Optional[float] = None) -> dict:
+    def status(self, now: Optional[float] = None,
+               extra: Optional[dict] = None) -> dict:
         from datafusion_tpu.obs.export import prometheus_text
 
         view = self.membership(now)
+        gauges = self.gauges()
+        if extra:
+            gauges.update(extra)
         return {
             "type": "status",
             "uptime_s": round(time.time() - self.started, 1),
             "epoch": view["epoch"],
             "rev": view["rev"],
+            "term": self.term,
             "workers": view["workers"],
             "results": self.results.stats(),
-            "prometheus": prometheus_text(METRICS, extra_gauges=self.gauges()),
+            "prometheus": prometheus_text(METRICS, extra_gauges=gauges),
         }
 
 
-def handle_request(state: ClusterState, msg: dict) -> dict:
-    """One request -> one response, shared by the TCP handler and the
-    in-process `LocalClusterClient` so both deployment shapes run the
-    exact same semantics."""
+# -- request handling (shared by TCP handler and LocalClusterClient) ------
+
+_MUTATING_REQUESTS = frozenset((
+    "lease_grant", "lease_refresh", "lease_revoke", "kv_put", "kv_delete",
+    "invalidate", "result_put",
+))
+
+
+def _encode_result_value(value, bw):
+    """Service-side wire encoding for a stored result value: raw numpy
+    snapshot columns become RAW binary segments (or inline base64 under
+    the segment threshold); non-snapshot values pass through."""
+    if isinstance(value, dict) and isinstance(value.get("snapshot"), dict) \
+            and "columns" in value["snapshot"]:
+        from datafusion_tpu.cluster.shared_cache import raw_to_wire
+
+        return {**value, "snapshot": raw_to_wire(value["snapshot"], bw)}
+    return value
+
+
+def _decode_result_value(value):
+    """Inverse of `_encode_result_value`: normalize an arriving result
+    value to the canonical raw-numpy storage form."""
+    if isinstance(value, dict) and isinstance(value.get("snapshot"), dict) \
+            and "columns" in value["snapshot"]:
+        from datafusion_tpu.cluster.shared_cache import wire_to_raw
+
+        return {**value, "snapshot": wire_to_raw(value["snapshot"])}
+    return value
+
+
+def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
+    """One request -> one response against the raw state machine
+    (fencing and replication live one layer up in `ClusterNode`)."""
     kind = msg.get("type")
     if kind == "ping":
         return {"type": "pong", "epoch": state.membership()["epoch"]}
@@ -343,35 +632,426 @@ def handle_request(state: ClusterState, msg: dict) -> dict:
         return {"type": "membership", **state.membership()}
     if kind == "events":
         return {"type": "events", **state.events_since(int(msg.get("since", 0)))}
+    if kind == "watch":
+        out = state.watch(int(msg.get("since", 0)),
+                          float(msg.get("timeout_s", 10.0)))
+        return {"type": "watch", **out}
     if kind == "invalidate":
         return {"type": "ok", **state.invalidate(msg["table"])}
     if kind == "result_put":
         stored = state.result_put(
-            msg["key"], msg["value"], int(msg["nbytes"]),
-            tuple(msg.get("tables") or ()),
+            msg["key"], _decode_result_value(msg["value"]),
+            int(msg["nbytes"]), tuple(msg.get("tables") or ()),
         )
         return {"type": "ok", "stored": stored}
     if kind == "result_get":
         value = state.result_get(msg["key"])
         out = {"type": "kv", "found": value is not None}
         if value is not None:
-            out["value"] = value
+            out["value"] = _encode_result_value(value, bw) if bw is not None \
+                else value
         return out
     if kind == "status":
         return state.status()
     return {"type": "error", "message": f"unknown request {kind!r}"}
 
 
+class ClusterNode:
+    """One service replica: a `ClusterState` plus a replication role.
+
+    A **primary** serves every request (replication pulls included)
+    and stamps its term on every mutation.  A **standby** serves only
+    `ping`/`status` and the peer term exchange — regular reads and
+    writes AND replication pulls are answered with a ``not_primary``
+    redirect (carrying the upstream hint) so multi-endpoint clients
+    fail over and downstream standbys chase the real primary instead
+    of tailing a deposed one — while a control loop tails the
+    primary's event log (`replicate_once`), falls back to a full-state
+    snapshot after log truncation, and promotes itself when the primary
+    has been silent past the election timeout (`maybe_promote` — the
+    lease-based election: leadership is a lease the primary keeps alive
+    by answering pulls).  Term fencing closes the split-brain window: a
+    revived old primary is demoted on its first replication or peer
+    exchange with a higher-term node, and any write carrying an
+    explicitly stale term is rejected outright.
+
+    Every method takes an injectable `now` so failover tests run
+    without sleeping; `partitioned` simulates an unreachable node for
+    in-process chaos (the local client raises the same
+    `ConnectionRefusedError` a dead TCP endpoint would)."""
+
+    def __init__(self, state: Optional[ClusterState] = None,
+                 addr: Optional[str] = None,
+                 standby_of=None, peers=(),
+                 election_timeout_s: Optional[float] = None,
+                 replicate_interval_s: Optional[float] = None):
+        from datafusion_tpu import cluster as _cluster
+
+        self.state = state or ClusterState()
+        self.addr = addr
+        self.role = "standby" if standby_of is not None else "primary"
+        self.standby_of = standby_of  # upstream: addr string or ClusterNode
+        self.peers = [p for p in peers if p]
+        if election_timeout_s is None:
+            election_timeout_s = _cluster.election_timeout_s()
+        self.election_timeout_s = float(election_timeout_s)
+        if replicate_interval_s is None:
+            replicate_interval_s = max(0.05, self.election_timeout_s / 5.0)
+        self.replicate_interval_s = float(replicate_interval_s)
+        self.partitioned = False
+        self.promotions = 0
+        self.step_downs = 0
+        self.snapshots_applied = 0
+        self.primary_rev = self.state._rev  # last rev observed upstream
+        self.last_primary_contact = time.monotonic()
+        self._force_snapshot = False
+        self._upstream_client = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def term(self) -> int:
+        return self.state.term
+
+    def __repr__(self):
+        return (f"ClusterNode({self.addr or 'in-process'}, {self.role}, "
+                f"term={self.term})")
+
+    # -- request surface --
+    def handle_request(self, msg: dict, bw=None) -> dict:
+        kind = msg.get("type")
+        if kind == "peer_status":
+            return self._serve_peer_status(msg)
+        if kind == "replicate_pull":
+            return self._serve_pull(msg, bw)
+        if kind == "ping":
+            return {"type": "pong", "role": self.role, "term": self.term,
+                    "epoch": self.state.membership()["epoch"]}
+        if kind == "status":
+            return self.status()
+        if self.role != "primary":
+            return self._not_primary_reply()
+        claimed = msg.get("term")
+        if claimed is not None and kind in _MUTATING_REQUESTS \
+                and int(claimed) < self.term:
+            METRICS.add("cluster.stale_term_writes_rejected")
+            return {
+                "type": "error", "code": "stale_term", "term": self.term,
+                "message": f"write fenced: term {claimed} is stale "
+                           f"(current term {self.term})",
+            }
+        return apply_request(self.state, msg, bw)
+
+    def _primary_hint(self) -> Optional[str]:
+        up = self.standby_of
+        if isinstance(up, ClusterNode):
+            return up.addr
+        return up
+
+    def _not_primary_reply(self, what: str = "request") -> dict:
+        METRICS.add("cluster.not_primary_rejected")
+        return {
+            "type": "error", "code": "not_primary",
+            "primary": self._primary_hint(), "term": self.term,
+            "message": f"{what} refused: this replica is a standby "
+                       f"(term {self.term}); primary is "
+                       f"{self._primary_hint() or 'unknown'}",
+        }
+
+    def _observe_term(self, term: int, role: Optional[str], source) -> None:
+        """The single fencing reaction, shared by every term exchange
+        (replication pulls, peer probes, being probed): a higher term
+        deposes a primary (step down toward `source`); a standby
+        adopts the term — and when the higher-term peer IS the
+        primary, retargets its replication at it."""
+        if term <= self.term:
+            return
+        if self.role == "primary":
+            self.step_down(source, term)
+            return
+        self.state.term = max(self.state.term, int(term))
+        if role == "primary" and source is not None \
+                and self._primary_hint() != source:
+            self.retarget(source)
+
+    # -- replication (standby side) --
+    def _upstream(self):
+        if self._upstream_client is None:
+            from datafusion_tpu.cluster.client import LocalClusterClient
+
+            up = self.standby_of
+            if isinstance(up, ClusterNode):
+                self._upstream_client = LocalClusterClient(up)
+            else:
+                from datafusion_tpu import cluster as _cluster
+
+                self._upstream_client = _cluster.connect(up)
+        return self._upstream_client
+
+    def replicate_once(self, now: Optional[float] = None) -> int:
+        """One log-shipping round: pull events (or a snapshot) from the
+        upstream, apply them, and record the contact for the election
+        clock.  Returns how many events were applied (-1 for a full
+        snapshot).  Raises on an unreachable upstream — the control
+        loop counts it and lets `maybe_promote` decide."""
+        from datafusion_tpu.errors import ClusterNotPrimaryError
+
+        if self.role == "primary":
+            return 0
+        faults.check("cluster.replicate", addr=self.addr)
+        msg = {"type": "replicate_pull", "since": self.state._rev,
+               "term": self.term, "addr": self.addr}
+        if self._force_snapshot:
+            msg["snapshot"] = True
+        try:
+            resp = self._upstream().request(msg)
+        except ClusterNotPrimaryError as e:
+            # the upstream stepped down: chase its hint
+            if e.primary and e.primary != self._primary_hint():
+                self.standby_of = e.primary
+                self._upstream_client = None
+            raise
+        now = time.monotonic() if now is None else now
+        self.last_primary_contact = now
+        self.primary_rev = int(resp.get("rev", self.primary_rev))
+        if resp.get("term", 0) > self.term:
+            self.state.term = int(resp["term"])
+        snap = resp.get("snapshot")
+        if snap is not None:
+            faults.check("cluster.snapshot", addr=self.addr)
+            for spec in snap.get("results", []):
+                spec["value"] = _decode_result_value(spec.get("value"))
+            self.state.apply_snapshot(snap)
+            self.snapshots_applied += 1
+            self._force_snapshot = False
+            METRICS.add("cluster.snapshots_applied")
+            return -1
+        values = resp.get("result_values") or {}
+        events = resp.get("events") or []
+        for ev in events:
+            self.state.apply_event(
+                ev, value=_decode_result_value(values.get(ev.get("key"))),
+            )
+        if events:
+            METRICS.add("cluster.replicated_events", len(events))
+        return len(events)
+
+    def maybe_promote(self, now: Optional[float] = None) -> bool:
+        """The election: promote when the primary has been silent past
+        the election timeout.  Lease-based — every successful pull
+        renews the primary's leadership lease; silence lets it lapse."""
+        if self.role == "primary":
+            return False
+        now = time.monotonic() if now is None else now
+        if now - self.last_primary_contact < self.election_timeout_s:
+            return False
+        faults.check("cluster.election", addr=self.addr, term=self.term)
+        self.state.promote(self.term + 1)
+        self.role = "primary"
+        self.standby_of = None
+        self._upstream_client = None
+        self.promotions += 1
+        METRICS.add("cluster.promotions")
+        return True
+
+    def retarget(self, upstream) -> None:
+        """Point this standby at a (new) upstream — an address string
+        (TCP) or another `ClusterNode` (in-process)."""
+        self.standby_of = upstream
+        self._upstream_client = None
+
+    def step_down(self, to, term: int,
+                  now: Optional[float] = None) -> None:
+        """A higher term exists: stop serving writes immediately, adopt
+        the term, and resync from the new primary via a full snapshot
+        (our log may have diverged during the split-brain window — any
+        writes we took after the election are discarded, which is the
+        fencing contract: one primary's history wins)."""
+        now = time.monotonic() if now is None else now
+        self.role = "standby"
+        self.standby_of = to
+        self.state.term = max(self.state.term, int(term))
+        self._upstream_client = None
+        self._force_snapshot = True
+        self.last_primary_contact = now
+        self.step_downs += 1
+        METRICS.add("cluster.step_downs")
+
+    # -- replication (primary side) --
+    def _serve_pull(self, msg: dict, bw=None) -> dict:
+        # the puller was promoted past us? if we still think we are
+        # primary, we are the revived old primary — step down NOW
+        self._observe_term(int(msg.get("term", 0)), None, msg.get("addr"))
+        if self.role != "primary":
+            # a demoted (or never-primary) node must not feed the log:
+            # the puller follows the hint to the real primary, and a
+            # standby that kept "succeeding" against a deposed upstream
+            # would otherwise defer its own election forever
+            return self._not_primary_reply("replication")
+        since = int(msg.get("since", 0))
+        state = self.state
+        base = {"type": "replicate", "term": self.term, "role": self.role,
+                "epoch": state.membership()["epoch"], "rev": state._rev}
+        out = state.events_since(since, kinds=None)
+        if msg.get("snapshot") or out.get("truncated") or \
+                (since == 0 and state._rev > 0 and
+                 state._events_floor > 1):
+            faults.check("cluster.snapshot", addr=self.addr)
+            snap = state.snapshot_state()
+            if bw is not None:
+                for spec in snap["results"]:
+                    spec["value"] = _encode_result_value(spec["value"], bw)
+            METRICS.add("cluster.snapshots_served")
+            return {**base, "rev": snap["rev"], "snapshot": snap}
+        values = {}
+        for ev in out["events"]:
+            if ev.get("kind") != "result_put":
+                continue
+            value = state.results.peek(f"cache/result/{ev['key']}")
+            if value is None:
+                continue  # evicted since; the standby just misses it
+            values[ev["key"]] = _encode_result_value(value, bw) \
+                if bw is not None else value
+        return {**base, "rev": out["rev"], "events": out["events"],
+                "result_values": values}
+
+    def _serve_peer_status(self, msg: dict) -> dict:
+        # fenced: a newer-term peer exists — depose ourselves (primary)
+        # or chase it (standby probed by the new primary)
+        self._observe_term(int(msg.get("term", 0)), msg.get("role"),
+                           msg.get("addr"))
+        return {
+            "type": "peer_status", "term": self.term, "role": self.role,
+            "rev": self.state._rev, "addr": self.addr,
+            "primary": self.addr if self.role == "primary"
+            else self._primary_hint(),
+        }
+
+    def peer_probe_once(self) -> None:
+        """Exchange terms with every configured peer; either side of
+        the exchange that learns of a higher term steps down.  This is
+        how a restarted old primary discovers the new one within one
+        probe interval instead of split-braining indefinitely."""
+        from datafusion_tpu import cluster as _cluster
+        from datafusion_tpu.errors import ExecutionError
+
+        for peer in self.peers:
+            if peer == self.addr:
+                continue
+            try:
+                client = _cluster.connect(peer)
+                resp = client.request({
+                    "type": "peer_status", "term": self.term,
+                    "role": self.role, "addr": self.addr,
+                })
+            except (ConnectionError, OSError, ExecutionError):
+                continue
+            self._observe_term(
+                int(resp.get("term", 0)), resp.get("role"),
+                resp.get("primary") or peer,
+            )
+
+    # -- control loop (TCP deployments) --
+    def _control_loop(self) -> None:
+        from datafusion_tpu.errors import ExecutionError
+
+        probe_every = max(1, int(round(
+            self.election_timeout_s / max(self.replicate_interval_s, 1e-3) / 2
+        )))
+        cycles = 0
+        while not self._stop.wait(self.replicate_interval_s):
+            cycles += 1
+            try:
+                if self.role == "standby":
+                    try:
+                        self.replicate_once()
+                    except (ConnectionError, OSError, ExecutionError):
+                        METRICS.add("cluster.replicate_errors")
+                    self.maybe_promote()
+                elif self.peers and cycles % probe_every == 0:
+                    self.peer_probe_once()
+            except Exception:  # noqa: BLE001 — the control loop must survive
+                METRICS.add("cluster.control_errors")
+
+    def start(self) -> "ClusterNode":
+        """Start the replication/peer control thread (and run one
+        synchronous peer probe first, so a restarted old primary fences
+        itself BEFORE accepting its first client write)."""
+        if self.peers:
+            try:
+                self.peer_probe_once()
+            except Exception:  # noqa: BLE001 — boot probe is best-effort
+                METRICS.add("cluster.control_errors")
+        if self.role == "standby":
+            from datafusion_tpu.errors import ExecutionError
+
+            try:
+                self.replicate_once()
+            except (ConnectionError, OSError, ExecutionError):
+                METRICS.add("cluster.replicate_errors")
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._control_loop, name="df-tpu-cluster-ctl",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    # -- introspection --
+    @property
+    def replication_lag_revisions(self) -> int:
+        if self.role == "primary":
+            return 0
+        return max(0, self.primary_rev - self.state._rev)
+
+    def gauges(self) -> dict:
+        return {
+            "cluster.term": self.term,
+            "cluster.role": 1 if self.role == "primary" else 0,
+            "cluster.replication_lag_revisions": self.replication_lag_revisions,
+        }
+
+    def status(self) -> dict:
+        out = self.state.status(extra=self.gauges())
+        out.update({
+            "role": self.role,
+            "term": self.term,
+            "standby_of": self._primary_hint(),
+            "replication_lag_revisions": self.replication_lag_revisions,
+            "promotions": self.promotions,
+            "step_downs": self.step_downs,
+        })
+        return out
+
+
+def handle_request(target, msg: dict, bw=None) -> dict:
+    """One request -> one response, shared by the TCP handler and the
+    in-process `LocalClusterClient` so both deployment shapes run the
+    exact same semantics (fencing included — pass a `ClusterNode`; a
+    bare `ClusterState` is served unfenced for state-machine tests)."""
+    if isinstance(target, ClusterNode):
+        return target.handle_request(msg, bw)
+    return apply_request(target, msg, bw)
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         from datafusion_tpu.errors import ExecutionError
         from datafusion_tpu.parallel.wire import (
+            BinWriter,
             crc_for_peer,
             recv_msg,
             send_msg,
         )
 
-        state: ClusterState = self.server.cluster_state  # type: ignore[attr-defined]
+        node: ClusterNode = self.server.cluster_node  # type: ignore[attr-defined]
         while True:
             try:
                 msg = recv_msg(self.request)
@@ -379,6 +1059,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 return
             if msg is None:
                 return
+            bw = BinWriter()
             try:
                 if msg.get("type") == "shutdown":
                     send_msg(self.request, {"type": "bye"})
@@ -386,11 +1067,12 @@ class _Handler(socketserver.BaseRequestHandler):
                         target=self.server.shutdown, daemon=True
                     ).start()
                     return
-                out = handle_request(state, msg)
+                out = node.handle_request(msg, bw)
             except Exception as e:  # noqa: BLE001 — the service must not die on a bad request
                 out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
+                bw = BinWriter()  # a failed build may hold partial segments
             try:
-                send_msg(self.request, out, crc=crc_for_peer(msg))
+                send_msg(self.request, out, bw, crc=crc_for_peer(msg))
             except (ConnectionError, OSError):
                 return
 
@@ -401,12 +1083,30 @@ class ClusterStateService(socketserver.ThreadingTCPServer):
 
 
 def serve(bind: str = "127.0.0.1:0",
-          state: Optional[ClusterState] = None) -> ClusterStateService:
+          state: Optional[ClusterState] = None,
+          node: Optional[ClusterNode] = None,
+          standby_of: Optional[str] = None,
+          peers=(),
+          election_timeout_s: Optional[float] = None,
+          advertise: Optional[str] = None) -> ClusterStateService:
     """Run the service on `bind`; returns the server (embed it, or call
-    `serve_forever` via ``python -m datafusion_tpu.cluster``)."""
+    `serve_forever` via ``python -m datafusion_tpu.cluster``).
+    `standby_of` starts this instance as a replicating standby of an
+    existing primary; `peers` (addresses, self included or not) arms
+    the term-exchange probe that fences a revived old primary."""
     host, _, port = bind.partition(":")
     server = ClusterStateService((host, int(port or 0)), _Handler)
-    server.cluster_state = state or ClusterState()  # type: ignore[attr-defined]
+    bound_host, bound_port = server.server_address[:2]
+    addr = advertise or f"{bound_host}:{bound_port}"
+    if node is None:
+        node = ClusterNode(
+            state=state, addr=addr, standby_of=standby_of, peers=peers,
+            election_timeout_s=election_timeout_s,
+        )
+        if standby_of or node.peers:
+            node.start()
+    server.cluster_node = node  # type: ignore[attr-defined]
+    server.cluster_state = node.state  # type: ignore[attr-defined]
     return server
 
 
@@ -416,16 +1116,42 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="datafusion-tpu-cluster",
         description="datafusion-tpu cluster state service "
-                    "(lease KV + membership + shared cache tier)",
+                    "(replicated lease KV + membership + shared cache tier)",
     )
     ap.add_argument("--bind", default="127.0.0.1:8470",
                     help="host:port to listen on (default 127.0.0.1:8470)")
+    ap.add_argument("--standby-of", default=None,
+                    help="primary address host:port — start as a "
+                         "replicating standby that promotes itself on "
+                         "primary silence (default: start as primary)")
+    ap.add_argument("--peers", default=None,
+                    help="comma-separated replica addresses for the "
+                         "term-exchange probe that fences a revived old "
+                         "primary (include every replica; self is skipped)")
+    ap.add_argument("--advertise", default=None,
+                    help="host[:port] peers should dial for this replica "
+                         "(default: the bound address)")
+    ap.add_argument("--election-timeout-s", type=float, default=None,
+                    help="promote after this much primary silence "
+                         "(default: env DATAFUSION_TPU_CLUSTER_ELECTION_S "
+                         "or half the lease TTL)")
     args = ap.parse_args(argv)
-    server = serve(args.bind)
+    peers = [p.strip() for p in (args.peers or "").split(",") if p.strip()]
+    server = serve(args.bind, standby_of=args.standby_of, peers=peers,
+                   election_timeout_s=args.election_timeout_s,
+                   advertise=args.advertise)
     host, port = server.server_address[:2]
+    node: ClusterNode = server.cluster_node  # type: ignore[attr-defined]
+    # NB: smoke harnesses parse this line for the address — keep the
+    # role/term detail on its own line
     print(f"cluster service listening on {host}:{port}", flush=True)
+    print(f"cluster service role={node.role} term={node.term}"
+          + (f" standby_of={args.standby_of}" if args.standby_of else ""),
+          flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         pass
+    finally:
+        node.stop()
     return 0

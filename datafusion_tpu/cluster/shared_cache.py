@@ -13,11 +13,19 @@ fleet resource.  `SharedResultTier` plugs into `CacheStore`'s pluggable
   or partitioned service costs a dropped publication, counted, not
   latency).
 
-Snapshots cross the wire in the protocol's inline array form
-(`enc_array` without a segment writer: dtype + shape + base64) inside
-ordinary JSON frames — no new encoding, and the CRC handshake covers
-them like any fragment payload.  Entries carry the scanned table names
-as tags so `invalidate(table)` on the service drops dependents.
+Snapshots cross the wire as RAW binary segments with per-segment CRC32s
+(the same binary frames the fragment protocol ships columns in) instead
+of inline base64 JSON — publishing a large result costs its bytes, not
+its bytes plus a third, and the ``coord.shared_cache_publish_bytes``
+counter records exactly what went out.  Three snapshot forms exist and
+the converters below move between them: the *raw* form (numpy arrays —
+what the service stores and the in-process client passes by reference),
+the *wire* form (segment refs / inline base64 — what crosses TCP), and
+the `CachedResult` the cache subsystem consumes.  Entries carry the
+scanned table names as tags so `invalidate(table)` on the service drops
+dependents, and the whole tier rides replication: a standby mirrors
+``result_put`` events (values attached to the log-shipping response),
+so a coordinator's warm hit still lands after a primary failover.
 
 Fingerprint compatibility across coordinators is inherited from
 `cache/fingerprint.py`: the digest folds in the plan wire JSON, catalog
@@ -34,22 +42,30 @@ import queue
 import threading
 from typing import Optional
 
+import numpy as np
+
 from datafusion_tpu.cache.result import CachedResult
 from datafusion_tpu.errors import ExecutionError
 from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.utils.metrics import METRICS
 
 
-def encode_result(entry: CachedResult) -> dict:
-    """Wire-encode a `CachedResult` snapshot (JSON-able: arrays inline
-    base64 via the wire protocol's array form)."""
-    from datafusion_tpu.parallel.wire import enc_array
+def _as_array(o) -> np.ndarray:
+    """An array in any snapshot form -> numpy (raw passthrough, wire
+    segment/base64 decoded)."""
+    if isinstance(o, np.ndarray):
+        return o
+    from datafusion_tpu.parallel.wire import dec_array
 
+    return dec_array(o)
+
+
+def result_raw(entry: CachedResult) -> dict:
+    """`CachedResult` -> the raw snapshot form (numpy by reference —
+    nothing copied; treat the arrays as immutable)."""
     return {
-        "columns": [enc_array(c) for c in entry.columns],
-        "validity": [
-            None if v is None else enc_array(v) for v in entry.validity
-        ],
+        "columns": list(entry.columns),
+        "validity": list(entry.validity),
         "dict_values": [
             None if d is None else list(d) for d in entry.dict_values
         ],
@@ -58,17 +74,58 @@ def encode_result(entry: CachedResult) -> dict:
     }
 
 
-def decode_result(obj: dict) -> CachedResult:
-    """Rebuild a `CachedResult` from its wire form; the result is
-    marked ``shared`` so EXPLAIN ANALYZE shows where it came from."""
-    from datafusion_tpu.parallel.wire import dec_array
+def raw_to_wire(raw: dict, bw=None) -> dict:
+    """Raw snapshot -> wire form: arrays become RAW binary segments via
+    `bw` (inline base64 when `bw` is None or under the inline
+    threshold)."""
+    from datafusion_tpu.parallel.wire import enc_array
 
+    return {
+        "columns": [enc_array(_as_array(c), bw) for c in raw["columns"]],
+        "validity": [
+            None if v is None else enc_array(_as_array(v), bw)
+            for v in raw["validity"]
+        ],
+        "dict_values": [
+            None if d is None else list(d) for d in raw["dict_values"]
+        ],
+        "num_rows": int(raw["num_rows"]),
+        "nbytes": int(raw["nbytes"]),
+    }
+
+
+def wire_to_raw(obj: dict) -> dict:
+    """Any snapshot form -> raw numpy (the canonical service-side
+    storage form; numpy passes through untouched)."""
+    return {
+        "columns": [_as_array(c) for c in obj["columns"]],
+        "validity": [
+            None if v is None else _as_array(v) for v in obj["validity"]
+        ],
+        "dict_values": [
+            None if d is None else list(d) for d in obj["dict_values"]
+        ],
+        "num_rows": int(obj["num_rows"]),
+        "nbytes": int(obj["nbytes"]),
+    }
+
+
+def encode_result(entry: CachedResult, bw=None) -> dict:
+    """Wire-encode a `CachedResult` snapshot (binary segments when a
+    `BinWriter` is given, inline base64 otherwise)."""
+    return raw_to_wire(result_raw(entry), bw)
+
+
+def decode_result(obj: dict) -> CachedResult:
+    """Rebuild a `CachedResult` from any snapshot form; the result is
+    marked ``shared`` so EXPLAIN ANALYZE shows where it came from."""
+    raw = wire_to_raw(obj)
     return CachedResult(
-        [dec_array(c) for c in obj["columns"]],
-        [None if v is None else dec_array(v) for v in obj["validity"]],
-        [None if d is None else tuple(d) for d in obj["dict_values"]],
-        int(obj["num_rows"]),
-        int(obj["nbytes"]),
+        raw["columns"],
+        raw["validity"],
+        [None if d is None else tuple(d) for d in raw["dict_values"]],
+        raw["num_rows"],
+        raw["nbytes"],
         shared=True,
     )
 
@@ -92,21 +149,19 @@ class SharedResultTier:
     def load(self, key: str):
         try:
             with obs_trace.span("cluster.shared_cache", op="get"):
-                out = self.client.result_get(key)
+                fetched = self.client.result_fetch(key)
         except (ConnectionError, OSError, ExecutionError):
             METRICS.add("coord.shared_cache_errors")
             return None
-        if not out.get("found"):
-            METRICS.add("coord.shared_cache_misses")
-            return None
-        stored = out["value"]
-        try:
-            entry = decode_result(stored["snapshot"])
         except (KeyError, TypeError, ValueError):
             METRICS.add("coord.shared_cache_decode_errors")
             return None
+        if fetched is None:
+            METRICS.add("coord.shared_cache_misses")
+            return None
+        entry, tables = fetched
         METRICS.add("coord.shared_cache_hits")
-        return entry, entry.nbytes, tuple(stored.get("tables") or ())
+        return entry, entry.nbytes, tables
 
     # -- write-behind --
     def store(self, key: str, value, nbytes: int, tags: tuple) -> None:
@@ -143,12 +198,15 @@ class SharedResultTier:
             key, value, nbytes, tags = item
             try:
                 with obs_trace.span("cluster.shared_cache", op="put"):
-                    self.client.result_put(
-                        key, {"snapshot": encode_result(value),
-                              "tables": list(tags)},
-                        nbytes, tables=tags,
+                    sent = self.client.result_publish(
+                        key, value, nbytes, tables=tags
                     )
                 METRICS.add("coord.shared_cache_published")
+                if sent:
+                    # actual wire cost of the publication (binary
+                    # segments, not base64) — the A/B evidence for the
+                    # RAW-segment path
+                    METRICS.add("coord.shared_cache_publish_bytes", int(sent))
             except (ConnectionError, OSError, ExecutionError):
                 METRICS.add("coord.shared_cache_errors")
             except Exception:  # noqa: BLE001 — the publisher must outlive bad entries

@@ -59,6 +59,30 @@ class QueryDeadlineError(ExecutionError):
     NOT transient: retrying cannot create time."""
 
 
+class ClusterNotPrimaryError(TransientError, ExecutionError):
+    """A cluster-service replica refused the request because it is not
+    the primary.  Transient by construction — retrying against another
+    endpoint (or the same one after an election) is expected to
+    succeed, and the multi-endpoint `ClusterClient` does exactly that.
+    Also an `ExecutionError` so the existing swallow-and-degrade
+    handlers around cluster calls (membership polls, shared-tier loads,
+    heartbeat refreshes) keep catching it when failover is exhausted.
+    `primary` carries the rejecting replica's best hint for who IS
+    primary (an address string, or None)."""
+
+    def __init__(self, message: str, primary=None):
+        super().__init__(message)
+        self.primary = primary
+
+
+class StaleTermError(ExecutionError):
+    """A write carried a leadership term older than the service's
+    current term — the writer is a deposed primary and must not mutate
+    the KV (the split-brain fence).  Deliberately NOT transient:
+    replaying the same stale write cannot make its term current; the
+    writer has to step down and resync first."""
+
+
 # Status-code classification for JAX/XLA runtime errors.  The runtime
 # raises untyped `XlaRuntimeError`/`JaxRuntimeError` whose messages
 # lead with an absl status token ("UNAVAILABLE: socket closed"); the

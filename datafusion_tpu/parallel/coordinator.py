@@ -83,6 +83,11 @@ class WorkerHandle:
         self.host = host
         self.port = port
         self.alive = True
+        # True for handles minted from cluster membership discovery;
+        # only these are eligible for automatic retirement when the
+        # view drops them (explicitly configured workers are the
+        # operator's call — they only ever flip alive/dead)
+        self.discovered = False
         # None = wait for the fragment however long it takes; a slow
         # worker is NOT a dead worker (marking it dead on a response
         # timeout would replay the fragment elsewhere, time out again,
@@ -161,6 +166,20 @@ def _resolve_addr(addr: str) -> str:
         return addr
 
 
+def _resolved_addrs(addrs: set[str]) -> set[str]:
+    """The address set plus each member's resolved spelling — one
+    matching rule for every consumer of the membership view (a worker
+    registered as '127.0.0.1:p' must match a handle configured as
+    'localhost:p'; a spelling mismatch would flap it down or retire
+    it)."""
+    return addrs | {_resolve_addr(a) for a in addrs}
+
+
+def _addr_in_view(resolved: set[str], host, port) -> bool:
+    addr = f"{host}:{port}"
+    return addr in resolved or _resolve_addr(addr) in resolved
+
+
 class HeartbeatMonitor:
     """Coordinator-side failure detection + worker re-admission.
 
@@ -181,15 +200,17 @@ class HeartbeatMonitor:
     deterministically without the thread.
 
     **Cluster mode** (`membership` set): the monitor stops probing and
-    consumes the shared `MembershipView` instead — one request per
-    cycle replaces N probes, and every coordinator sharing the worker
-    pool learns liveness from the same epoch-stamped view instead of
-    re-learning it privately.  Worker state flips directly on view
-    membership (the service's lease TTL already is the
-    probation/fail-threshold debounce); a refresh that cannot reach the
-    service keeps the last view.  Dispatch's last-gasp re-probe is
-    unchanged either way — direct probes remain the final word before a
-    query is failed.
+    consumes the shared `MembershipView` instead — the background loop
+    parks a long-poll push *watch* on the service (the view refreshes
+    the moment a worker joins or leaves, instead of one interval
+    later), and every coordinator sharing the worker pool learns
+    liveness from the same epoch-stamped view instead of re-learning
+    it privately.  Worker state flips directly on view membership (the
+    service's lease TTL already is the probation/fail-threshold
+    debounce); a refresh that cannot reach the service keeps the last
+    view.  Dispatch's last-gasp re-probe is unchanged either way —
+    direct probes remain the final word before a query is failed.
+    `poll_once()` stays a synchronous pull for tests.
     """
 
     def __init__(self, workers: list[WorkerHandle], interval: float = 5.0,
@@ -208,7 +229,8 @@ class HeartbeatMonitor:
 
     def poll_once(self) -> None:
         if self.membership is not None:
-            self._poll_view()
+            if self.membership.poll():
+                self._apply_view()
             return
         for i, w in enumerate(self.workers):
             # dispatch failover (or a last-gasp re-probe) can flip a
@@ -229,22 +251,12 @@ class HeartbeatMonitor:
                     w.mark_down()
             self._seen_alive[i] = w.alive
 
-    def _poll_view(self) -> None:
-        """One cluster-mode cycle: refresh the shared view, flip worker
-        state to match it.  A failed refresh (partitioned service)
-        keeps the previous states — stale liveness beats flapping.
-        Addresses compare resolved (a worker registered as
-        '127.0.0.1:p' must match a handle configured as 'localhost:p' —
-        a spelling mismatch would flap the worker down every cycle)."""
-        if not self.membership.poll():
-            return
-        live = self.membership.live_addresses()
-        live = live | {_resolve_addr(a) for a in live}
-        for w in self.workers:
-            in_view = (
-                f"{w.host}:{w.port}" in live
-                or _resolve_addr(f"{w.host}:{w.port}") in live
-            )
+    def _apply_view(self) -> None:
+        """Flip worker state to match the shared view (resolved-address
+        matching via `_resolved_addrs` / `_addr_in_view`)."""
+        resolved = _resolved_addrs(self.membership.live_addresses())
+        for w in list(self.workers):
+            in_view = _addr_in_view(resolved, w.host, w.port)
             if in_view and not w.alive:
                 w.readmit()
             elif not in_view and w.alive:
@@ -253,6 +265,24 @@ class HeartbeatMonitor:
     def _loop(self) -> None:
         import random
 
+        if self.membership is not None:
+            # cluster mode: park a long-poll push watch instead of a
+            # timed poll — the service answers the moment a worker
+            # joins or leaves, so watch lag is one round trip, not one
+            # interval.  A clean timeout refreshes the view too; an
+            # unreachable service keeps the stale view and backs off a
+            # full interval so a dead control plane can't spin us.
+            while not self._stop.is_set():
+                try:
+                    ok = self.membership.watch(timeout_s=self.interval)
+                    self._apply_view()
+                except Exception:  # noqa: BLE001 — the monitor must outlive the service
+                    METRICS.add("coord.heartbeat_errors")
+                    ok = False
+                self._stop.wait(
+                    0.02 if ok else self.interval * random.uniform(0.8, 1.2)
+                )
+            return
         while not self._stop.wait(self.interval * random.uniform(0.8, 1.2)):
             try:
                 self.poll_once()
@@ -696,15 +726,22 @@ class DistributedContext(ExecutionContext):
     every query end to end — dispatch, reassignment retries, and
     worker-side device retries all honor the remaining budget.
 
-    `cluster` (address string, `ClusterState`, or client; or env
-    DATAFUSION_TPU_CLUSTER) joins the cluster control plane
+    `cluster` (address string — possibly a comma-separated HA endpoint
+    list "h1:p1,h2:p2" — `ClusterState`/`ClusterNode`, or client; or
+    env DATAFUSION_TPU_CLUSTER) joins the cluster control plane
     (`datafusion_tpu/cluster/`): worker liveness comes from the shared
     `MembershipView` (the heartbeat monitor consumes it instead of
     probing), `workers` may be omitted entirely (discovered from the
-    membership), the result cache gains the shared read-through/
-    write-behind tier, and `register_datasource` re-registrations
-    broadcast fragment-cache invalidations to every worker.  Unset, no
-    cluster code runs — no new threads, sockets, or allocations.
+    membership — and the worker pool then tracks the membership
+    automatically: every observed epoch change folds joiners in and
+    retires leavers, no `sync_workers()` call needed), the result
+    cache gains the shared read-through/write-behind tier, and
+    `register_datasource` re-registrations broadcast fragment-cache
+    invalidations to every worker.  A primary failover of the service
+    itself is absorbed inside the client (endpoint sweep +
+    redirect-on-``not_primary``) — queries never block on the control
+    plane.  Unset, no cluster code runs — no new threads, sockets, or
+    allocations.
     """
 
     def __init__(
@@ -726,6 +763,7 @@ class DistributedContext(ExecutionContext):
         self.cluster = None
         self.membership = None
         self._shared_tier = None
+        discovered_all = False
         if cluster is None:
             cluster = os.environ.get("DATAFUSION_TPU_CLUSTER") or None
         if cluster:
@@ -743,11 +781,22 @@ class DistributedContext(ExecutionContext):
                     self._parse_addr(a)
                     for a in self.membership.live_addresses()
                 )
+                discovered_all = True
             if self._result_cache is not None:
                 self._shared_tier = SharedResultTier(self.cluster)
                 self._result_cache.shared = self._shared_tier
         self._request_timeout = request_timeout
+        self._workers_lock = threading.Lock()
         self.workers = [WorkerHandle(h, p, request_timeout) for h, p in workers]
+        if discovered_all:
+            for w in self.workers:
+                w.discovered = True
+        if self.membership is not None:
+            # auto worker sync: every epoch change observed by ANY view
+            # consumer (heartbeat watch, cluster_epoch(), shared-tier
+            # traffic) folds joiners into the rotation and retires
+            # leavers — the fleet scales with zero coordinator calls
+            self.membership.subscribe(lambda _view: self._fold_view_workers())
         if query_deadline_s is None:
             env = os.environ.get("DATAFUSION_TPU_QUERY_DEADLINE_S")
             # "0" means off (the documented default), not a 0s budget
@@ -812,22 +861,67 @@ class DistributedContext(ExecutionContext):
             self.membership.poll()
         return self.membership.epoch
 
-    def sync_workers(self) -> list[str]:
-        """Fold newly-registered cluster workers into the rotation
-        (workers that joined after this coordinator came up).  Returns
-        the addresses added; existing handles keep their state."""
-        if self.membership is None:
+    def _fold_view_workers(self) -> list[str]:
+        """Reconcile the handle list with the CURRENT view (no service
+        round trip — refresh first, or let a view callback land here).
+        Joiners get fresh handles; *discovered* workers gone from a
+        non-empty view are retired (explicitly configured handles are
+        never removed — they only flip alive/dead, so a worker the
+        operator listed but never cluster-registered stays reachable
+        by dispatch's last-gasp probes; an empty view retires nobody:
+        it may be a service blip).  Returns the addresses added."""
+        view = self.membership
+        if view is None:
             return []
-        self.membership.poll()
-        known = {f"{w.host}:{w.port}" for w in self.workers}
+        live = view.live_addresses()
         added = []
-        for addr in sorted(self.membership.live_addresses() - known):
-            host, port = self._parse_addr(addr)
-            self.workers.append(WorkerHandle(host, port, self._request_timeout))
-            added.append(addr)
+        with self._workers_lock:
+            # joins compare RESOLVED, like retirement and _apply_view:
+            # a worker registered as '127.0.0.1:p' must not gain a
+            # duplicate handle beside a configured 'localhost:p' one
+            known = _resolved_addrs(
+                {f"{w.host}:{w.port}" for w in self.workers}
+            )
+            for addr in sorted(live):
+                if addr in known or _resolve_addr(addr) in known:
+                    continue
+                host, port = self._parse_addr(addr)
+                handle = WorkerHandle(host, port, self._request_timeout)
+                handle.discovered = True
+                self.workers.append(handle)
+                added.append(addr)
+            if live:
+                resolved = _resolved_addrs(live)
+                keep, retired = [], 0
+                for w in self.workers:
+                    if (not w.discovered
+                            or _addr_in_view(resolved, w.host, w.port)):
+                        keep.append(w)
+                    else:
+                        retired += 1
+                if retired:
+                    # atomic swap: in-flight dispatch loops re-read the
+                    # list each retry and simply stop picking the dead
+                    self.workers[:] = keep
+                    METRICS.add("coord.workers_retired", retired)
         if added:
             METRICS.add("coord.workers_discovered", len(added))
         return added
+
+    def sync_workers(self) -> list[str]:
+        """Refresh the shared view and fold newly-registered cluster
+        workers into the rotation (and retire leavers).  Returns the
+        addresses added; existing handles keep their state.  In cluster
+        mode this also runs automatically on every observed epoch
+        change — the explicit call remains for off-cycle forcing."""
+        if self.membership is None:
+            return []
+        before = {f"{w.host}:{w.port}" for w in self.workers}
+        self.membership.poll()  # an epoch change folds via the callback
+        self._fold_view_workers()
+        return sorted(
+            {f"{w.host}:{w.port}" for w in self.workers} - before
+        )
 
     def broadcast_invalidate(self, table: str) -> int:
         """Coordinator-driven cache invalidation broadcast: drop

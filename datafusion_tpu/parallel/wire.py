@@ -90,7 +90,9 @@ class BinWriter:
 
 
 def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
-             crc: bool = False) -> None:
+             crc: bool = False) -> int:
+    """Send one frame; returns the total bytes written (callers like
+    the shared-tier publisher account wire cost from this)."""
     faults.check("wire.send", type=obj.get("type"))
     if bw is not None and bw.chunks:
         sizes = [memoryview(c).nbytes for c in bw.chunks]
@@ -107,9 +109,10 @@ def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
         # intermediate frame buffer, no per-array tobytes copy
         for c in bw.chunks:
             sock.sendall(c)
-        return
+        return _LEN.size + frame_len
     data = json.dumps(obj).encode("utf-8")
     sock.sendall(_LEN.pack(len(data)) + data)
+    return _LEN.size + len(data)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:

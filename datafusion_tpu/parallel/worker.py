@@ -478,10 +478,12 @@ def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
     """Run a worker; returns (server, thread) for embedding, or call
     serve_forever via the CLI entry (python -m datafusion_tpu.worker).
     `http_port` (non-zero) additionally serves GET /status on the same
-    host.  `cluster` (service address, `ClusterState`, or client)
-    registers this worker in the cluster control plane under a TTL
-    lease kept alive by a heartbeat thread that also applies broadcast
-    cache invalidations (`cluster/agent.py`); `advertise` is the
+    host.  `cluster` (service address or comma-separated HA endpoint
+    list, `ClusterState`/`ClusterNode`, or client) registers this
+    worker in the cluster control plane under a TTL lease kept alive by
+    a heartbeat thread that also applies broadcast cache invalidations
+    and rides out control-plane failovers (`cluster/agent.py`);
+    `advertise` is the
     host[:port] coordinators should DIAL — required knowledge when the
     bind address is a wildcard (0.0.0.0 is not dialable from another
     host) or NAT'd (containers)."""
@@ -544,9 +546,11 @@ def main(argv=None) -> int:
     # cluster control plane (datafusion_tpu/cluster): register under a
     # TTL lease, apply coordinator invalidation broadcasts
     ap.add_argument("--cluster", default=None,
-                    help="cluster state service address host:port "
-                         "(default: env DATAFUSION_TPU_CLUSTER; empty = "
-                         "cluster mode off)")
+                    help="cluster state service address host:port — or a "
+                         "comma-separated HA endpoint list "
+                         "host1:p1,host2:p2 (lease refreshes fail over to "
+                         "the promoted standby automatically; default: env "
+                         "DATAFUSION_TPU_CLUSTER; empty = cluster mode off)")
     ap.add_argument("--advertise", default=None,
                     help="host[:port] coordinators should dial for this "
                          "worker (needed behind 0.0.0.0 binds / NAT; "

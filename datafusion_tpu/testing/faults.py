@@ -8,9 +8,11 @@ exercisable on demand: production code declares *named sites*
 hurt it — wire send/recv, worker fragment execution, device dispatch,
 CSV/IO reads, and the cluster control plane (``cluster.request`` =
 service partition, ``cluster.lease.refresh`` = lease expiry /
-heartbeat loss, ``cluster.watch`` = stale membership view) — and a
-process-global, seedable *fault plan* decides which sites fire and
-how.
+heartbeat loss, ``cluster.watch`` = stale membership view,
+``cluster.replicate`` = log-shipping failure, ``cluster.election`` =
+aborted standby promotion, ``cluster.snapshot`` = catch-up snapshot
+failure) — and a process-global, seedable *fault plan* decides which
+sites fire and how.
 
 Zero overhead when off: with no plan installed, `check()` is one module
 attribute read and a `None` test.  Nothing else in the engine changes.

@@ -1,23 +1,31 @@
 #!/usr/bin/env python
-"""Cluster control plane worked example: two coordinators, one shared
-worker pool, a shared warm cache hit.
+"""Cluster control plane worked example: TWO service replicas
+(primary + standby), two coordinators, one shared worker pool, a shared
+warm cache hit — and a primary kill the fleet shrugs off.
 
 Everything runs in this one process (the in-process deployment shape —
-`ClusterState` + `LocalClusterClient`); swap the client for
-`connect("host:port")` against ``python -m datafusion_tpu.cluster`` and
+`ClusterNode` + `LocalClusterClient`); swap the client for
+`connect("host1:p1,host2:p2")` against two ``python -m
+datafusion_tpu.cluster`` processes (`--standby-of`/`--peers`) and
 nothing else changes.  The walk-through:
 
-1. start a cluster state, register two embedded workers under TTL
-   leases;
+1. start a PRIMARY and a log-shipping STANDBY replica, register two
+   embedded workers under TTL leases through the two-endpoint client;
 2. coordinator A discovers the workers from the shared membership
    (no worker list configured anywhere) and runs a GROUP BY;
 3. coordinator B — a different context, as if behind a load balancer —
    submits the same SQL and is served from the SHARED result tier:
    no fragment dispatched, `cache.shared=True` on the replay;
-4. a broadcast invalidation drops every worker's fragment-cache
-   entries on their next lease refresh (no TTL wait);
-5. kill a worker abruptly: both coordinators converge to the same
-   bumped membership epoch within one lease TTL.
+4. KILL THE PRIMARY: the standby's election fires on primary silence,
+   it promotes (term bump), re-arms every replicated lease, and the
+   client's endpoint sweep rides the next request over — the workers
+   keep their original leases, and a coordinator born after the kill
+   still gets the warm shared-tier hit (the tier replicated too);
+5. a broadcast invalidation ON THE NEW PRIMARY drops every worker's
+   fragment-cache entries on their next lease refresh (no TTL wait) —
+   coherence machinery fully live after the failover;
+6. the revived old primary is FENCED: the term exchange demotes it,
+   and a write stamped with its stale term is rejected.
 
     JAX_PLATFORMS=cpu python examples/cluster.py
 """
@@ -33,7 +41,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from datafusion_tpu.cache.result import CachedResultRelation
-from datafusion_tpu.cluster import ClusterState, LocalClusterClient
+from datafusion_tpu.cluster import ClusterNode, LocalClusterClient
 from datafusion_tpu.datatypes import DataType, Field, Schema
 from datafusion_tpu.exec.datasource import CsvDataSource
 from datafusion_tpu.exec.materialize import collect
@@ -75,8 +83,12 @@ def main() -> None:
     tmp = tempfile.mkdtemp(prefix="df_tpu_cluster_")
     paths = make_partitions(tmp)
 
-    # -- 1. control plane + two embedded workers under 1s leases --
-    client = LocalClusterClient(ClusterState())
+    # -- 1. replicated control plane + two embedded workers --
+    primary = ClusterNode(addr="primary:1")
+    standby = ClusterNode(addr="standby:2", standby_of=primary,
+                          election_timeout_s=1.0,
+                          replicate_interval_s=0.2).start()
+    client = LocalClusterClient([primary, standby])
     servers = []
     for _ in range(2):
         server = serve("127.0.0.1:0", device="cpu", cluster=client,
@@ -84,7 +96,7 @@ def main() -> None:
         threading.Thread(target=server.serve_forever, daemon=True).start()
         servers.append(server)
     view = client.membership()
-    print(f"membership epoch {view['epoch']}: "
+    print(f"membership epoch {view['epoch']} (term {view['term']}): "
           f"{sorted(view['workers'])}")
 
     # -- 2. coordinator A: workers discovered, query executed --
@@ -110,27 +122,58 @@ def main() -> None:
           f"({cold_ms / max(warm_ms, 1e-6):.0f}x); "
           f"attrs {rel.stats.attrs}")
 
-    # -- 4. invalidation broadcast beats the TTL --
+    # -- 4. kill the PRIMARY: the standby's election takes over --
+    # wait out the replication lag first: log shipping is asynchronous,
+    # and a kill inside the window loses the unreplicated tail (the
+    # cluster.replication_lag_revisions gauge is exactly this number)
+    deadline = time.monotonic() + 10.0
+    while standby.state._rev < primary.state._rev:
+        assert time.monotonic() < deadline, "standby never caught up"
+        time.sleep(0.05)
+    leases = [s.worker_state.cluster_agent.lease for s in servers]
+    primary.partitioned = True  # SIGKILL, in-process
+    deadline = time.monotonic() + 10.0
+    while standby.role != "primary" and time.monotonic() < deadline:
+        time.sleep(0.05)
+    print(f"primary killed -> standby promoted: role={standby.role}, "
+          f"term={standby.term}, promotions={standby.promotions}")
+    for s, lease in zip(servers, leases):
+        agent = s.worker_state.cluster_agent
+        agent.poll_once()  # heartbeat fails over inside the client
+        assert agent.lease == lease and agent.reregistrations == 0
+    print("worker leases preserved across the failover "
+          "(0 re-registrations)")
+    cc = DistributedContext(cluster=client)  # born after the kill
+    register(cc, paths)
+    rel = cc.sql(SQL)
+    assert isinstance(rel, CachedResultRelation) and rel.entry.shared
+    assert sorted(collect(rel).to_rows()) == rows_a
+    print(f"post-failover coordinator: warm shared hit still lands; "
+          f"gauges {cc.membership.gauges()}")
+
+    # -- 5. invalidation broadcast on the NEW primary beats the TTL --
     total = sum(s.worker_state.fragment_cache.entries for s in servers)
-    ca.broadcast_invalidate("events")
+    ca.broadcast_invalidate("events")  # rides the failover client
     for s in servers:
         s.worker_state.cluster_agent.poll_once()  # the next heartbeat
     left = sum(s.worker_state.fragment_cache.entries for s in servers)
-    print(f"invalidation broadcast: fragment-cache entries {total} -> {left}")
+    print(f"invalidation broadcast (post-failover): fragment-cache "
+          f"entries {total} -> {left}")
 
-    # -- 5. abrupt worker death: shared epoch convergence --
-    e0 = ca.cluster_epoch()
-    servers[1].worker_state.cluster_agent.stop()  # no revoke: a crash
-    servers[1].shutdown()
-    deadline = time.monotonic() + 3 * TTL_S
-    while ca.cluster_epoch() == e0 and time.monotonic() < deadline:
-        time.sleep(0.1)
-    print(f"after kill: epoch {e0} -> A={ca.cluster_epoch()}, "
-          f"B={cb.cluster_epoch()} (one lease TTL)")
-    print(f"coordinator gauges: {ca.membership.gauges()}")
+    # -- 6. the revived old primary is fenced --
+    primary.partitioned = False
+    out = standby.handle_request({"type": "kv_put", "key": "boom",
+                                  "value": 1, "term": 1})
+    print(f"stale-term write from the old primary: {out['code']!r}")
+    primary.handle_request({"type": "peer_status", "term": standby.term,
+                            "role": "primary", "addr": standby.addr})
+    print(f"old primary after the term exchange: role={primary.role}, "
+          f"term={primary.term} (resyncs as a standby)")
 
+    standby.stop()
     ca.close()
     cb.close()
+    cc.close()
     for s in servers:
         agent = s.worker_state.cluster_agent
         if agent is not None:

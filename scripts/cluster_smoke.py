@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Local cluster smoketest: coordinator + 2 workers + kill-one failover,
 plus the cluster control plane (service + shared membership + cache
-coherence).
+coherence) and control-plane HA (primary/standby service failover).
 
 The working version of the reference's intended harness
 (`/root/reference/scripts/smoketest.sh:30-66` wires etcd + worker +
@@ -20,7 +20,14 @@ distributed mode never worked).  Here:
    epoch, coordinator B gets a shared-tier hit on a query warm in
    coordinator A, and an invalidation broadcast drops worker
    fragment-cache entries before TTL;
-5. exit non-zero on any mismatch.
+5. (local mode) HA phase: spawn a PRIMARY + STANDBY service pair +
+   2 workers + 2 coordinators on the two-endpoint address list, run a
+   continuous workload, SIGKILL the primary mid-workload — assert the
+   standby promotes (role=primary, bumped term), zero queries failed,
+   every worker kept its original lease (no re-registrations), a
+   coordinator created AFTER the kill still gets the warm shared-tier
+   hit, and a restarted old primary comes back fenced as a standby;
+6. exit non-zero on any mismatch.
 
 Run directly (processes, works anywhere python does):
 
@@ -190,6 +197,178 @@ def control_plane_smoke(schema, sql, paths, env) -> None:
                 p.kill()
 
 
+def ha_smoke(schema, sql, paths, env) -> None:
+    """Phase 5: control-plane HA — primary + standby services, SIGKILL
+    the primary mid-workload, the fleet must not notice."""
+    import threading
+    import time
+
+    from datafusion_tpu.cache.result import CachedResultRelation
+    from datafusion_tpu.cluster import connect
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+    procs = []
+    try:
+        # -- primary + standby service pair --
+        pri_proc, pri_addr = _start_worker(
+            env, module="datafusion_tpu.cluster", extra_args=()
+        )
+        procs.append(pri_proc)
+        pri = f"{pri_addr[0]}:{pri_addr[1]}"
+        stb_proc, stb_addr = _start_worker(
+            env, module="datafusion_tpu.cluster",
+            extra_args=("--standby-of", pri, "--peers", pri,
+                        "--election-timeout-s", "2"),
+        )
+        procs.append(stb_proc)
+        stb = f"{stb_addr[0]}:{stb_addr[1]}"
+        endpoints = f"{pri},{stb}"
+
+        wenv = dict(env)
+        wenv["DATAFUSION_TPU_CLUSTER"] = endpoints
+        wenv["DATAFUSION_TPU_CLUSTER_TTL_S"] = "2"
+        for _ in range(2):
+            proc, _addr = _start_worker(wenv)
+            procs.append(proc)
+        print(f"HA fleet up: primary {pri} + standby {stb} + 2 workers",
+              flush=True)
+
+        client = connect(endpoints)
+        deadline = time.monotonic() + 120
+        while len(client.membership()["workers"]) < 2:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"workers never registered: {client.membership()}"
+                )
+            time.sleep(0.5)
+
+        def make_ctx(**kwargs):
+            ctx = DistributedContext(cluster=endpoints, **kwargs)
+            ctx.register_datasource(
+                "t",
+                PartitionedDataSource(
+                    [CsvDataSource(p, schema, True, 131072) for p in paths]
+                ),
+            )
+            return ctx
+
+        ca = make_ctx()
+        assert len(ca.workers) == 2, ca.workers
+        want = sorted(collect(ca.sql(sql)).to_rows())
+        assert ca._shared_tier.flush(timeout_s=30), "publish never drained"
+        # wait for the standby to mirror the primary's log (status is
+        # served by any role; the standby reports its replication lag)
+        stb_client = connect(stb)
+        deadline = time.monotonic() + 30
+        while True:
+            st = stb_client.status()
+            if st["role"] == "standby" and \
+                    st["replication_lag_revisions"] == 0 and st["rev"] > 0:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(f"standby never caught up: {st}")
+            time.sleep(0.2)
+        print(f"standby replicated to rev {st['rev']} (lag 0)", flush=True)
+
+        # -- continuous workload while the primary dies (result cache
+        # off on this context so EVERY round genuinely dispatches
+        # fragments to the workers instead of replaying locally) --
+        cw = make_ctx(result_cache=False)
+        errors: list = []
+        results: list = []
+        stop = threading.Event()
+
+        def workload():
+            while not stop.is_set():
+                try:
+                    got = sorted(
+                        collect(cw.sql(sql.replace("-900", "-899")))
+                        .to_rows()
+                    )
+                    results.append(got)
+                except Exception as e:  # noqa: BLE001 — counted, asserted zero
+                    errors.append(e)
+                time.sleep(0.05)
+
+        t = threading.Thread(target=workload)
+        t.start()
+        time.sleep(0.5)
+        procs[0].send_signal(signal.SIGKILL)
+        procs[0].wait(timeout=10)
+        print("killed PRIMARY service (SIGKILL) mid-workload", flush=True)
+
+        deadline = time.monotonic() + 30
+        while True:
+            st = stb_client.status()
+            if st["role"] == "primary":
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(f"standby never promoted: {st}")
+            time.sleep(0.2)
+        promoted_term = st["term"]
+        print(f"standby promoted: role=primary term={promoted_term}",
+              flush=True)
+        assert promoted_term >= 2, st
+        time.sleep(2.5)  # > one lease TTL on the new primary
+        stop.set()
+        t.join(timeout=60)
+        assert not errors, f"queries failed during failover: {errors[:3]}"
+        assert results and all(r == results[0] for r in results)
+        print(f"workload: {len(results)} queries, 0 failed", flush=True)
+
+        # leases survived: no worker had to re-register
+        for addr, status in ca.worker_status().items():
+            assert status is not None, f"worker {addr} unreachable"
+            cl = status["cluster"]
+            assert cl["registered"], (addr, cl)
+            assert cl["reregistrations"] == 0, (addr, cl)
+            assert cl["term"] == promoted_term, (addr, cl)
+        print("leases preserved: 0 re-registrations, term bumped fleet-wide",
+              flush=True)
+
+        # a coordinator born after the kill gets the warm shared hit
+        cb = make_ctx()
+        rel = cb.sql(sql)
+        assert isinstance(rel, CachedResultRelation) and rel.entry.shared, rel
+        assert sorted(collect(rel).to_rows()) == want
+        print("shared tier survived failover: warm hit on the new primary",
+              flush=True)
+
+        # the revived old primary comes back FENCED (peer probe at boot)
+        old_proc, old_addr = _start_worker(
+            env, module="datafusion_tpu.cluster",
+            extra_args=("--peers", stb),
+        )
+        procs[0] = old_proc
+        old_client = connect(f"{old_addr[0]}:{old_addr[1]}")
+        deadline = time.monotonic() + 30
+        while True:
+            st = old_client.status()
+            if st["role"] == "standby" and st["term"] >= promoted_term:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(f"old primary never stepped down: {st}")
+            time.sleep(0.2)
+        print(f"revived old primary fenced: role={st['role']} "
+              f"term={st['term']}", flush=True)
+        ca.close()
+        cb.close()
+        cw.close()
+        print("CONTROL PLANE HA OK", flush=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def main(addrs=None) -> int:
     # a logic smoketest: pin everything to CPU regardless of what
     # accelerator the launching shell is configured for
@@ -299,11 +478,13 @@ def main(addrs=None) -> int:
             )
 
         # -- control plane: service + shared membership + cache tiers --
-        if procs:  # local mode only: the phase spawns its own fleet
+        if procs:  # local mode only: the phases spawn their own fleets
             env = dict(os.environ)
             env.pop("XLA_FLAGS", None)
             env["JAX_PLATFORMS"] = "cpu"
             control_plane_smoke(schema, sql, paths, env)
+            # -- HA: primary + standby, SIGKILL the primary mid-workload --
+            ha_smoke(schema, sql, paths, env)
         else:
             print(
                 "control plane check SKIPPED (external workers)", flush=True

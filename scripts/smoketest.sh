@@ -33,7 +33,7 @@ python scripts/trace_smoke.py
 echo "== cache smoke (result + fragment caches, invalidation, off-switch) =="
 python scripts/cache_smoke.py
 
-echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast) =="
+echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast, primary/standby HA) =="
 python scripts/cluster_smoke.py
 
 echo "== example (reference csv_sql.rs workload) =="

@@ -4,13 +4,20 @@ Covers the lease-KV state machine (grants, refresh piggyback, lazy
 expiry with injectable time, epoch bumps on join/leave, event-log
 truncation), client parity (the in-process client and the TCP service
 run the same `handle_request`), the coordinator `MembershipView` (epoch
-subscription, stale-view tolerance, gauges), the shared result tier
-(wire snapshot roundtrip, read-through install, write-behind publish,
-cross-coordinator warm hit), the invalidation broadcast (worker
-fragment caches drop tagged entries on the next lease refresh, well
-before TTL), multi-coordinator convergence after a worker kill, and the
-chaos variants under `testing/faults` (service partition, lease expiry,
-stale watch).
+subscription, push watches, stale-view tolerance, gauges), the shared
+result tier (wire snapshot roundtrip, binary-segment publish,
+read-through install, write-behind publish, cross-coordinator warm
+hit), the invalidation broadcast (worker fragment caches drop tagged
+entries on the next lease refresh, well before TTL), multi-coordinator
+convergence after a worker kill, and the chaos variants under
+`testing/faults` (service partition, lease expiry, stale watch).
+
+HA coverage (`TestReplication` / `TestFailoverChaos`): log-shipping
+standbys, snapshot catch-up after truncation, lease-based election on
+primary silence, term fencing (standby write rejection, stale-term
+writes, revived-old-primary demotion), multi-endpoint client failover,
+lease survival across promotion, post-failover warm shared-tier hits,
+and automatic worker sync on membership epoch changes.
 """
 
 from __future__ import annotations
@@ -24,6 +31,7 @@ import pytest
 from datafusion_tpu.cache.result import CachedResult, CachedResultRelation
 from datafusion_tpu.cache.store import CacheStore
 from datafusion_tpu.cluster import (
+    ClusterNode,
     ClusterState,
     LocalClusterClient,
     connect,
@@ -633,3 +641,456 @@ class TestClusterIntegration:
             finally:
                 server.worker_state.cluster_agent.close()
                 server.server_close()
+
+
+# -- replication / failover (control-plane HA) ----------------------------
+
+
+def _pair(election_timeout_s=1.0):
+    """Primary + standby nodes over separate states, in-process."""
+    a = ClusterNode(addr="a:1")
+    b = ClusterNode(addr="b:2", standby_of=a,
+                    election_timeout_s=election_timeout_s)
+    return a, b, LocalClusterClient([a, b])
+
+
+class TestReplication:
+    def test_standby_tails_primary_log(self):
+        a, b, client = _pair()
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+        client.put("config/x", 42)
+        client.invalidate("t")
+        applied = b.replicate_once()
+        assert applied >= 4  # grant + join + put + invalidate
+        assert b.state._rev == a.state._rev
+        assert b.state.get("config/x") == 42
+        assert b.state.membership()["workers"].keys() == {"w:9"}
+        assert b.state.membership()["epoch"] == a.state.membership()["epoch"]
+        assert b.replication_lag_revisions == 0
+
+    def test_result_tier_replicates_with_values(self):
+        a, b, client = _pair()
+        entry = _snapshot()
+        client.result_publish("fp", entry, 64, ("t",))
+        b.replicate_once()
+        stored = b.state.result_get("fp")
+        assert stored is not None
+        np.testing.assert_array_equal(
+            stored["snapshot"]["columns"][0], entry.columns[0]
+        )
+
+    def test_snapshot_catchup_after_truncation(self):
+        a, b, client = _pair()
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+        for i in range(1200):  # blow past the 1024-event window
+            client.invalidate(f"t{i}")
+        assert b.replicate_once() == -1  # full snapshot, not a tail
+        assert b.snapshots_applied == 1
+        assert b.state._rev == a.state._rev
+        assert b.state.membership()["workers"].keys() == {"w:9"}
+        # incremental shipping resumes after the snapshot
+        client.put("config/x", 1)
+        assert b.replicate_once() >= 1
+        assert b.state.get("config/x") == 1
+
+    def test_standby_rejects_reads_and_writes(self):
+        a, b, _ = _pair()
+        out = b.handle_request({"type": "kv_put", "key": "k", "value": 1})
+        assert out.get("code") == "not_primary"
+        assert out.get("primary") == "a:1"  # the redirect hint
+        out = b.handle_request({"type": "membership"})
+        assert out.get("code") == "not_primary"
+        # ping and status still answer (health checks, operators)
+        assert b.handle_request({"type": "ping"})["type"] == "pong"
+        assert b.handle_request({"type": "status"})["role"] == "standby"
+
+    def test_promotion_on_primary_silence_rearms_leases(self):
+        a, b, client = _pair(election_timeout_s=1.0)
+        g = client.lease_grant(2.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        b.replicate_once()
+        a.partitioned = True
+        now = time.monotonic()
+        with pytest.raises(ConnectionError):
+            b.replicate_once()
+        assert not b.maybe_promote(now=now)  # silence too short
+        assert b.maybe_promote(now=now + 1.5)
+        assert b.role == "primary" and b.term == 2
+        # the replicated lease survived the takeover with a fresh TTL
+        resp = LocalClusterClient(b).lease_refresh(g["lease"])
+        assert resp["found"] and resp["term"] == 2
+
+    def test_election_fault_site_aborts_promotion(self):
+        a, b, _ = _pair(election_timeout_s=0.5)
+        a.partitioned = True
+        now = time.monotonic() + 10.0
+        with faults.scoped({"rules": [
+            {"site": "cluster.election", "op": "raise",
+             "exc": "ExecutionError", "count": 1},
+        ]}):
+            with pytest.raises(Exception):
+                b.maybe_promote(now=now)
+            assert b.role == "standby"  # the aborted round changed nothing
+        assert b.maybe_promote(now=now)
+
+    def test_replicate_fault_site_is_transient(self):
+        a, b, _ = _pair()
+        a.state.put("config/x", 1)
+        with faults.scoped({"rules": [
+            {"site": "cluster.replicate", "op": "raise",
+             "exc": "ConnectionResetError", "count": 1},
+        ]}):
+            with pytest.raises(ConnectionError):
+                b.replicate_once()
+        b.replicate_once()  # the next round catches up
+        assert b.state.get("config/x") == 1
+
+    def test_stale_term_write_rejected_and_old_primary_demoted(self):
+        """The split-brain fence: standby promotes past a partitioned
+        primary; the revived old primary is demoted on its first term
+        exchange, and a write stamped with its stale term is refused."""
+        from datafusion_tpu.errors import StaleTermError
+
+        a, b, client = _pair(election_timeout_s=0.5)
+        client.put("config/x", 1)
+        b.replicate_once()
+        a.partitioned = True
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        a.partitioned = False  # the old primary revives, still term 1
+        old_term = a.term
+        assert a.role == "primary" and old_term < b.term
+        # a write carrying the deposed term is fenced
+        out = b.handle_request({"type": "kv_put", "key": "boom",
+                                "value": 1, "term": old_term})
+        assert out.get("code") == "stale_term"
+        with pytest.raises(StaleTermError):
+            LocalClusterClient(b).request(
+                {"type": "kv_put", "key": "boom", "value": 1,
+                 "term": old_term}
+            )
+        assert b.state.get("boom") is None
+        assert METRICS.counts.get("cluster.stale_term_writes_rejected", 0) >= 1
+        # the term exchange demotes the old primary...
+        b.handle_request({"type": "replicate_pull", "since": a.state._rev,
+                          "term": a.term, "addr": "a:1"})  # b keeps primacy
+        a.handle_request({"type": "peer_status", "term": b.term,
+                          "role": "primary", "addr": "b:2"})
+        assert a.role == "standby" and a.term == b.term
+        # ...and it resyncs FROM the new primary via a full snapshot
+        a.retarget(b)  # in-process: dial the node, not "b:2"
+        assert a.replicate_once() == -1
+        assert a.state._rev == b.state._rev
+
+    def test_standby_refuses_replication_pulls(self):
+        """A deposed/never-primary node must not feed the log: the
+        puller gets the redirect hint instead of silently tailing a
+        non-primary (which would also defer its election forever)."""
+        a, b, _ = _pair()
+        out = a.handle_request({"type": "replicate_pull", "since": 0,
+                                "term": b.term, "addr": "b:2"})
+        assert out["type"] == "replicate"  # primary serves pulls
+        out = b.handle_request({"type": "replicate_pull", "since": 0,
+                                "term": 1, "addr": "c:3"})
+        assert out.get("code") == "not_primary"
+        assert out.get("primary") == "a:1"  # chase this instead
+
+    def test_configured_workers_never_auto_retired(self):
+        """Explicitly configured handles are the operator's call: an
+        epoch change must not remove them even when the membership
+        view has never seen them (only flip them via the monitor)."""
+        st = ClusterState()
+        c = LocalClusterClient(st)
+        g1, g2 = c.lease_grant(30.0), c.lease_grant(30.0)
+        c.put("workers/10.0.0.8:1", {}, lease=g1["lease"])
+        c.put("workers/10.0.0.9:1", {}, lease=g2["lease"])
+        ctx = DistributedContext([("203.0.113.7", 4)], cluster=c,
+                                 result_cache=False)
+        try:
+            assert len(ctx.workers) == 1 and not ctx.workers[0].discovered
+            ctx.sync_workers()  # folds the registered workers in
+            addrs = {f"{w.host}:{w.port}" for w in ctx.workers}
+            assert addrs == {"203.0.113.7:4", "10.0.0.8:1", "10.0.0.9:1"}
+            c.lease_revoke(g2["lease"])  # one registered worker leaves
+            ctx.sync_workers()
+            addrs = {f"{w.host}:{w.port}" for w in ctx.workers}
+            # discovered leaver retired; configured handle untouched
+            # even though the (non-empty) view has never seen it
+            assert addrs == {"203.0.113.7:4", "10.0.0.8:1"}
+        finally:
+            ctx.close()
+
+    def test_rev_regression_after_failover_clears_worker_cache(self):
+        """A failover can land on a standby whose log was BEHIND the
+        revision a worker had already consumed; events the new primary
+        issues inside that gap are filtered out of every future tail
+        (`since` is too high) — unobservable, like a truncation — so
+        the worker must treat its fragment cache as suspect."""
+
+        class _FakeWorkerState:
+            batch_size = 4
+            fragment_cache = CacheStore(1 << 20, name="rvreg")
+
+        a, b, client = _pair(election_timeout_s=0.5)
+        ws = _FakeWorkerState()
+        agent = WorkerClusterAgent(client, "w:1", ws, ttl_s=30.0)
+        agent.poll_once()  # register on the primary
+        b.replicate_once()  # standby mirrors the registration...
+        for i in range(5):  # ...but NOT these: the unreplicated tail
+            client.invalidate(f"gap{i}")
+        agent.poll_once()  # the worker consumed the tail (last_rev high)
+        ws.fragment_cache.put("stale", b"x", 1, tags=("events",))
+        a.partitioned = True
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        # an invalidation on the new primary lands INSIDE the gap the
+        # worker's cursor already skipped past
+        client.invalidate("events")
+        assert b.state._rev < agent.last_rev
+        agent.poll_once()
+        assert ws.fragment_cache.entries == 0  # suspect cache cleared
+        assert METRICS.counts.get("worker.cluster_rev_regressions", 0) >= 1
+
+    def test_client_failover_and_redirect(self):
+        a, b, client = _pair(election_timeout_s=0.5)
+        b.replicate_once()
+        a.partitioned = True
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        base = METRICS.counts.get("cluster.client_failovers", 0)
+        # endpoint sweep: a (dead) -> b (promoted) without the caller
+        # seeing anything but the answer
+        rev = client.put("config/y", 7)
+        assert rev > 0 and b.state.get("config/y") == 7
+        assert METRICS.counts.get("cluster.client_failovers", 0) > base
+        # subsequent requests start at the promoted endpoint (sticky)
+        assert client.nodes[client._active % 2] is b
+
+    def test_redirect_hint_follows_primary(self):
+        a, b, client = _pair()
+        b.replicate_once()
+        # ask the standby FIRST: the not_primary redirect must land on a
+        client._active = 1
+        assert client.put("config/z", 3) > 0
+        assert a.state.get("config/z") == 3
+        assert METRICS.counts.get("cluster.client_redirects", 0) >= 1
+
+    def test_watch_unparks_on_event(self):
+        a, _, client = _pair()
+        rev0 = a.state._rev
+        got = {}
+
+        def park():
+            got.update(client.watch(rev0, timeout_s=5.0))
+
+        t = threading.Thread(target=park)
+        t.start()
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        client.invalidate("t")
+        t.join(timeout=5.0)
+        assert time.monotonic() - t0 < 2.0  # pushed, not polled
+        assert got.get("fired") is True
+        assert [e["kind"] for e in got["events"]] == ["invalidate"]
+        assert "workers" in got  # membership piggybacks on the answer
+
+    def test_watch_timeout_returns_fresh_membership(self):
+        a, _, client = _pair()
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        rev0 = a.state._rev
+        out = client.watch(rev0, timeout_s=0.05)
+        assert out.get("fired") is False
+        assert out["events"] == [] and "w:9" in out["workers"]
+
+    def test_membership_view_watch_and_subscribe(self):
+        a, _, client = _pair()
+        view = MembershipView(client)
+        view.refresh()
+        seen = []
+        view.subscribe(lambda v: seen.append(v.epoch))
+        g = client.lease_grant(30.0)
+
+        def join_later():
+            time.sleep(0.1)
+            client.put("workers/w:9", {}, lease=g["lease"])
+
+        t = threading.Thread(target=join_later)
+        t.start()
+        assert view.watch(timeout_s=5.0)
+        t.join()
+        if not seen:  # the watch can race the put; one more park settles it
+            assert view.watch(timeout_s=5.0)
+        assert seen and view.live_addresses() == {"w:9"}
+        assert view.term >= 1
+
+    def test_replicated_state_serves_clients_after_promotion(self):
+        """The acceptance path in miniature: writes land on the primary,
+        the standby promotes, and every consumer-visible read (KV,
+        membership, events, shared tier) answers identically."""
+        a, b, client = _pair(election_timeout_s=0.5)
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+        client.result_publish("fp", _snapshot(), 64, ("t",))
+        b.replicate_once()
+        a.partitioned = True
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        assert client.membership()["workers"].keys() == {"w:9"}
+        fetched = client.result_fetch("fp")
+        assert fetched is not None and fetched[0].shared
+        tail = client.events_since(0)
+        assert any(e["kind"] == "join" for e in tail["events"])
+
+
+class TestBinaryPublish:
+    def test_tcp_publish_uses_raw_segments_not_base64(self):
+        """Satellite: shared-tier snapshots cross the wire as binary RAW
+        segments; `coord.shared_cache_publish_bytes` proves the cost is
+        ~the raw bytes, not raw * 4/3."""
+        from datafusion_tpu.cluster.service import serve as serve_cluster
+
+        server = serve_cluster("127.0.0.1:0")
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            host, port = server.server_address[:2]
+            client = connect(f"{host}:{port}")
+            cols = [np.arange(100_000, dtype=np.int64)]
+            raw_bytes = cols[0].nbytes
+            entry = CachedResult(cols, [None], [None], 100_000, raw_bytes)
+            tier = SharedResultTier(client)
+            store = CacheStore(1 << 24, name="bin")
+            store.shared = tier
+            base = METRICS.counts.get("coord.shared_cache_publish_bytes", 0)
+            store.put("fp-big", entry, raw_bytes, tags=("t",))
+            assert tier.flush(timeout_s=20.0)
+            sent = METRICS.counts["coord.shared_cache_publish_bytes"] - base
+            assert 0 < sent < raw_bytes * 1.05  # base64 would be ~1.33x
+            # and the fetch roundtrips through the binary frames
+            other = CacheStore(1 << 24, name="bin2")
+            other.shared = SharedResultTier(client)
+            got = other.get("fp-big")
+            assert got is not None and got.shared
+            np.testing.assert_array_equal(got.columns[0], cols[0])
+            tier.close()
+        finally:
+            server.shutdown()
+            server.server_close()
+
+
+class TestFailoverChaos:
+    """Satellite: kill the primary mid-workload under seeded faults and
+    prove the fleet never notices — standby promotes within one lease
+    TTL, no lease is lost, the warm shared tier survives, and the
+    revived old primary is fenced."""
+
+    def test_primary_kill_mid_workload(self, tmp_path):
+        from datafusion_tpu import cache as qcache
+
+        paths = _write_parts(tmp_path)
+        a = ClusterNode(addr="a:1")
+        b = ClusterNode(addr="b:2", standby_of=a, election_timeout_s=0.5)
+        client = LocalClusterClient([a, b])
+        servers = []
+        with qcache.configured(enabled=True):
+            for _ in range(2):
+                server = serve("127.0.0.1:0", device="cpu",
+                               cluster=client, lease_ttl_s=1.0)
+                threading.Thread(target=server.serve_forever,
+                                 daemon=True).start()
+                servers.append(server)
+            ctx = DistributedContext(cluster=client)
+            try:
+                _register(ctx, paths)
+                want = sorted(collect(ctx.sql(DSQL)).to_rows())
+                assert ctx._shared_tier.flush(timeout_s=10.0)
+                b.replicate_once()
+                leases = [s.worker_state.cluster_agent.lease
+                          for s in servers]
+                # seeded chaos riding along: the standby's first
+                # replication pull after the kill fails transiently
+                with faults.scoped({"seed": 11, "rules": [
+                    {"site": "cluster.replicate", "op": "raise",
+                     "exc": "ConnectionResetError", "count": 1},
+                ]}):
+                    a.partitioned = True  # SIGKILL, in-process
+                    with pytest.raises(ConnectionError):
+                        b.replicate_once()
+                    assert b.maybe_promote(now=time.monotonic() + 1.0)
+                assert b.term == 2
+                # every worker heartbeat lands on the new primary with
+                # its ORIGINAL lease — nothing was lost in the handoff
+                for server, lease in zip(servers, leases):
+                    agent = server.worker_state.cluster_agent
+                    agent.poll_once()
+                    assert agent.lease == lease
+                    assert agent.reregistrations == 0
+                    assert agent.term == 2
+                # membership rode over: same worker set, same epoch
+                assert ctx.cluster_epoch() == 2
+                assert len(ctx.membership.live_addresses()) == 2
+                # a second coordinator's warm shared-tier hit still
+                # lands — the replicated result tier survived the kill
+                cb = DistributedContext(cluster=client)
+                try:
+                    _register(cb, paths)
+                    rel = cb.sql(DSQL)
+                    assert isinstance(rel, CachedResultRelation)
+                    assert rel.entry.shared
+                    assert sorted(collect(rel).to_rows()) == want
+                finally:
+                    cb.close()
+                # queries keep completing post-failover (zero failed):
+                # a FRESH fingerprint forces a real fragment dispatch
+                cold = ctx.sql(
+                    "SELECT region, COUNT(1) FROM t GROUP BY region"
+                )
+                assert not isinstance(cold, CachedResultRelation)
+                assert len(collect(cold).to_rows()) == len(want)
+                # the revived old primary is fenced, not obeyed
+                a.partitioned = False
+                out = b.handle_request({"type": "kv_put", "key": "boom",
+                                        "value": 1, "term": 1})
+                assert out.get("code") == "stale_term"
+                a.handle_request({"type": "peer_status", "term": b.term,
+                                  "role": "primary", "addr": "b:2"})
+                assert a.role == "standby"
+            finally:
+                ctx.close()
+                for server in servers:
+                    agent = server.worker_state.cluster_agent
+                    if agent is not None:
+                        agent.close()
+                    server.shutdown()
+                    server.server_close()
+
+    def test_auto_worker_sync_on_epoch_change(self, cluster):
+        """Satellite: the epoch-change callback folds joiners in and
+        retires leavers without any sync_workers() call."""
+        with DistributedContext(cluster=cluster.client,
+                                result_cache=False) as ctx:
+            assert len(ctx.workers) == 2
+            late = serve("127.0.0.1:0", device="cpu",
+                         cluster=cluster.client, lease_ttl_s=1.0)
+            threading.Thread(target=late.serve_forever, daemon=True).start()
+            try:
+                # any view consumer observes the epoch move; the
+                # subscription folds the joiner — no sync_workers()
+                deadline = time.monotonic() + 5.0
+                while len(ctx.workers) < 3:
+                    ctx.cluster_epoch()
+                    if time.monotonic() > deadline:
+                        raise AssertionError(f"never folded: {ctx.workers}")
+                    time.sleep(0.05)
+                assert len(ctx.workers) == 3
+            finally:
+                late.worker_state.cluster_agent.close()
+                late.shutdown()
+                late.server_close()
+            # the leaver is retired from the rotation automatically too
+            deadline = time.monotonic() + 5.0
+            while len(ctx.workers) > 2:
+                ctx.cluster_epoch()
+                if time.monotonic() > deadline:
+                    raise AssertionError(f"never retired: {ctx.workers}")
+                time.sleep(0.05)
+            assert len(ctx.workers) == 2
