@@ -74,6 +74,26 @@ DENSE_GROUP_MAX = 64
 _WIDEN_IDS_JIT = jax.jit(lambda w: w.astype(jnp.int32))
 
 
+def _pallas_agg_max() -> int:
+    from datafusion_tpu.exec import pallas as _pallas
+
+    return _pallas.agg_max_groups()
+
+
+def _probe_hash_agg():
+    """Tiny compile probe for the Pallas hash-agg kernel on the current
+    backend (pallas.probe_ok caches the outcome process-wide)."""
+    from datafusion_tpu.exec.pallas import hash_agg as _hagg
+
+    ids = jnp.zeros(8, jnp.int32)
+    vals = jnp.ones(8, jnp.int64)
+    live = jnp.ones(8, bool)
+    out = jax.jit(
+        lambda i, v, l: _hagg.grouped_reduce(i, v, l, 4, "sum")
+    )(ids, vals, live)
+    np.asarray(out)
+
+
 def group_capacity(n: int) -> int:
     """Accumulator capacity: next power of two, floor 8.  Kept tight
     (unlike row-batch bucketing) because capacities <= DENSE_GROUP_MAX
@@ -429,7 +449,7 @@ class _AggregateCore:
     every executable in its cache."""
 
     def __init__(self, in_schema, group_expr, aggr_expr, predicate, functions,
-                 param_slots=None):
+                 param_slots=None, accel=False, allow_pallas=True):
         for g in group_expr:
             if not isinstance(g, Column):
                 raise NotSupportedError(f"GROUP BY supports column references, got {g!r}")
@@ -461,8 +481,20 @@ class _AggregateCore:
         # per-column codec memory for put_compressed (persists across
         # cold re-runs of the same query shape — see batch.py)
         self.wire_hints: dict = {}
+        # Pallas hash-agg engagement is a trace-time fact of this core
+        # (the build key folds it in, so mode flips mint a fresh core):
+        # accelerator batches only, within the kernel's group window,
+        # and only if the backend's one-shot compile probe passes
+        from datafusion_tpu.exec import pallas as _pallas
+
+        self._pallas_agg = allow_pallas and _pallas.enabled_for(accel)
+        if self._pallas_agg and not _pallas.interpret_mode():
+            self._pallas_agg = _pallas.probe_ok("hash_agg", _probe_hash_agg)
         self.jit = jax.jit(self._kernel)
         self.fused_jit = jax.jit(self._fused_kernel)
+        # fused-pass batch-group fold (exec/fused.py): ONE launch per
+        # shape-homogeneous group of prepared batches
+        self.group_jit = jax.jit(self._fused_group)
 
     def _fused_kernel(self, chunk, state, params):
         """Fold `_kernel` over a chunk of prepared batches in ONE device
@@ -481,7 +513,9 @@ class _AggregateCore:
         return ([] if predicate is None else [predicate]) + list(aggr_expr)
 
     @staticmethod
-    def build(in_schema, group_expr, aggr_expr, predicate, functions):
+    def build(in_schema, group_expr, aggr_expr, predicate, functions,
+              accel=False, allow_pallas=True):
+        from datafusion_tpu.exec import pallas as _pallas
         from datafusion_tpu.exec.kernels import (
             cached_kernel,
             functions_fingerprint,
@@ -499,12 +533,15 @@ class _AggregateCore:
             fps[n_pred:],
             fps[0] if n_pred else None,
             functions_fingerprint(functions),
+            # kernel-engagement facts baked into the traced program
+            (accel, allow_pallas),
+            _pallas.config_signature() if allow_pallas else (),
         )
         return cached_kernel(
             key,
             lambda: _AggregateCore(
                 in_schema, group_expr, aggr_expr, predicate, functions,
-                slot_by_id,
+                slot_by_id, accel=accel, allow_pallas=allow_pallas,
             ),
         )
 
@@ -603,6 +640,8 @@ class _AggregateCore:
         group_cap = counts.shape[0]
         if group_cap <= DENSE_GROUP_MAX:
             return self._dense_update(env, capacity, mask, ids, counts, accs, str_aux)
+        if self._pallas_agg and group_cap <= _pallas_agg_max():
+            return self._pallas_update(env, capacity, mask, ids, counts, accs, str_aux)
         return self._sortmerge_update(env, capacity, mask, ids, counts, accs, str_aux)
 
     def _slot_inputs(self, env, capacity, mask):
@@ -674,6 +713,42 @@ class _AggregateCore:
         out, _ = jax.lax.associative_scan(op, (vals, start))
         return out
 
+    def _sm_contribs(self, env, capacity, mask, ids, str_aux):
+        """Per-batch contribution columns of the sort-merge combine:
+        (batch_keys, [row-count contrib, one per non-aliased slot...],
+        payload_of).  Split out of the combine so the fused batch-group
+        fold can concatenate MANY batches' contributions and pay for
+        ONE sort instead of one per batch."""
+        SENT = jnp.int64(jnp.iinfo(jnp.int64).max)
+        inputs = self._slot_inputs(env, capacity, mask)
+        batch_keys = jnp.where(mask, ids.astype(jnp.int64), SENT)
+        contribs = [mask.astype(jnp.int64)]  # row count
+        payload_of: dict[int, int] = {}
+        for i, (sl, (v, ok)) in enumerate(zip(self.slots, inputs)):
+            if sl.kind == "cnt" and ok is mask:
+                continue  # aliases the row count payload
+            if sl.is_string:
+                # contribute in lexicographic-rank space under the
+                # current dict version
+                ranks, _ = str_aux[i]
+                cap = ranks.shape[0]
+                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
+                contrib = jnp.where(ok, r, self._rank_sentinel(sl.kind))
+            elif sl.kind == "sum":
+                contrib = jnp.where(ok, v, 0).astype(sl.acc_dtype)
+            elif sl.kind == "cnt":
+                contrib = ok.astype(jnp.int64)
+            else:
+                ident = (
+                    _min_identity(sl.acc_dtype)
+                    if sl.kind == "min"
+                    else _max_identity(sl.acc_dtype)
+                )
+                contrib = jnp.where(ok, v.astype(sl.acc_dtype), ident)
+            payload_of[i] = len(contribs)
+            contribs.append(contrib)
+        return batch_keys, contribs, payload_of
+
     def _sortmerge_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """High-cardinality path (group capacity > DENSE_GROUP_MAX):
         sort-merge aggregation, the scatter-free XLA shape.
@@ -687,44 +762,35 @@ class _AggregateCore:
         least once (the state contributes all of them), so the first G
         entries of the compaction sort are exactly groups 0..G-1.
         """
+        batch_keys, contribs, payload_of = self._sm_contribs(
+            env, capacity, mask, ids, str_aux
+        )
+        return self._sm_combine(
+            counts, accs, batch_keys, contribs, payload_of, str_aux
+        )
+
+    def _sm_combine(self, counts, accs, batch_keys, contribs, payload_of,
+                    str_aux=()):
+        """Merge (possibly multi-batch, concatenated) sort-merge
+        contributions into the dense state — the sort + segmented-scan
+        + compaction half of `_sortmerge_update`."""
         G = counts.shape[0]
         SENT = jnp.int64(jnp.iinfo(jnp.int64).max)
-        inputs = self._slot_inputs(env, capacity, mask)
-
         state_keys = jnp.arange(G, dtype=jnp.int64)
-        batch_keys = jnp.where(mask, ids.astype(jnp.int64), SENT)
         keys = jnp.concatenate([state_keys, batch_keys])
 
         # payload columns: row count first, then one per non-aliased slot
-        payloads = [jnp.concatenate([counts, mask.astype(jnp.int64)])]
-        payload_of: dict[int, int] = {}
-        for i, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
-            if sl.kind == "cnt" and ok is mask:
-                continue  # aliases the row count payload
+        payloads = [jnp.concatenate([counts, contribs[0]])]
+        for i, (sl, acc) in enumerate(zip(self.slots, accs)):
+            p = payload_of.get(i)
+            if p is None:
+                continue
             if sl.is_string:
-                # merge by lexicographic rank under the current dict
-                # version; state codes convert to ranks on entry
-                ranks, _ = str_aux[i]
-                cap = ranks.shape[0]
+                # state codes convert to ranks on entry
                 acc_rank = self._codes_to_ranks(sl.kind, acc, str_aux[i])
-                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
-                contrib = jnp.where(ok, r, self._rank_sentinel(sl.kind))
-            elif sl.kind == "sum":
-                acc_rank = acc
-                contrib = jnp.where(ok, v, 0).astype(acc.dtype)
-            elif sl.kind == "cnt":
-                acc_rank = acc
-                contrib = ok.astype(jnp.int64)
             else:
-                ident = (
-                    _min_identity(sl.acc_dtype)
-                    if sl.kind == "min"
-                    else _max_identity(sl.acc_dtype)
-                )
                 acc_rank = acc
-                contrib = jnp.where(ok, v.astype(acc.dtype), ident)
-            payload_of[i] = len(payloads)
-            payloads.append(jnp.concatenate([acc_rank, contrib]))
+            payloads.append(jnp.concatenate([acc_rank, contribs[p]]))
 
         sorted_ops = jax.lax.sort([keys] + payloads, num_keys=1)
         skeys = sorted_ops[0]
@@ -768,6 +834,121 @@ class _AggregateCore:
             else:
                 new_accs.append(val)
         return new_counts, tuple(new_accs)
+
+    def _pallas_update(self, env, capacity, mask, ids, counts, accs,
+                       str_aux=()):
+        """Hash-aggregation path via the Pallas kernel library
+        (exec/pallas/hash_agg.py): dense ids ARE the hash, per-block
+        partials build in VMEM and combine across row blocks — no sort,
+        no scatter.  Engaged between DENSE_GROUP_MAX and the kernel's
+        group window; contribution semantics mirror `_sm_contribs`
+        exactly (identity-filled dead rows), so results match the
+        sort-merge path up to float reassociation."""
+        from datafusion_tpu.exec import pallas as _pallas
+        from datafusion_tpu.exec.pallas import hash_agg as _hagg
+
+        interp = _pallas.interpret_mode()
+        G = counts.shape[0]
+        inputs = self._slot_inputs(env, capacity, mask)
+
+        def red(vals, kind):
+            return _hagg.grouped_reduce(
+                ids, vals, mask, G, kind, interpret=interp
+            )
+
+        d_counts = red(mask.astype(jnp.int64), "sum")
+        new_counts = counts + d_counts
+        new_accs = []
+        for i, (sl, (v, ok), acc) in enumerate(zip(self.slots, inputs, accs)):
+            if sl.kind == "cnt" and ok is mask:
+                new_accs.append(acc + d_counts)
+            elif sl.is_string:
+                ranks, _ = str_aux[i]
+                cap = ranks.shape[0]
+                r = ranks[jnp.clip(v.astype(jnp.int32), 0, cap - 1)]
+                contrib = jnp.where(ok, r, self._rank_sentinel(sl.kind))
+                best = red(contrib, "min" if sl.kind == "smin" else "max")
+                new_accs.append(
+                    self._string_combine(sl.kind, acc, best, str_aux[i])
+                )
+            elif sl.kind == "sum":
+                new_accs.append(
+                    acc + red(jnp.where(ok, v, 0).astype(acc.dtype), "sum")
+                )
+            elif sl.kind == "cnt":
+                new_accs.append(acc + red(ok.astype(jnp.int64), "sum"))
+            else:
+                ident = (
+                    _min_identity(sl.acc_dtype)
+                    if sl.kind == "min"
+                    else _max_identity(sl.acc_dtype)
+                )
+                r = red(jnp.where(ok, v.astype(acc.dtype), ident), sl.kind)
+                new_accs.append(
+                    jnp.minimum(acc, r) if sl.kind == "min"
+                    else jnp.maximum(acc, r)
+                )
+        return new_counts, tuple(new_accs)
+
+    def _fused_group(self, entries, state, aux, str_aux, params):
+        """ONE device launch for a whole batch group (exec/fused.py).
+
+        entries: per-batch (cols, valids, num_rows, mask|None, ids)
+        pytrees with identical structure/shapes.  Dense-path (and
+        Pallas-path) capacities fold with `lax.scan` — the per-batch
+        kernel body traces once, not once per batch.  Sort-merge
+        capacities instead concatenate every batch's contribution
+        columns and run ONE sort + segmented reduce for the whole
+        group: n_batches fewer big sorts, the state concat amortized
+        across the group (the BENCH_r04 high-cardinality regression was
+        exactly per-batch state-sized sorts)."""
+        from datafusion_tpu.exec.fused import stack_entries
+
+        counts, _ = state
+        G = counts.shape[0]
+        if G <= DENSE_GROUP_MAX or (
+            self._pallas_agg and G <= _pallas_agg_max()
+        ):
+            stacked = stack_entries(entries)
+
+            def body(st, x):
+                cols, valids, num_rows, mask, ids = x
+                return self._kernel(
+                    cols, valids, aux, num_rows, mask, ids, st, str_aux,
+                    params,
+                ), None
+
+            state, _ = jax.lax.scan(body, state, stacked)
+            return state
+
+        keys_l, contribs_l = [], []
+        payload_of: dict[int, int] = {}
+        for cols, valids, num_rows, mask, ids in entries:
+            env = Env(cols, valids, aux, self.col_map, params)
+            capacity = cols[0].shape[0] if cols else ids.shape[0]
+            m = jnp.arange(capacity, dtype=jnp.int32) < num_rows
+            if mask is not None:
+                m = m & mask
+            if self._pred_fn is not None:
+                pv, pvalid = self._pred_fn(env)
+                pv = jnp.broadcast_to(pv, (capacity,))
+                if pvalid is not None:
+                    pv = pv & jnp.broadcast_to(pvalid, (capacity,))
+                m = m & pv
+            bk, contribs, payload_of = self._sm_contribs(
+                env, capacity, m, ids, str_aux
+            )
+            keys_l.append(bk)
+            contribs_l.append(contribs)
+        counts, accs = state
+        batch_keys = jnp.concatenate(keys_l)
+        cat = [
+            jnp.concatenate([c[p] for c in contribs_l])
+            for p in range(len(contribs_l[0]))
+        ]
+        return self._sm_combine(
+            counts, accs, batch_keys, cat, payload_of, str_aux
+        )
 
     def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """Small-group path: segment reduction against a one-hot
@@ -954,6 +1135,10 @@ class AggregateRelation(Relation):
     process-wide across relations with the same plan fingerprint.
     """
 
+    # the Pallas hash-agg path is per-device-kernel work; subclasses
+    # whose kernels run inside shard_map bodies opt out
+    _pallas_ok = True
+
     def __init__(
         self,
         child: Relation,
@@ -997,7 +1182,8 @@ class AggregateRelation(Relation):
         self._allow_host_split = True
         self.core = _AggregateCore.build(
             child.schema, list(group_expr), list(aggr_expr), core_pred,
-            functions,
+            functions, accel=_is_accelerator(device),
+            allow_pallas=self._pallas_ok,
         )
         # THIS query's literal values for the shared core's parameter
         # slots (identical fingerprints guarantee identical slot order)
@@ -1113,6 +1299,7 @@ class AggregateRelation(Relation):
             link_rate_mbps,
         )
         from datafusion_tpu.exec.hostfn import host_evaluable
+        from datafusion_tpu.exec.relation import _is_accelerator
 
         if not self._allow_host_split or not _wire_enabled(self.device):
             return None
@@ -1192,6 +1379,8 @@ class AggregateRelation(Relation):
             core2 = _AggregateCore.build(
                 self.child.schema, self._group_expr, dev_exprs,
                 self._core_pred, self._functions,
+                accel=_is_accelerator(self.device),
+                allow_pallas=self._pallas_ok,
             )
             params2 = parameterize_exprs(
                 _AggregateCore.param_exprs(self._core_pred, dev_exprs)
@@ -1282,16 +1471,63 @@ class AggregateRelation(Relation):
 
             batches = staged_pipeline(batches, _stage)
 
+        from datafusion_tpu.exec.fused import (
+            fuse_group_max,
+            fusion_enabled,
+            iter_groups,
+            pad_group,
+        )
         from datafusion_tpu.exec.kernels import fuse_batch_count
 
         # batches per device launch: prepared inputs accumulate host-
         # side and dispatch as ONE fused kernel (launch round trips are
-        # the warm-path bottleneck on tunneled devices)
-        fuse = fuse_batch_count()
+        # the warm-path bottleneck on tunneled devices).  Fused-pass
+        # mode (the default) folds whole batch GROUPS — maximal runs of
+        # batches with one shape class — into one launch each;
+        # DATAFUSION_TPU_FUSE=0 restores the fixed 16-batch unrolled
+        # chunks byte-identically.
+        fused_mode = fusion_enabled()
+        fuse = fuse_group_max() if fused_mode else fuse_batch_count()
 
         state = None
         capacity = 0
         chunk: list = []
+
+        def dispatch_chunk(state):
+            if len(chunk) == 1:
+                c = chunk[0]
+                return device_call(
+                    core.jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
+                    c[6], params,
+                )
+            if not fused_mode:
+                return device_call(
+                    core.fused_jit, tuple(chunk), state, params
+                )
+            # one launch per shape-homogeneous batch group, padded to
+            # the group-size ladder with zero-row (identity) entries so
+            # scans of any length reuse a small set of compiled programs
+            entries = [(c[0], c[1], c[3], c[4], c[5]) for c in chunk]
+            shareds = [(c[2], c[6]) for c in chunk]
+            for idxs, (aux, str_aux) in iter_groups(entries, shareds):
+                if len(idxs) == 1:
+                    c = chunk[idxs[0]]
+                    state = device_call(
+                        core.jit, c[0], c[1], c[2], c[3], c[4], c[5],
+                        state, c[6], params,
+                    )
+                    continue
+                group = pad_group(
+                    [entries[i] for i in idxs],
+                    lambda e: (e[0], e[1], np.int32(0), e[3], e[4]),
+                )
+                METRICS.add("fused.groups")
+                METRICS.add("fused.group_batches", len(idxs))
+                state = device_call(
+                    core.group_jit, tuple(group), state, aux, str_aux,
+                    params,
+                )
+            return state
 
         def flush():
             nonlocal state, capacity
@@ -1308,16 +1544,11 @@ class AggregateRelation(Relation):
                 capacity = needed
             with METRICS.timer("execute.aggregate"), op_timer(self), \
                     device_scope(self.device):
-                if len(chunk) == 1:
-                    c = chunk[0]
-                    state = device_call(
-                        core.jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
-                        c[6], params,
-                    )
-                else:
-                    state = device_call(
-                        core.fused_jit, tuple(chunk), state, params
-                    )
+                state = dispatch_chunk(state)
+            if self._op_stats is not None:
+                self.stats.attrs["fused_batches"] = (
+                    self.stats.attrs.get("fused_batches", 0) + len(chunk)
+                )
             chunk.clear()
 
         for batch in batches:

@@ -34,6 +34,7 @@ from datafusion_tpu.exec.datasource import (
     NdJsonDataSource,
     ParquetDataSource,
 )
+from datafusion_tpu.exec import fused
 from datafusion_tpu.exec.materialize import ResultTable, collect
 from datafusion_tpu.exec.relation import DataSourceRelation, PipelineRelation, Relation
 from datafusion_tpu.exec.sort import LimitRelation, SortRelation
@@ -409,6 +410,10 @@ class ExecutionContext:
 
     def _execute_plan(self, plan: LogicalPlan) -> Relation:
         fns = self._jax_functions()
+        if fused.fusion_enabled():
+            rel = self._execute_fused(plan, fns)
+            if rel is not None:
+                return rel
         if isinstance(plan, TableScan):
             ds = self.datasources.get(plan.table_name)
             if ds is None:
@@ -465,6 +470,77 @@ class ExecutionContext:
                 )
             return LimitRelation(self.execute(plan.input), plan.limit, plan.schema)
         raise ExecutionError(f"Cannot execute plan node {type(plan).__name__}")
+
+    def _execute_fused(self, plan: LogicalPlan, fns) -> Optional[Relation]:
+        """Fused-pass plan-chain collapse (exec/fused.py): lower whole
+        filter->project->aggregate chains — and [Limit](Sort(...)) over
+        filter/column-projection chains — into ONE physical operator.
+        Returns None whenever a chain doesn't qualify (the caller falls
+        through to the default per-operator lowering, which already
+        fuses the two-node shapes)."""
+        from datafusion_tpu.exec.hostfn import contains_host_fn
+
+        if isinstance(plan, Aggregate):
+            hit = fused.rewrite_aggregate(plan)
+            if hit is None:
+                return None
+            base, group_expr, aggr_expr, pred = hit
+            checked = ([] if pred is None else [pred]) + [
+                a.args[0] for a in aggr_expr if a.args
+            ]
+            if any(contains_host_fn(e, self.functions) for e in checked):
+                return None
+            try:
+                rel = AggregateRelation(
+                    self.execute(base), group_expr, aggr_expr, plan.schema,
+                    predicate=pred, functions=fns, device=self.device,
+                )
+            except (NotSupportedError, PlanError):
+                return None  # inlined shape the kernel can't take
+            rel._fused_chain = "filter+project+aggregate"
+            return rel
+
+        if isinstance(plan, (Selection, Projection)):
+            flat = fused.flatten_chain(plan)
+            if flat is None:
+                return None
+            base, pred, proj, n = flat
+            # single nodes and Projection(Selection(x)) lower to the
+            # exact same fused PipelineRelation below — only DEEPER
+            # chains (stacked selections/projections from subqueries or
+            # DataFrame pipelines) need the collapse
+            if n <= 1 or (
+                n == 2
+                and isinstance(plan, Projection)
+                and isinstance(plan.input, Selection)
+            ):
+                return None
+            if pred is not None and contains_host_fn(pred, self.functions):
+                return None
+            rel = PipelineRelation(
+                self.execute(base), pred, proj, plan.schema,
+                functions=fns, device=self.device,
+                function_metas=self.functions,
+            )
+            rel._fused_chain = f"{n}-node chain"
+            return rel
+
+        limit = None
+        sort = plan
+        if isinstance(plan, Limit) and isinstance(plan.input, Sort):
+            limit, sort = plan.limit, plan.input
+        if isinstance(sort, Sort):
+            hit = fused.rewrite_sort(sort, limit)
+            if hit is None:
+                return None
+            base, keys, pred, out_cols = hit
+            rel = SortRelation(
+                self.execute(base), keys, plan.schema, limit=limit,
+                device=self.device, predicate=pred, output_cols=out_cols,
+            )
+            rel._fused_chain = "filter+project+sort"
+            return rel
+        return None
 
     def execute_physical(self, physical_plan):
         """Execute a PhysicalPlan statement wrapper — the unit of work

@@ -30,13 +30,20 @@ _REGISTRY: OrderedDict = OrderedDict()
 
 def cached_kernel(key, build: Callable):
     """The cached compiled core for `key`, building it on first use;
-    least-recently-used cores evict past the registry bound."""
+    least-recently-used cores evict past the registry bound.  Hit/miss
+    counters feed the fused-pass observability (EXPLAIN ANALYZE's
+    per-query compile-cache line, the Prometheus export): a repeated
+    query must show zero misses."""
+    from datafusion_tpu.utils.metrics import METRICS
+
     hit = _REGISTRY.get(key)
     if hit is None:
+        METRICS.add("kernel_cache.misses")
         hit = _REGISTRY[key] = build()
         while len(_REGISTRY) > _MAX_CORES:
             _REGISTRY.popitem(last=False)
     else:
+        METRICS.add("kernel_cache.hits")
         _REGISTRY.move_to_end(key)
     return hit
 

@@ -1,0 +1,159 @@
+"""Pallas grouped-aggregation kernel (dense ids, VMEM partials).
+
+The host `GroupKeyEncoder` assigns dense group ids, so "hash build" is
+already done: id IS the accumulator slot.  What XLA lacks is a fast
+scatter-reduce on TPU (scatter executes serially there — the reason
+the stock path sorts).  This kernel instead sweeps group *tiles*:
+
+    grid = (G/TILE_G, N/BLOCK_R)
+
+Each step loads one BLOCK_R-row slice of (ids, values, liveness) into
+VMEM, builds the one-hot membership of its rows against one TILE_G
+group tile, and reduces it into the tile's accumulator — which lives
+in VMEM across all row blocks of that tile (the output block is
+revisited: TPU grids iterate the last axis innermost).  For G beyond
+`agg_max_groups` the tile sweep's O(N * G) work loses to sorting and
+the caller keeps the sort-merge path; within it, every row block is
+read once per tile from HBM and all accumulation is on-chip.  Group
+counts above the VMEM tile budget spill across HBM-resident output
+tiles — one per grid row — exactly the "HBM-resident partials" shape.
+
+Arithmetic is dtype-preserving (int64 sums stay exact; f64 reduces in
+f64), so results match the engine's other paths to reassociation only.
+`grouped_reduce_numpy` is the parity fallback/oracle.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+
+
+TILE_G = int(os.environ.get("DATAFUSION_TPU_PALLAS_AGG_TILE", 512))
+BLOCK_R = int(os.environ.get("DATAFUSION_TPU_PALLAS_AGG_BLOCK", 2048))
+
+_COMBINE = {"sum": "add", "min": "min", "max": "max"}
+
+
+def _identity(kind: str, dtype) -> np.generic:
+    dtype = np.dtype(dtype)
+    if kind == "sum":
+        return np.zeros((), dtype)[()]
+    if dtype.kind == "f":
+        return np.asarray(np.inf if kind == "min" else -np.inf, dtype)[()]
+    if dtype.kind == "b":
+        return np.asarray(kind == "min", dtype)[()]
+    info = np.iinfo(dtype)
+    return np.asarray(info.max if kind == "min" else info.min, dtype)[()]
+
+
+def _kernel(ids_ref, val_ref, live_ref, out_ref, *, kind, ident, tile_g,
+            block_r):
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    gt = pl.program_id(0)
+
+    @pl.when(pl.program_id(1) == 0)
+    def _init():
+        out_ref[...] = jnp.full((tile_g,), ident, out_ref.dtype)
+
+    ids = ids_ref[...]
+    vals = val_ref[...]
+    live = live_ref[...]
+    g0 = gt * tile_g
+    # [rows, tile] one-hot membership of this row block in this group
+    # tile; dead rows (padding / filtered / null-arg) hit nothing
+    gidx = g0 + lax.broadcasted_iota(jnp.int32, (block_r, tile_g), 1)
+    hit = (ids[:, None] == gidx) & live[:, None]
+    cell = jnp.where(hit, vals[:, None], jnp.asarray(ident, vals.dtype))
+    if kind == "sum":
+        contrib = jnp.sum(cell, axis=0)
+        out_ref[...] = out_ref[...] + contrib
+    elif kind == "min":
+        contrib = jnp.min(cell, axis=0)
+        out_ref[...] = jnp.minimum(out_ref[...], contrib)
+    else:
+        contrib = jnp.max(cell, axis=0)
+        out_ref[...] = jnp.maximum(out_ref[...], contrib)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(kind: str, dtype_str: str, n_pad: int, g_pad: int,
+                tile_g: int, block_r: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    ident = _identity(kind, np.dtype(dtype_str))
+    kern = functools.partial(
+        _kernel, kind=kind, ident=ident, tile_g=tile_g, block_r=block_r
+    )
+    return pl.pallas_call(
+        kern,
+        grid=(g_pad // tile_g, n_pad // block_r),
+        in_specs=[
+            pl.BlockSpec((block_r,), lambda g, b: (b,)),
+            pl.BlockSpec((block_r,), lambda g, b: (b,)),
+            pl.BlockSpec((block_r,), lambda g, b: (b,)),
+        ],
+        out_specs=pl.BlockSpec((tile_g,), lambda g, b: (g,)),
+        out_shape=jax.ShapeDtypeStruct((g_pad,), jnp.dtype(dtype_str)),
+        interpret=interpret,
+    )
+
+
+def _pad_up(n: int, mult: int) -> int:
+    return -(-n // mult) * mult
+
+
+def grouped_reduce(ids, vals, live, num_groups: int, kind: str,
+                   interpret: bool = False):
+    """Per-group reduction of `vals` by dense int32 `ids` (traceable —
+    call under jit).  `live` masks rows out (they contribute the
+    identity); `kind` is "sum" | "min" | "max".  Returns a
+    [num_groups] array of vals.dtype."""
+    import jax.numpy as jnp
+
+    if kind not in _COMBINE:
+        raise ValueError(f"unknown reduce kind {kind!r}")
+    n = ids.shape[0]
+    n_pad = _pad_up(max(n, 1), BLOCK_R)
+    g_pad = _pad_up(max(num_groups, 1), TILE_G)
+    if n_pad != n:
+        pad = n_pad - n
+        ids = jnp.concatenate([ids, jnp.zeros(pad, ids.dtype)])
+        vals = jnp.concatenate([vals, jnp.zeros(pad, vals.dtype)])
+        live = jnp.concatenate([live, jnp.zeros(pad, bool)])
+    call = _build_call(
+        kind, str(np.dtype(vals.dtype)), n_pad, g_pad, TILE_G, BLOCK_R,
+        interpret,
+    )
+    out = call(ids.astype(jnp.int32), vals, live)
+    return out[:num_groups]
+
+
+def grouped_reduce_numpy(ids, vals, live, num_groups: int, kind: str):
+    """Numpy parity oracle / fallback for `grouped_reduce` (identical
+    dead-row and identity semantics)."""
+    ids = np.asarray(ids)
+    vals = np.asarray(vals)
+    live = np.asarray(live, bool)
+    out = np.full(num_groups, _identity(kind, vals.dtype), vals.dtype)
+    sel = live & (ids >= 0) & (ids < num_groups)
+    if kind == "sum":
+        if vals.dtype.kind == "f":
+            out += np.bincount(
+                ids[sel], weights=vals[sel].astype(np.float64),
+                minlength=num_groups,
+            )[:num_groups].astype(vals.dtype)
+        else:
+            np.add.at(out, ids[sel], vals[sel])
+    elif kind == "min":
+        np.minimum.at(out, ids[sel], vals[sel])
+    else:
+        np.maximum.at(out, ids[sel], vals[sel])
+    return out

@@ -1,0 +1,149 @@
+"""Pallas segmented bitonic sort (VMEM compare-exchange network).
+
+One launch sorts a whole run: the (key, index) pair lives in VMEM and
+the full bitonic network — log^2(N)/2 compare-exchange stages — runs
+as one kernel, no per-stage HBM round trips.  Partner pairing at
+distance j is a reshape to [N/2j, 2, j] (the XOR-partner trick: the
+two halves of axis 1 are each element's partner), so no gather/scatter
+is ever needed; direction bits derive from the block index.
+
+Stability: bitonic networks are not stable, so the comparator orders
+(key, original index) lexicographically — a total order, which makes
+the output exactly the *stable* ascending permutation.  Padding rows
+carry key = int64.max and the largest indices, so they sink to the
+tail and callers slice [:n].
+
+Multi-key orders compose as chained passes (`argsort_multi`): sort by
+the last key first, then re-sort by each earlier key with the running
+permutation as the tiebreak index — the classic LSD composition, all
+inside one jitted computation.
+
+`argsort_numpy` is the parity oracle.  Callers must gate with
+`pallas.probe_ok("sort", ...)`: the 1-D reshape network is beyond some
+Mosaic versions, and the probe downgrades to `lax.sort` cleanly.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+
+def _stages(n: int):
+    """(block, distance) pairs of the bitonic network over n=2^k."""
+    k = 2
+    while k <= n:
+        j = k // 2
+        while j >= 1:
+            yield k, j
+            j //= 2
+        k *= 2
+
+
+def _cmpx(keys, idx, k: int, j: int):
+    """One compare-exchange stage at distance j inside sort-blocks of
+    size k, on [N] arrays (pure jnp — runs inside the kernel)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = keys.shape[0]
+    m = n // (2 * j)
+    k2 = keys.reshape(m, 2, j)
+    i2 = idx.reshape(m, 2, j)
+    lo_k, hi_k = k2[:, 0], k2[:, 1]
+    lo_i, hi_i = i2[:, 0], i2[:, 1]
+    # ascending iff the element's size-k sort block has an even index:
+    # global index = a*2j + ..., block = global // k — constant per row
+    # a because k >= 2j
+    a = lax.broadcasted_iota(jnp.int32, (m, 1), 0)
+    asc = ((a * (2 * j)) // k) % 2 == 0
+    # lexicographic (key, index) comparator = stable total order
+    gt = (lo_k > hi_k) | ((lo_k == hi_k) & (lo_i > hi_i))
+    swap = jnp.where(asc, gt, ~gt)
+    nlo_k = jnp.where(swap, hi_k, lo_k)
+    nhi_k = jnp.where(swap, lo_k, hi_k)
+    nlo_i = jnp.where(swap, hi_i, lo_i)
+    nhi_i = jnp.where(swap, lo_i, hi_i)
+    keys = jnp.stack([nlo_k, nhi_k], axis=1).reshape(n)
+    idx = jnp.stack([nlo_i, nhi_i], axis=1).reshape(n)
+    return keys, idx
+
+
+def _sort_kernel(k_ref, i_ref, ko_ref, io_ref, *, n: int):
+    keys = k_ref[...]
+    idx = i_ref[...]
+    # the network is static in n: unrolled python loop, one fused body
+    for k, j in _stages(n):
+        keys, idx = _cmpx(keys, idx, k, j)
+    ko_ref[...] = keys
+    io_ref[...] = idx
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(n_pad: int, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        functools.partial(_sort_kernel, n=n_pad),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad,), jnp.int64),
+            jax.ShapeDtypeStruct((n_pad,), jnp.int32),
+        ),
+        interpret=interpret,
+    )
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def argsort_i64(keys, interpret: bool = False):
+    """Stable ascending argsort of an int64 array (traceable).  Returns
+    the int32 permutation over the input length."""
+    import jax.numpy as jnp
+
+    n = keys.shape[0]
+    n_pad = max(_pow2(n), 2)
+    if n_pad != n:
+        keys = jnp.concatenate([
+            keys.astype(jnp.int64),
+            jnp.full(n_pad - n, np.int64(np.iinfo(np.int64).max), jnp.int64),
+        ])
+    else:
+        keys = keys.astype(jnp.int64)
+    idx = jnp.arange(n_pad, dtype=jnp.int32)
+    _, perm = _build_call(n_pad, interpret)(keys, idx)
+    return perm[:n]
+
+
+def argsort_multi(ops, interpret: bool = False):
+    """Stable lexicographic argsort of one-or-more int64 key operands
+    (significance order: ops[0] primary).  Chained passes: sort by the
+    last key, then re-sort by each earlier key with the running
+    permutation carried as the gather order — each pass's (key, index)
+    comparator preserves the previous pass's order among ties."""
+    import jax.numpy as jnp
+
+    perm = None
+    for op in reversed(list(ops)):
+        op = op.astype(jnp.int64)
+        if perm is None:
+            perm = argsort_i64(op, interpret)
+            continue
+        p = argsort_i64(op[perm], interpret)
+        perm = perm[p]
+    return perm
+
+
+def argsort_numpy(ops) -> np.ndarray:
+    """Parity oracle: numpy stable lexicographic argsort (ops[0]
+    primary — note np.lexsort's reversed significance)."""
+    return np.lexsort(tuple(np.asarray(o) for o in reversed(list(ops)))).astype(
+        np.int32
+    )

@@ -219,6 +219,31 @@ class _PipelineCore:
         # every scan skips the encode probe ladder
         self.wire_hints: dict = {}
         self.jit = jax.jit(self._kernel)
+        # fused-pass batch-group map (exec/fused.py): one launch runs
+        # the filter+project kernel over a whole group of batches
+        self.group_jit = jax.jit(self._fused_group)
+
+    def _fused_group(self, entries, aux, params):
+        """ONE launch for a group of prepared batches: `lax.map` of the
+        fused kernel over the stacked group; outputs return per batch
+        (the unstacking slices fuse into the same program, so consumers
+        see ordinary per-batch arrays without extra dispatches)."""
+        from datafusion_tpu.exec.fused import stack_entries
+
+        stacked = stack_entries(entries)
+
+        def body(x):
+            cols, valids, num_rows, mask = x
+            out_cols, out_valids, m = self._kernel(
+                cols, valids, aux, num_rows, mask, params
+            )
+            return tuple(out_cols), tuple(out_valids), m
+
+        ys = jax.lax.map(body, stacked)
+        return tuple(
+            jax.tree.map(lambda t, i=i: t[i], ys)
+            for i in range(len(entries))
+        )
 
     @staticmethod
     def param_exprs(predicate, projections, metas, in_schema=None,
@@ -423,6 +448,15 @@ class PipelineRelation(Relation):
 
             batches = staged_pipeline(batches, _stage)
 
+        from datafusion_tpu.exec.fused import fusion_enabled
+
+        if core.needs_kernel and fusion_enabled():
+            # fused-pass mode: one launch per batch group instead of
+            # one per batch (DATAFUSION_TPU_FUSE=0 restores the
+            # per-batch loop below byte-identically)
+            yield from self._batches_fused(batches)
+            return
+
         for batch in batches:
             if not core.needs_kernel:
                 # pure column selection: yield a STABLE output batch per
@@ -498,6 +532,106 @@ class PipelineRelation(Relation):
                     out,
                 )
             yield out
+
+    def _batches_fused(self, batches) -> Iterator[RecordBatch]:
+        """Kernel-path batches in fused-pass mode: prepared per-batch
+        inputs buffer into shape-homogeneous groups of up to
+        `pipeline_group_max()` and each group dispatches as ONE device
+        launch (cold scans stop paying a dispatch round trip per
+        batch — the csv_scan_filter satellite)."""
+        from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.fused import (
+            entry_signature,
+            pad_group,
+            pipeline_group_max,
+        )
+        from datafusion_tpu.obs.stats import op_timer
+
+        core = self.core
+        group_max = pipeline_group_max()
+        buf: list = []  # (batch, entry, aux)
+        cur_sig = None
+
+        def prepare(batch):
+            staged = batch.cache.get("staged_aux")
+            if staged is not None and staged[0] is core:
+                aux = staged[1]
+            else:
+                aux = tuple(
+                    compute_aux_values(core.aux_specs, batch, self._aux_cache)
+                )
+            # timed + operator-ambient like the per-batch loop, so H2D
+            # bytes/time keep attributing to this operator in EXPLAIN
+            # ANALYZE (record_h2d reads the ambient op)
+            with METRICS.timer("execute.pipeline"), op_timer(self), \
+                    device_scope(self.device):
+                data, validity, mask_in = device_inputs(
+                    self._subset_view(batch), self.device, core.wire_hints
+                )
+                if self._host_pred_expr is not None:
+                    mask_in = self._device_mask(batch)
+            return aux, (data, validity, np.int32(batch.num_rows), mask_in)
+
+        def flush() -> list:
+            if not buf:
+                return []
+            with METRICS.timer("execute.pipeline"), op_timer(self), \
+                    device_scope(self.device):
+                if len(buf) == 1:
+                    b, e, aux = buf[0]
+                    outs = [device_call(
+                        core.jit, e[0], e[1], aux, e[2], e[3], self._params
+                    )]
+                else:
+                    group = pad_group(
+                        [e for _, e, _ in buf],
+                        lambda e: (e[0], e[1], np.int32(0), e[3]),
+                    )
+                    METRICS.add("fused.groups")
+                    METRICS.add("fused.group_batches", len(buf))
+                    outs = device_call(
+                        core.group_jit, tuple(group), buf[0][2], self._params
+                    )
+            emitted = [
+                self._emit_kernel_output(b, list(cols), list(valids), mask)
+                for (b, _, _), (cols, valids, mask) in zip(buf, outs)
+            ]
+            buf.clear()
+            return emitted
+
+        for batch in batches:
+            aux, entry = prepare(batch)
+            sig = (entry_signature(entry), tuple(map(id, aux)))
+            if buf and (sig != cur_sig or len(buf) >= group_max):
+                yield from flush()
+            cur_sig = sig
+            buf.append((batch, entry, aux))
+        yield from flush()
+
+    def _emit_kernel_output(self, batch, cols, valids, mask) -> RecordBatch:
+        """Assemble one output batch from the kernel's computed columns
+        (identity passthroughs and host-routed projections interleave
+        exactly as on the per-batch path)."""
+        core = self.core
+        if core.proj_fns is None:
+            # filter-only: the input columns, untouched
+            out_cols, out_valids, dicts = batch.data, batch.validity, batch.dicts
+        else:
+            dicts = [
+                batch.dicts[src] if src is not None else None
+                for src in core.out_dict_sources
+            ]
+            out_cols, out_valids, dicts = self._assemble_outputs(
+                batch, cols, valids, list(dicts)
+            )
+        return RecordBatch(
+            self._schema,
+            list(out_cols),
+            list(out_valids),
+            dicts,
+            num_rows=batch.num_rows,
+            mask=mask,
+        )
 
     def _host_pred_mask(self, batch) -> np.ndarray:
         """This query's host-routed predicate over one batch, as a

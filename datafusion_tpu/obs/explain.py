@@ -91,19 +91,36 @@ class ExplainAnalyzeResult:
     the annotated report; `chrome_trace()` exports the timeline."""
 
     def __init__(self, plan, root, result, spans: list[dict],
-                 trace_id: str, wall_s: float):
+                 trace_id: str, wall_s: float, counters: Optional[dict] = None):
         self.plan = plan
         self.root = root
         self.result = result
         self.spans = spans
         self.trace_id = trace_id
         self.wall_s = wall_s
+        # per-query engine counter deltas (device launches, compile-
+        # cache hits/misses, fused batch groups) — the fused-pass
+        # observability satellite
+        self.counters = counters or {}
 
     def report(self) -> str:
         lines = [f"EXPLAIN ANALYZE  (trace {self.trace_id}, "
                  f"wall {_fmt_s(self.wall_s)}, rows {self.result.num_rows})"]
         for depth, rel in collect_tree(self.root):
-            lines.append("  " * (depth + 1) + _op_line(rel))
+            fused_chain = getattr(rel, "_fused_chain", None)
+            marker = f"  <- fused pass [{fused_chain}]" if fused_chain else ""
+            lines.append("  " * (depth + 1) + _op_line(rel) + marker)
+        if self.counters:
+            c = self.counters
+            lines.append(
+                "Fused passes: "
+                f"launches_per_pass={c.get('device.launches', 0)}, "
+                f"fused_groups={c.get('fused.groups', 0)} "
+                f"({c.get('fused.group_batches', 0)} batches), "
+                f"kernel_cache hit/miss="
+                f"{c.get('kernel_cache.hits', 0)}/"
+                f"{c.get('kernel_cache.misses', 0)}"
+            )
         worker_spans = sum(
             1 for s in self.spans if str(s.get("proc", "")).startswith("worker")
         )
@@ -153,13 +170,29 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
     the annotated result.  The query runs to completion (EXPLAIN
     ANALYZE measures a real execution, not an estimate)."""
     from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.utils.metrics import METRICS
 
+    _WATCHED = ("device.launches", "kernel_cache.hits",
+                "kernel_cache.misses", "fused.groups",
+                "fused.group_batches")
+    before = dict(METRICS.counts)
     with trace.session() as tc:
         t0 = time.perf_counter()
         with trace.span("query", plan=type(plan).__name__):
             rel = ctx.execute(plan)
             table = collect(_RootTap(rel))
         wall = time.perf_counter() - t0
+    counters = {
+        k: METRICS.counts.get(k, 0) - before.get(k, 0) for k in _WATCHED
+    }
+    # exported as Prometheus gauges (obs/export.py renders
+    # METRICS.gauges): last instrumented query's fused-pass facts
+    METRICS.gauge("query.launches_per_pass", counters["device.launches"])
+    METRICS.gauge(
+        "query.kernel_cache_misses", counters["kernel_cache.misses"]
+    )
     spans = trace.drain(tc.trace_id)
     spans.sort(key=lambda s: s["start_ns"])
-    return ExplainAnalyzeResult(plan, rel, table, spans, tc.trace_id, wall)
+    return ExplainAnalyzeResult(
+        plan, rel, table, spans, tc.trace_id, wall, counters
+    )

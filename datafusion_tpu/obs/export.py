@@ -96,11 +96,14 @@ def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
             f'datafusion_tpu_events_total{{name="{_metric_name(k)}"}} '
             f"{snap['counts'][k]}"
         )
+    gauges = dict(snap.get("gauges") or {})
     if extra_gauges:
+        gauges.update(extra_gauges)
+    if gauges:
         lines.append("# TYPE datafusion_tpu_gauge gauge")
-        for k in sorted(extra_gauges):
+        for k in sorted(gauges):
             lines.append(
                 f'datafusion_tpu_gauge{{name="{_metric_name(k)}"}} '
-                f"{extra_gauges[k]}"
+                f"{gauges[k]}"
             )
     return "\n".join(lines) + "\n"

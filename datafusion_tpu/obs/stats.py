@@ -94,6 +94,15 @@ def record_retry() -> None:
         st.retries += 1
 
 
+def record_launch() -> None:
+    """Attribute one device-executable launch to the ambient operator
+    (shows as `launches=` in EXPLAIN ANALYZE — the fused-pass work is
+    judged by this number going down)."""
+    st = _CUR_OP.get()
+    if st is not None:
+        st.attrs["launches"] = st.attrs.get("launches", 0) + 1
+
+
 def live_rows(batch) -> int:
     """Rows a batch actually contributes (mask- and padding-aware).
     Pulls a device-resident mask to host — only ever called on

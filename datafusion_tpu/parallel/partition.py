@@ -599,6 +599,10 @@ class PartitionedAggregateRelation(AggregateRelation):
     per-shard body of a `shard_map`; adds the collective final combine.
     """
 
+    # per-shard kernels run inside shard_map bodies: keep the Pallas
+    # hash-agg path (a per-device kernel) out of the traced collective
+    _pallas_ok = False
+
     def __init__(
         self,
         children: list[Relation],
@@ -616,6 +620,23 @@ class PartitionedAggregateRelation(AggregateRelation):
         self.children = children
         self.mesh = mesh
         self.n_shards = int(np.prod(mesh.devices.shape))
+        # warm round-input cache: a re-collected relation (repeated
+        # query over in-memory partitions) reuses each round's padded +
+        # device-placed shard stacks instead of re-padding and
+        # re-transferring every column per run — the per-round host
+        # overhead was most of the r05 0.94x mesh-vs-single gap.
+        # Entries pin their round's batch objects, so the id()-keys
+        # stay valid; FIFO-bounded.
+        from collections import OrderedDict
+
+        self._round_cache: OrderedDict = OrderedDict()
+        self._round_cache_max = 64
+        # second-chance admission (mirrors SortRelation._run_seen): a
+        # round key must be SEEN twice before its device stacks are
+        # stored, so file-backed scans — fresh batch objects every run,
+        # their id()-keys can never repeat — pin no HBM at all
+        self._round_seen: OrderedDict = OrderedDict()
+        self._init_stacked_cache: dict = {}
         # the shard_map jits are keyed on the PROCESS-WIDE core (not
         # this relation): a fresh PartitionedContext per query would
         # otherwise rebuild `jax.jit(shard_map(...))` around new bound
@@ -628,10 +649,17 @@ class PartitionedAggregateRelation(AggregateRelation):
 
     # -- stacked state management --
     def _init_stacked_state(self, capacity: int):
+        # cached per capacity: building + sharding the empty stacked
+        # state costs device launches every accumulate() otherwise;
+        # states are functionally consumed, never mutated
+        hit = self._init_stacked_cache.get(capacity)
+        if hit is not None:
+            return hit
         counts, accs = self._init_state(capacity)
         tile = lambda t: jnp.broadcast_to(t[None], (self.n_shards,) + t.shape)
-        state = (tile(counts), jax.tree.map(tile, accs))
-        return self._shard_state(state)
+        state = self._shard_state((tile(counts), jax.tree.map(tile, accs)))
+        self._init_stacked_cache[capacity] = state
+        return state
 
     def _shard_state(self, state):
         sharding = NamedSharding(self.mesh, P(MESH_AXIS))
@@ -689,6 +717,41 @@ class PartitionedAggregateRelation(AggregateRelation):
                 bucket_capacity(1),
                 *(b.capacity for b in round_batches if b is not None),
             )
+            round_key = (
+                tuple(-1 if b is None else id(b) for b in round_batches),
+                cap,
+                tuple(
+                    tuple(
+                        d.version if d is not None else -1 for d in b.dicts
+                    )
+                    for b in round_batches
+                    if b is not None
+                ),
+            )
+            hit = self._round_cache.get(round_key)
+            if hit is not None:
+                # warm round: the padded shard stacks are already on
+                # their mesh devices (and the group ids this relation's
+                # encoder assigned are append-stable, so they replay
+                # exactly); only the state update kernel runs
+                METRICS.add("mesh.round_cache_hits")
+                (_, put_cols, put_valids, aux, rows_dev, put_mask,
+                 put_ids, str_aux) = hit
+                needed = self._pick_capacity(group_cap)
+                if state is None:
+                    group_cap = needed
+                    state = self._init_stacked_state(group_cap)
+                elif needed > group_cap:
+                    state = self._grow_stacked_state(state, needed)
+                    group_cap = needed
+                with METRICS.timer("execute.partitioned_aggregate"), \
+                        op_timer(self):
+                    state = device_call(
+                        self._stacked_jit, put_cols, put_valids, aux,
+                        rows_dev, put_mask, put_ids, state, str_aux,
+                        self._params,
+                    )
+                continue
             views = [
                 None if b is None else self._device_view(b)
                 for b in round_batches
@@ -765,19 +828,35 @@ class PartitionedAggregateRelation(AggregateRelation):
                 else []
             )
             str_aux = self._compute_str_aux(live_batch)
+            put_cols = tuple(stacker.put(s) for s in col_shards)
+            put_valids = tuple(
+                stacker.put(s) if has_valid[c_i] else None
+                for c_i, s in enumerate(valid_shards)
+            )
+            rows_dev = jnp.asarray(rows_np)
+            put_mask = stacker.put(mask_shards)
+            put_ids = stacker.put(id_shards)
+            if round_key in self._round_seen:
+                self._round_cache[round_key] = (
+                    tuple(round_batches), put_cols, put_valids, tuple(aux),
+                    rows_dev, put_mask, put_ids, str_aux,
+                )
+                while len(self._round_cache) > self._round_cache_max:
+                    self._round_cache.popitem(last=False)
+            else:
+                self._round_seen[round_key] = True
+                while len(self._round_seen) > 4 * self._round_cache_max:
+                    self._round_seen.popitem(last=False)
             with METRICS.timer("execute.partitioned_aggregate"), \
                     op_timer(self):
                 state = device_call(
                     self._stacked_jit,
-                    tuple(stacker.put(s) for s in col_shards),
-                    tuple(
-                        stacker.put(s) if has_valid[c_i] else None
-                        for c_i, s in enumerate(valid_shards)
-                    ),
+                    put_cols,
+                    put_valids,
                     tuple(aux),
-                    jnp.asarray(rows_np),
-                    stacker.put(mask_shards),
-                    stacker.put(id_shards),
+                    rows_dev,
+                    put_mask,
+                    put_ids,
                     state,
                     str_aux,
                     self._params,

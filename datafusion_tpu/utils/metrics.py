@@ -19,10 +19,12 @@ class Metrics:
     def __init__(self):
         self.timings: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.gauges: dict[str, float] = {}
 
     def reset(self):
         self.timings.clear()
         self.counts.clear()
+        self.gauges.clear()
 
     @contextmanager
     def timer(self, name: str):
@@ -55,10 +57,16 @@ class Metrics:
                 self.timings[name] += time.perf_counter() - t0
             yield item
 
+    def gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time gauge (last-write-wins): per-query facts
+        like `query.launches_per_pass` that counters can't express."""
+        self.gauges[name] = value
+
     def snapshot(self) -> dict:
         return {
             "timings_s": dict(self.timings),
             "counts": dict(self.counts),
+            "gauges": dict(self.gauges),
         }
 
 

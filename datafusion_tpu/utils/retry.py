@@ -76,7 +76,16 @@ def device_call(fn, /, *args, **kwargs):
     while True:
         try:
             faults.check("device.call", attempt=attempt)
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            # every successful dispatch is one executable launch — the
+            # unit the fused-pass work minimizes (launches_per_pass in
+            # EXPLAIN ANALYZE / bench derives from this counter);
+            # counted AFTER fn so failed attempts/retries don't inflate
+            METRICS.add("device.launches")
+            from datafusion_tpu.obs.stats import record_launch
+
+            record_launch()
+            return out
         except Exception as e:  # jax.errors.JaxRuntimeError and kin
             transient = classify_transient(e)
             if transient is None:

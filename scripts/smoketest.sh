@@ -33,6 +33,9 @@ python scripts/trace_smoke.py
 echo "== cache smoke (result + fragment caches, invalidation, off-switch) =="
 python scripts/cache_smoke.py
 
+echo "== kernel smoke (fused vs unfused parity, no-recompile-on-repeat, Pallas interpret parity) =="
+python scripts/kernel_smoke.py
+
 echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast, primary/standby HA) =="
 python scripts/cluster_smoke.py
 

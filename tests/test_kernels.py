@@ -1,0 +1,453 @@
+"""Kernel parity + fused-pass coverage (ISSUE 6).
+
+Three layers, all on the CPU tier-1 backend:
+
+- Pallas kernels against their numpy oracles through the Pallas
+  interpreter (`interpret=True` — same kernel code path the TPU runs,
+  minus Mosaic lowering).
+- The engine's fused-pass mode (`DATAFUSION_TPU_FUSE`, default on)
+  against the unfused per-operator path: identical results, fewer
+  launches, plan-chain collapse in effect.
+- Sort semantics that must survive any backend/kernel swap: stability,
+  NaN / signed-zero ordering, multi-key and mixed-dtype keys, and
+  high-cardinality group-by exact-key/count parity vs numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from datafusion_tpu import DataType, ExecutionContext, Field, Schema
+from datafusion_tpu.exec.batch import make_host_batch
+from datafusion_tpu.exec.datasource import MemoryDataSource
+from datafusion_tpu.exec.materialize import collect
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def _ctx(schema, columns, validity=None, batch_size=4096, name="t"):
+    from datafusion_tpu.exec.batch import StringDictionary
+
+    n = len(columns[0])
+    # Utf8 columns travel as dictionary codes (one shared dictionary
+    # per column, as a real scan produces)
+    dicts = [None] * len(columns)
+    cols = []
+    for j, c in enumerate(columns):
+        c = np.asarray(c)
+        if schema.field(j).data_type == DataType.UTF8:
+            dicts[j] = StringDictionary()
+            c = dicts[j].encode([str(x) for x in c])
+        cols.append(c)
+    batches = []
+    for i in range(0, n, batch_size):
+        sl = slice(i, i + batch_size)
+        batches.append(make_host_batch(
+            schema,
+            [c[sl] for c in cols],
+            [None if v is None else np.asarray(v)[sl]
+             for v in (validity or [None] * len(columns))],
+            dicts,
+        ))
+    ctx = ExecutionContext(device="cpu", result_cache=False)
+    ctx.register_datasource(name, MemoryDataSource(schema, batches))
+    return ctx
+
+
+def _rows(ctx, sql):
+    return collect(ctx.sql(sql)).to_rows()
+
+
+# ---------------------------------------------------------------- pallas
+
+
+class TestPallasKernelParity:
+    def test_hash_agg_sum_min_max_parity(self):
+        from datafusion_tpu.exec.pallas import hash_agg
+
+        import jax
+
+        rng = np.random.default_rng(7)
+        n, g = 6000, 900
+        ids = rng.integers(0, g, n).astype(np.int32)
+        live = rng.random(n) > 0.15
+        for vals in (
+            rng.normal(size=n),                                # f64
+            rng.integers(-10**6, 10**6, n).astype(np.int64),   # i64
+        ):
+            for kind in ("sum", "min", "max"):
+                got = np.asarray(jax.jit(
+                    lambda i, v, l, k=kind: hash_agg.grouped_reduce(
+                        i, v, l, g, k, interpret=True
+                    )
+                )(ids, vals, live))
+                want = hash_agg.grouped_reduce_numpy(ids, vals, live, g, kind)
+                if vals.dtype.kind == "f":
+                    np.testing.assert_allclose(
+                        got, want, rtol=1e-12, err_msg=f"{kind}/{vals.dtype}"
+                    )
+                else:
+                    np.testing.assert_array_equal(
+                        got, want, err_msg=f"{kind}/{vals.dtype}"
+                    )
+
+    def test_hash_agg_empty_groups_keep_identity(self):
+        from datafusion_tpu.exec.pallas import hash_agg
+
+        ids = np.zeros(16, np.int32)  # every row hits group 0
+        vals = np.arange(16).astype(np.int64)
+        live = np.ones(16, bool)
+        out = hash_agg.grouped_reduce_numpy(ids, vals, live, 8, "min")
+        assert out[0] == 0
+        assert (out[1:] == np.iinfo(np.int64).max).all()
+
+    def test_bitonic_argsort_stability_and_sizes(self):
+        from datafusion_tpu.exec.pallas import sort_kernel
+
+        rng = np.random.default_rng(11)
+        for n in (1, 2, 3, 17, 128, 1000, 2048):
+            keys = rng.integers(0, 40, n).astype(np.int64)  # heavy ties
+            got = np.asarray(sort_kernel.argsort_i64(keys, interpret=True))
+            want = np.argsort(keys, kind="stable")
+            np.testing.assert_array_equal(got, want, err_msg=f"n={n}")
+
+    def test_bitonic_multi_key_vs_lexsort(self):
+        from datafusion_tpu.exec.pallas import sort_kernel
+
+        rng = np.random.default_rng(13)
+        a = rng.integers(0, 6, 700).astype(np.int64)
+        b = rng.integers(-50, 50, 700).astype(np.int64)
+        c = rng.integers(0, 3, 700).astype(np.int64)
+        got = np.asarray(sort_kernel.argsort_multi([a, b, c], interpret=True))
+        want = sort_kernel.argsort_numpy([a, b, c])
+        np.testing.assert_array_equal(got, want)
+
+    def test_engine_aggregate_under_interpret_kernels(self, monkeypatch):
+        # end to end: DATAFUSION_TPU_PALLAS=interpret routes the
+        # high-cardinality aggregate through the Pallas hash-agg kernel
+        monkeypatch.setenv("DATAFUSION_TPU_PALLAS", "interpret")
+        rng = np.random.default_rng(17)
+        n, g = 4000, 300
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+            Field("w", DataType.INT64, True),
+        ])
+        k = rng.integers(0, g, n)
+        v = rng.normal(size=n)
+        w = rng.integers(-9, 9, n)
+        wv = rng.random(n) > 0.2
+        sql = ("SELECT k, SUM(v), MIN(w), MAX(w), COUNT(w), COUNT(1) "
+               "FROM t GROUP BY k")
+        got = sorted(_rows(_ctx(schema, [k, v, w], [None, None, wv]), sql))
+        monkeypatch.setenv("DATAFUSION_TPU_PALLAS", "0")
+        want = sorted(_rows(_ctx(schema, [k, v, w], [None, None, wv]), sql))
+        assert len(got) == len(want) == g
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(a, float), np.asarray(b, float), rtol=1e-9
+            )
+
+    def test_engine_full_sort_under_interpret_kernels(self, monkeypatch):
+        rng = np.random.default_rng(19)
+        n = 3000
+        schema = Schema([
+            Field("a", DataType.INT64, False),
+            Field("tag", DataType.INT64, False),
+        ])
+        a = rng.integers(0, 50, n)
+        tag = np.arange(n, dtype=np.int64)
+        sql = "SELECT a, tag FROM t ORDER BY a"
+        monkeypatch.setenv("DATAFUSION_TPU_PALLAS", "interpret")
+        METRICS.reset()
+        got = _rows(_ctx(schema, [a, tag], batch_size=n), sql)
+        assert METRICS.snapshot()["counts"].get("sort.pallas_runs")
+        monkeypatch.setenv("DATAFUSION_TPU_PALLAS", "0")
+        want = _rows(_ctx(schema, [a, tag], batch_size=n), sql)
+        assert got == want  # incl. tag order: stability parity
+
+
+# ------------------------------------------------------------ fused pass
+
+
+class TestFusedPasses:
+    def _agg_data(self):
+        rng = np.random.default_rng(23)
+        n, g = 40_000, 3000  # past DENSE_GROUP_MAX: sort-merge territory
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+            Field("w", DataType.INT64, False),
+        ])
+        cols = [rng.integers(0, g, n), rng.normal(size=n),
+                rng.integers(-100, 100, n)]
+        return schema, cols, g
+
+    def test_fused_vs_unfused_aggregate_parity(self, monkeypatch):
+        schema, cols, g = self._agg_data()
+        sql = ("SELECT k, SUM(w), MIN(v), MAX(v), COUNT(1) FROM t "
+               "WHERE v > -1.5 GROUP BY k")
+        monkeypatch.setenv("DATAFUSION_TPU_FUSE", "1")
+        got = sorted(_rows(_ctx(schema, cols), sql))
+        monkeypatch.setenv("DATAFUSION_TPU_FUSE", "0")
+        want = sorted(_rows(_ctx(schema, cols), sql))
+        assert len(got) == len(want) == g
+        for a, b in zip(got, want):
+            assert a[0] == b[0] and a[1] == b[1] and a[4] == b[4]  # exact
+            np.testing.assert_allclose(a[2], b[2], rtol=1e-12)
+            np.testing.assert_allclose(a[3], b[3], rtol=1e-12)
+
+    def test_fused_mode_reduces_launches(self, monkeypatch):
+        schema, cols, _ = self._agg_data()
+        sql = "SELECT k, SUM(w), COUNT(1) FROM t GROUP BY k"
+
+        def launches(fuse):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE_BATCHES", "1")
+            ctx = _ctx(schema, cols, batch_size=2048)  # ~20 batches
+            METRICS.reset()
+            collect(ctx.sql(sql))
+            snap = METRICS.snapshot()["counts"]
+            return snap.get("device.launches", 0), snap.get("fused.groups", 0)
+
+        fused_n, groups = launches("1")
+        unfused_n, _ = launches("0")
+        assert groups >= 1
+        # ~20 per-batch launches collapse into one per batch group
+        assert fused_n < unfused_n
+        assert fused_n <= 4
+
+    def test_fuse_group_bucketing_bounds_compiles(self):
+        from datafusion_tpu.exec.fused import bucket_group
+
+        assert bucket_group(1) == 1
+        assert bucket_group(5) == 6
+        assert bucket_group(115) == 128
+        assert bucket_group(9000) == 9000  # beyond the ladder: as-is
+
+    def test_aggregate_over_projection_chain_collapses(self, monkeypatch):
+        # DataFrame-style Aggregate(Projection(Selection(scan))) lowers
+        # to ONE AggregateRelation under fusion
+        from datafusion_tpu.plan.expr import (
+            AggregateFunction, BinaryExpr, Column, Literal, Operator,
+            ScalarValue,
+        )
+        from datafusion_tpu.plan.logical import (
+            Aggregate, Projection, Selection, TableScan,
+        )
+
+        rng = np.random.default_rng(29)
+        n = 10_000
+        schema = Schema([
+            Field("a", DataType.FLOAT64, False),
+            Field("k", DataType.INT64, False),
+        ])
+        cols = [rng.normal(size=n), rng.integers(0, 40, n)]
+        scan = TableScan("default", "t", schema)
+        sel = Selection(
+            BinaryExpr(Column(0), Operator.Gt,
+                       Literal(ScalarValue.float64(-0.7))), scan,
+        )
+        proj = Projection(
+            [Column(1),
+             BinaryExpr(Column(0), Operator.Multiply,
+                        Literal(ScalarValue.float64(3.0)))],
+            sel,
+            Schema([Field("k", DataType.INT64, False),
+                    Field("x", DataType.FLOAT64, False)]),
+        )
+        agg = Aggregate(
+            proj, [Column(0)],
+            [AggregateFunction("sum", [Column(1)], DataType.FLOAT64)],
+            Schema([Field("k", DataType.INT64, False),
+                    Field("s", DataType.FLOAT64, False)]),
+        )
+
+        def run(fuse):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            ctx = _ctx(schema, cols)
+            rel = ctx.execute(agg)
+            return sorted(collect(rel).to_rows()), rel
+
+        got, rel = run("1")
+        assert getattr(rel, "_fused_chain", None) == "filter+project+aggregate"
+        assert type(rel).__name__ == "AggregateRelation"
+        assert rel.op_children() and type(
+            rel.op_children()[0]
+        ).__name__ == "DataSourceRelation"  # no interposed pipeline
+        want, _ = run("0")
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(
+                np.asarray(a, float), np.asarray(b, float), rtol=1e-12
+            )
+
+    def test_sort_chain_collapses_with_filter_and_projection(
+        self, monkeypatch
+    ):
+        rng = np.random.default_rng(31)
+        n = 20_000
+        schema = Schema([
+            Field("a", DataType.FLOAT64, False),
+            Field("b", DataType.INT64, False),
+            Field("c", DataType.INT64, False),
+        ])
+        cols = [rng.normal(size=n), rng.integers(0, 1000, n),
+                rng.integers(0, 5, n)]
+        sql = "SELECT b, a FROM t WHERE c < 3 ORDER BY b DESC, a LIMIT 25"
+
+        def run(fuse):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            ctx = _ctx(schema, cols)
+            rel = ctx.sql(sql)
+            return collect(rel).to_rows(), rel
+
+        got, rel = run("1")
+        assert getattr(rel, "_fused_chain", None) == "filter+project+sort"
+        assert "+filter" in rel.op_label() and "+project" in rel.op_label()
+        want, _ = run("0")
+        assert got == want
+        # and the full-sort (no LIMIT) variant
+        fsql = "SELECT b, a FROM t WHERE c < 3 ORDER BY b, a"
+        monkeypatch.setenv("DATAFUSION_TPU_FUSE", "1")
+        f_got = _rows(_ctx(schema, cols), fsql)
+        monkeypatch.setenv("DATAFUSION_TPU_FUSE", "0")
+        f_want = _rows(_ctx(schema, cols), fsql)
+        assert f_got == f_want
+
+    def test_explain_analyze_reports_fused_passes(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_FUSE", "1")
+        rng = np.random.default_rng(37)
+        n = 8000
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+        ])
+        ctx = _ctx(schema, [rng.integers(0, 500, n), rng.normal(size=n)],
+                   batch_size=1024)
+        res = ctx.sql(
+            "EXPLAIN ANALYZE SELECT k, SUM(v) FROM t WHERE v > 0 GROUP BY k"
+        )
+        report = res.report()
+        assert "launches_per_pass=" in report
+        assert "kernel_cache hit/miss=" in report
+        assert res.counters["device.launches"] >= 1
+        # the gauges export through the Prometheus text path
+        text = ctx.metrics_text()
+        assert 'name="query_launches_per_pass"' in text
+
+    def test_repeat_query_no_kernel_cache_misses(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_FUSE", "1")
+        rng = np.random.default_rng(41)
+        n = 5000
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+        ])
+        ctx = _ctx(schema, [rng.integers(0, 100, n), rng.normal(size=n)])
+        sql = "SELECT k, SUM(v) FROM t GROUP BY k"
+        first = _rows(ctx, sql)
+        METRICS.reset()
+        second = _rows(ctx, sql)
+        snap = METRICS.snapshot()["counts"]
+        assert snap.get("kernel_cache.misses", 0) == 0
+        assert sorted(first) == sorted(second)
+
+
+# ---------------------------------------------------- sort semantics
+
+
+class TestSortSemantics:
+    def test_stability_under_heavy_ties(self, monkeypatch):
+        rng = np.random.default_rng(43)
+        n = 30_000
+        schema = Schema([
+            Field("a", DataType.INT64, False),
+            Field("tag", DataType.INT64, False),
+        ])
+        a = rng.integers(0, 8, n)  # 8 distinct keys: massive tie runs
+        tag = np.arange(n, dtype=np.int64)
+        for fuse in ("1", "0"):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            rows = _rows(_ctx(schema, [a, tag], batch_size=4096),
+                         "SELECT a, tag FROM t ORDER BY a")
+            # within each key run, the original row order must survive
+            last = {}
+            for key, tag_v in rows:
+                assert last.get(key, -1) < tag_v, f"unstable at key {key}"
+                last[key] = tag_v
+
+    def test_nan_and_signed_zero_ordering(self, monkeypatch):
+        vals = np.array([1.5, np.nan, -0.0, 0.0, -np.inf, np.inf,
+                         -1.5, np.nan, 0.0, -0.0])
+        tag = np.arange(len(vals), dtype=np.int64)
+        schema = Schema([
+            Field("a", DataType.FLOAT64, False),
+            Field("tag", DataType.INT64, False),
+        ])
+        outs = {}
+        for fuse in ("1", "0"):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            outs[fuse] = _rows(_ctx(schema, [vals, tag]),
+                               "SELECT a, tag FROM t ORDER BY a")
+        assert str(outs["1"]) == str(outs["0"])  # NaN-safe comparison
+        order = [t for _, t in outs["1"]]
+        # -inf first, then -1.5; NaNs sort last (stable between them);
+        # the four zeros stay contiguous (±0.0 compare equal or split —
+        # backend-dependent — but never interleave with nonzeros)
+        assert order[0] == 4 and order[1] == 6
+        assert order[-2:] == [1, 7]
+        zeros = [t for v, t in outs["1"] if v == 0.0]
+        assert sorted(zeros) == [2, 3, 8, 9]
+        assert order[2:6] == zeros
+
+    def test_multi_key_mixed_dtype(self, monkeypatch):
+        rng = np.random.default_rng(47)
+        n = 6000
+        words = np.array(["ash", "birch", "cedar", "oak"], dtype=object)
+        schema = Schema([
+            Field("s", DataType.UTF8, False),
+            Field("f", DataType.FLOAT64, False),
+            Field("i", DataType.INT64, False),
+        ])
+        s = words[rng.integers(0, 4, n)]
+        f = rng.normal(size=n).round(1)  # ties across keys
+        i = rng.integers(-40, 40, n)
+        sql = "SELECT s, f, i FROM t ORDER BY s, f DESC, i"
+        got = {}
+        for fuse in ("1", "0"):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            got[fuse] = _rows(_ctx(schema, [s, f, i]), sql)
+        assert got["1"] == got["0"]
+        want = sorted(
+            zip(s.tolist(), f.tolist(), i.tolist()),
+            key=lambda r: (r[0], -r[1], r[2]),
+        )
+        assert got["1"] == [tuple(w) for w in want]
+
+    def test_high_cardinality_groupby_exact_keys_and_counts(
+        self, monkeypatch
+    ):
+        rng = np.random.default_rng(53)
+        n, g = 60_000, 20_000  # most groups have 1-6 rows
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+        ])
+        k = rng.integers(0, g, n)
+        v = rng.normal(size=n)
+        for fuse in ("1", "0"):
+            monkeypatch.setenv("DATAFUSION_TPU_FUSE", fuse)
+            rows = _rows(_ctx(schema, [k, v], batch_size=8192),
+                         "SELECT k, COUNT(1), SUM(v) FROM t GROUP BY k")
+            got_keys = sorted(r[0] for r in rows)
+            want_keys, want_counts = np.unique(k, return_counts=True)
+            assert got_keys == want_keys.tolist()
+            counts = {r[0]: r[1] for r in rows}
+            assert all(
+                counts[kk] == cc
+                for kk, cc in zip(want_keys.tolist(), want_counts.tolist())
+            )
+            sums = {r[0]: r[2] for r in rows}
+            want_sums = np.bincount(k, weights=v, minlength=g)
+            for kk in want_keys.tolist():
+                np.testing.assert_allclose(sums[kk], want_sums[kk], rtol=1e-9)

@@ -678,9 +678,9 @@ class TestHostRoutedRunSort:
         first = collect(rel).to_rows()
         assert METRICS.snapshot()["counts"].get("sort.host_routed_runs")
         collect(rel)  # second pass: key admitted to the cache
-        before = METRICS.snapshot()["counts"].get("sort.host_perm_cache_hits", 0)
+        before = METRICS.snapshot()["counts"].get("sort.perm_cache_hits", 0)
         third = collect(rel).to_rows()
-        after = METRICS.snapshot()["counts"].get("sort.host_perm_cache_hits", 0)
+        after = METRICS.snapshot()["counts"].get("sort.perm_cache_hits", 0)
         assert after > before
         assert third == first
 
