@@ -1,0 +1,29 @@
+"""Static verification layer (ISSUE 7).
+
+Three independent analyzers, each usable on its own:
+
+- :mod:`analysis.verify` — the plan-IR verifier: infers and checks
+  output schemas bottom-up over ``plan/logical.py`` + ``plan/expr.py``
+  so an invalid query fails at plan time with a source-anchored
+  diagnostic instead of an XLA trace error mid-launch.  Runs inside
+  ``ExecutionContext`` under ``DATAFUSION_TPU_VERIFY`` (default on)
+  and surfaces as ``EXPLAIN VERIFY <sql>``.
+- :mod:`analysis.lint` — the invariant linter: an ``ast``-based rule
+  engine enforcing the project's cross-cutting invariants (no host
+  syncs in device dispatch paths, no wall-clock/RNG inside replayable
+  fault-guarded code, IO boundaries behind named fault sites, no
+  silent broad excepts, no locks in metrics/trace callbacks).  CLI:
+  ``python -m datafusion_tpu.analysis [paths] [--format=github]``.
+- :mod:`analysis.lockcheck` — the lock-order race detector:
+  instrumented lock wrappers (adopted by the lock-bearing modules)
+  record per-thread acquisition stacks into a global lock-order graph
+  under ``DATAFUSION_TPU_LOCKCHECK=1``, detect cycles (potential
+  deadlock) and blocking calls made while holding a lock, and report
+  at process exit.
+"""
+
+# NB: no eager submodule imports here — `analysis.lockcheck` is
+# imported by modules on the engine's coldest import path (faults,
+# cache) and must not drag the verifier/linter in with it.  Import the
+# submodules directly:
+#   from datafusion_tpu.analysis import verify, lint, lockcheck

@@ -1,0 +1,76 @@
+"""CLI: ``python -m datafusion_tpu.analysis [paths...]``.
+
+Runs the invariant linter over the given paths (default:
+``datafusion_tpu/``) and exits nonzero on findings.  ``--format=github``
+emits workflow-annotation lines for the CI lint job.
+``--lockcheck-report FILE`` instead evaluates a lock-order report
+written by a ``DATAFUSION_TPU_LOCKCHECK=1`` run (analysis/lockcheck.py
+atexit hook) and exits nonzero when it recorded cycles or held-lock
+blocking calls — the shell glue for scripts/analysis_check.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from datafusion_tpu.analysis.lint import RULES, lint_paths
+
+
+def _check_lockcheck_report(path: str) -> int:
+    with open(path, "r", encoding="utf-8") as f:
+        report = json.load(f)
+    cycles = report.get("cycles") or []
+    blocking = report.get("blocking") or []
+    for cyc in cycles:
+        print(f"lockcheck: lock-order cycle: {' -> '.join(cyc['cycle'])}")
+        for edge in cyc.get("edges", []):
+            print(f"  edge {edge['held']} -> {edge['acquired']} "
+                  f"({edge.get('site', '?')})")
+    for b in blocking:
+        print(f"lockcheck: blocking call {b['op']!r} while holding "
+              f"{b['held']} ({b.get('site', '?')})")
+    n = len(cycles) + len(blocking)
+    print(f"lockcheck report: {n} issue(s), "
+          f"{len(report.get('edges') or [])} lock-order edge(s) observed")
+    return 1 if n else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m datafusion_tpu.analysis",
+        description="datafusion-tpu invariant linter "
+                    "(project rules DF001-DF005)",
+    )
+    ap.add_argument("paths", nargs="*", default=["datafusion_tpu"],
+                    help="files/directories to lint "
+                         "(default: datafusion_tpu)")
+    ap.add_argument("--format", choices=("text", "github"), default="text",
+                    help="finding output format (github = workflow "
+                         "annotations)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--lockcheck-report", metavar="FILE", default=None,
+                    help="evaluate a DATAFUSION_TPU_LOCKCHECK report "
+                         "file instead of linting")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in RULES:
+            doc = (rule.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule.id}  {doc}")
+        return 0
+    if args.lockcheck_report is not None:
+        return _check_lockcheck_report(args.lockcheck_report)
+
+    findings = lint_paths(args.paths or ["datafusion_tpu"])
+    for f in findings:
+        print(f.github() if args.format == "github" else f.text())
+    print(f"{len(findings)} finding(s) in "
+          f"{', '.join(args.paths or ['datafusion_tpu'])}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,384 @@
+"""Invariant linter: an ``ast``-based rule engine for the project's
+cross-cutting invariants.
+
+Generic lint (ruff) catches language-level defects; these rules encode
+*engine* invariants that PRs 1-6 established by convention — each one a
+class of bug that once cost a debugging session:
+
+- **DF001 host-sync-in-dispatch** — no ``block_until_ready`` /
+  ``device_get`` host syncs inside ``exec/`` device paths, and no
+  ``np.asarray`` inside the fused dispatch fold (``exec/fused.py``):
+  an accidental sync there serializes the launch pipeline the fused
+  passes exist to batch.
+- **DF002 nondeterminism-in-replayable** — no wall clock
+  (``time.time``/``time.time_ns``/``datetime.now``) or process-global
+  ``random.*`` calls inside functions guarded by a named fault site:
+  those functions are the *replayable* recovery surface, and seeded
+  chaos soaks only replay if their behavior is a pure function of the
+  plan seed.
+- **DF003 unguarded-io-boundary** — raw socket IO (``.sendall`` /
+  ``.recv``) only inside functions that hold a named fault site
+  (``faults.check``/``faults.corrupt``); everything else must go
+  through ``send_msg``/``recv_msg``, which carry the sites.
+- **DF004 swallowed-broad-except** — no bare ``except:`` ever, and no
+  ``except Exception:`` that swallows without either re-raising or the
+  explicit ``# noqa: BLE001`` justification marker: a silent broad
+  except around a wire/device call eats the `TransientError`
+  classification the retry layer depends on.
+- **DF005 lock-in-metrics-callback** — no lock acquisition inside
+  ``utils/metrics.py`` or the ambient-operator ``record_*`` callbacks
+  (``obs/stats.py``): they run inside other subsystems' critical
+  sections (CacheStore eviction, retry loops), where taking a lock
+  would build silent lock-order edges.
+
+Suppression: append ``# df-lint: ok(DF00N)`` (or a blanket
+``# df-lint: ok``) to the offending line, with a justification — the
+marker is the reviewed exception list.  ``# noqa: BLE001`` additionally
+suppresses DF004 (the pre-existing convention for documented swallows).
+
+CLI: ``python -m datafusion_tpu.analysis [paths] [--format=github]``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+_SUPPRESS = re.compile(r"#\s*df-lint:\s*ok(?:\(([A-Z0-9, ]+)\))?")
+_NOQA_BLE = re.compile(r"#\s*noqa:[^\n]*\bBLE001\b")
+
+# wall-clock / global-RNG call patterns for DF002: (module, attr)
+_WALL_CLOCK = {("time", "time"), ("time", "time_ns"),
+               ("datetime", "now"), ("datetime", "utcnow")}
+_HOST_SYNCS = ("block_until_ready", "device_get")
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "col", "message")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.message = message
+
+    def text(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def github(self) -> str:
+        return (f"::error file={self.path},line={self.line},"
+                f"col={self.col}::{self.rule} {self.message}")
+
+    def __repr__(self) -> str:
+        return self.text()
+
+
+def _call_name(node: ast.Call) -> Optional[str]:
+    """Trailing attribute/name of a call: `a.b.c(...)` -> "c"."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _call_mod_attr(node: ast.Call) -> Optional[tuple[str, str]]:
+    """`mod.attr(...)` -> ("mod", "attr") when mod is a bare name."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return f.value.id, f.attr
+    return None
+
+
+def _is_faults_hook(node: ast.Call) -> bool:
+    ma = _call_mod_attr(node)
+    return ma is not None and ma[0] == "faults" and ma[1] in (
+        "check", "corrupt"
+    )
+
+
+def _calls_in(node: ast.AST) -> Iterable[ast.Call]:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            yield sub
+
+
+def _functions_in(tree: ast.AST):
+    for sub in ast.walk(tree):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield sub
+
+
+class _Rule:
+    id = "DF000"
+    message = ""
+
+    def applies(self, relpath: str) -> bool:
+        raise NotImplementedError
+
+    def check(self, tree: ast.AST, relpath: str) -> list[Finding]:
+        raise NotImplementedError
+
+    def _finding(self, relpath: str, node: ast.AST, msg: str) -> Finding:
+        return Finding(self.id, relpath, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0) + 1, msg)
+
+
+class HostSyncInDispatch(_Rule):
+    """DF001: host syncs inside device dispatch paths."""
+
+    id = "DF001"
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace(os.sep, "/")
+        return "datafusion_tpu/exec/" in p or p.startswith("exec/")
+
+    def check(self, tree, relpath):
+        out = []
+        fused = relpath.replace(os.sep, "/").endswith("exec/fused.py")
+        for call in _calls_in(tree):
+            name = _call_name(call)
+            if name in _HOST_SYNCS:
+                out.append(self._finding(
+                    relpath, call,
+                    f"{name}() is a host sync; device dispatch paths "
+                    "must stay async (launch pipelining is the fused-"
+                    "pass win)",
+                ))
+            elif fused and name == "asarray":
+                ma = _call_mod_attr(call)
+                if ma is not None and ma[0] in ("np", "numpy"):
+                    out.append(self._finding(
+                        relpath, call,
+                        "np.asarray inside the fused dispatch fold "
+                        "forces D2H on device-array inputs",
+                    ))
+        return out
+
+
+class NondeterminismInReplayable(_Rule):
+    """DF002: wall clock / global RNG inside fault-guarded functions."""
+
+    id = "DF002"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree, relpath):
+        out = []
+        for fn in _functions_in(tree):
+            if not any(_is_faults_hook(c) for c in _calls_in(fn)):
+                continue
+            for call in _calls_in(fn):
+                ma = _call_mod_attr(call)
+                if ma in _WALL_CLOCK:
+                    out.append(self._finding(
+                        relpath, call,
+                        f"{ma[0]}.{ma[1]}() inside fault-site-guarded "
+                        f"{fn.name}(): replayable code must not read "
+                        "the wall clock (use time.monotonic / inject "
+                        "now=)",
+                    ))
+                elif ma is not None and ma[0] == "random":
+                    out.append(self._finding(
+                        relpath, call,
+                        f"process-global random.{ma[1]}() inside fault-"
+                        f"site-guarded {fn.name}(): replayable code "
+                        "must draw from a seeded stream",
+                    ))
+        return out
+
+
+class UnguardedIoBoundary(_Rule):
+    """DF003: raw socket IO outside fault-site-guarded functions."""
+
+    id = "DF003"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    def check(self, tree, relpath):
+        out = []
+        for fn in _functions_in(tree):
+            guarded = any(_is_faults_hook(c) for c in _calls_in(fn))
+            if guarded:
+                continue
+            for call in _calls_in(fn):
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in ("sendall", "recv"):
+                    out.append(self._finding(
+                        relpath, call,
+                        f".{call.func.attr}() in {fn.name}() without a "
+                        "named fault site: IO boundaries go through "
+                        "send_msg/recv_msg (which carry wire.send/"
+                        "wire.recv) or declare their own faults.check",
+                    ))
+        return out
+
+
+class SwallowedBroadExcept(_Rule):
+    """DF004: bare/broad excepts that swallow silently."""
+
+    id = "DF004"
+
+    def applies(self, relpath: str) -> bool:
+        return True
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        for sub in ast.walk(handler):
+            if isinstance(sub, ast.Raise):
+                return True
+        return False
+
+    def check(self, tree, relpath):
+        out = []
+        for sub in ast.walk(tree):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            if sub.type is None:
+                out.append(self._finding(
+                    relpath, sub,
+                    "bare except: swallows everything, including the "
+                    "TransientError classification the retry layer "
+                    "keys on — name the exception types",
+                ))
+                continue
+            name = sub.type.id if isinstance(sub.type, ast.Name) else None
+            if name in ("Exception", "BaseException") and \
+                    not self._reraises(sub):
+                out.append(self._finding(
+                    relpath, sub,
+                    f"except {name} without re-raise: a broad swallow "
+                    "here eats TransientError classification; narrow "
+                    "the types or justify with `# noqa: BLE001`",
+                ))
+        return out
+
+
+class LockInMetricsCallback(_Rule):
+    """DF005: lock acquisition inside Metrics / stats callbacks."""
+
+    id = "DF005"
+
+    _STATS_FNS = ("record_h2d", "record_d2h", "record_retry",
+                  "record_launch", "current_op")
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace(os.sep, "/")
+        return p.endswith(("utils/metrics.py", "obs/stats.py"))
+
+    def _scan(self, node, relpath, where):
+        out = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                name = _call_name(sub)
+                if name == "acquire":
+                    out.append(self._finding(
+                        relpath, sub,
+                        f"lock acquisition in {where}: metrics/trace "
+                        "callbacks run inside other subsystems' "
+                        "critical sections",
+                    ))
+                elif name in ("Lock", "RLock", "Condition") and \
+                        _call_mod_attr(sub) == ("threading", name):
+                    out.append(self._finding(
+                        relpath, sub,
+                        f"threading.{name} in {where}: the metrics "
+                        "registry and stats callbacks stay lock-free "
+                        "(GIL-atomic counters only)",
+                    ))
+            elif isinstance(sub, ast.With):
+                for item in sub.items:
+                    for leaf in ast.walk(item.context_expr):
+                        if isinstance(leaf, (ast.Name, ast.Attribute)):
+                            ident = leaf.id if isinstance(leaf, ast.Name) \
+                                else leaf.attr
+                            if "lock" in ident.lower():
+                                out.append(self._finding(
+                                    relpath, sub,
+                                    f"`with {ident}` in {where}: "
+                                    "metrics/trace callbacks must not "
+                                    "take locks",
+                                ))
+        return out
+
+    def check(self, tree, relpath):
+        p = relpath.replace(os.sep, "/")
+        if p.endswith("utils/metrics.py"):
+            return self._scan(tree, relpath, "utils/metrics.py")
+        out = []
+        for fn in _functions_in(tree):
+            if fn.name in self._STATS_FNS:
+                out.extend(self._scan(fn, relpath, f"{fn.name}()"))
+        return out
+
+
+RULES: list[_Rule] = [
+    HostSyncInDispatch(),
+    NondeterminismInReplayable(),
+    UnguardedIoBoundary(),
+    SwallowedBroadExcept(),
+    LockInMetricsCallback(),
+]
+
+
+def _suppressed(line_text: str, rule_id: str) -> bool:
+    m = _SUPPRESS.search(line_text)
+    if m is not None:
+        ids = m.group(1)
+        if ids is None or rule_id in ids:
+            return True
+    if rule_id == "DF004" and _NOQA_BLE.search(line_text):
+        return True
+    return False
+
+
+def lint_source(source: str, relpath: str,
+                rules: Optional[list[_Rule]] = None) -> list[Finding]:
+    """Lint one file's source text; returns the unsuppressed findings."""
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return [Finding("DF000", relpath, e.lineno or 0, e.offset or 0,
+                        f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    out: list[Finding] = []
+    for rule in (RULES if rules is None else rules):
+        if not rule.applies(relpath):
+            continue
+        for f in rule.check(tree, relpath):
+            text = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+            if not _suppressed(text, f.rule):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(paths: Iterable[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            yield p
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if d != "__pycache__" and not d.startswith(".")
+            )
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    yield os.path.join(root, name)
+
+
+def lint_paths(paths: Iterable[str],
+               rules: Optional[list[_Rule]] = None) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            findings.extend(lint_source(f.read(), path, rules))
+    return findings

@@ -21,11 +21,11 @@ it never mutates them).
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import OrderedDict
 from typing import Any, Iterable, Optional
 
+from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.utils.metrics import METRICS
 
 
@@ -57,7 +57,7 @@ class CacheStore:
         # and the only overhead is one attribute test on the miss path.
         self.shared = None
         self.shared_hits = 0
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock(f"cache.store:{name}")
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
         self._tags: dict[str, set[str]] = {}
         self._bytes = 0

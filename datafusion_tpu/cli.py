@@ -190,10 +190,11 @@ class Console:
         t0 = time.perf_counter()
         try:
             result = self.ctx.sql_collect(sql)
-        except Exception as e:  # errors print, the console survives
+        except Exception as e:  # noqa: BLE001 — errors print, the console survives
             self._print(f"Error: {e}")
             return
         elapsed = time.perf_counter() - t0
+        from datafusion_tpu.analysis.verify import ExplainVerifyResult
         from datafusion_tpu.exec.context import ExplainResult
         from datafusion_tpu.exec.materialize import ResultTable
         from datafusion_tpu.obs.explain import ExplainAnalyzeResult
@@ -203,9 +204,12 @@ class Console:
                 self._print(
                     "\t".join("NULL" if v is None else str(v) for v in row)
                 )
-        elif isinstance(result, (ExplainResult, ExplainAnalyzeResult)):
-            # the plan tree (EXPLAIN) or the annotated operator tree +
-            # span timeline (EXPLAIN ANALYZE / \explain)
+        elif isinstance(
+            result, (ExplainResult, ExplainAnalyzeResult, ExplainVerifyResult)
+        ):
+            # the plan tree (EXPLAIN), the annotated operator tree +
+            # span timeline (EXPLAIN ANALYZE / \explain), or the
+            # inferred-schema report (EXPLAIN VERIFY)
             self._print(repr(result))
         # "seconds" keeps this line inside the golden diff's -I filter
         self._print(f"Query executed in {elapsed:.3f} seconds")

@@ -37,6 +37,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.errors import ExecutionError
 from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.testing import faults
@@ -52,7 +53,7 @@ class MembershipView:
         self.rev = 0
         self.term = 0  # leadership term last observed on the service
         self.workers: dict[str, dict] = {}  # addr -> info (lease_age_s, ...)
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("cluster.membership_view")
         self._last_refresh: Optional[float] = None
         self.refresh_errors = 0
         self._callbacks: list[Callable[["MembershipView"], None]] = []

@@ -56,6 +56,7 @@ import time
 import uuid
 from typing import Any, Optional
 
+from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.cache.store import CacheStore
 from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.metrics import METRICS
@@ -99,8 +100,10 @@ class ClusterState:
             from datafusion_tpu.cluster import DEFAULT_CACHE_BYTES
 
             result_cache_bytes = int(env) if env else DEFAULT_CACHE_BYTES
-        self._lock = threading.Lock()
-        # watchers park here; notified on every appended event
+        self._lock = lockcheck.make_lock("cluster.state")
+        # watchers park here; notified on every appended event (the
+        # Condition runs through the tracked lock's acquire/release, so
+        # lockcheck's held-stack stays coherent across parked waits)
         self._watch_cond = threading.Condition(self._lock)
         self._kv: dict[str, _Key] = {}
         self._leases: dict[str, _Lease] = {}

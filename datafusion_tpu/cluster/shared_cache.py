@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.cache.result import CachedResult
 from datafusion_tpu.errors import ExecutionError
 from datafusion_tpu.obs import trace as obs_trace
@@ -143,7 +144,7 @@ class SharedResultTier:
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("cluster.shared_tier")
 
     # -- read-through --
     def load(self, key: str):

@@ -13,7 +13,7 @@ int32 codes (see exec/batch.py).
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence
+from typing import ClassVar, Iterable, Sequence
 
 import numpy as np
 
@@ -29,7 +29,8 @@ class DataType:
     the planner golden tests assert on plan strings containing them.
     """
 
-    _registry: dict[str, "DataType"] = {}
+    # deliberately shared: the registry of primitive singletons
+    _registry: "ClassVar[dict[str, DataType]]" = {}
 
     def __init__(self, name: str):
         self.name = name
@@ -54,7 +55,7 @@ class DataType:
             try:
                 return DataType._registry[obj]
             except KeyError:
-                raise PlanError(f"Unknown DataType {obj!r}")
+                raise PlanError(f"Unknown DataType {obj!r}") from None
         if isinstance(obj, dict) and "Struct" in obj:
             return StructType([Field.from_json(f) for f in obj["Struct"]])
         raise PlanError(f"Cannot deserialize DataType from {obj!r}")
@@ -189,7 +190,7 @@ def from_np_dtype(dtype: np.dtype) -> DataType:
     try:
         return _BY_NP_KIND[np.dtype(dtype)]
     except KeyError:
-        raise PlanError(f"No DataType for numpy dtype {dtype!r}")
+        raise PlanError(f"No DataType for numpy dtype {dtype!r}") from None
 
 
 def get_supertype(l: DataType, r: DataType) -> DataType | None:
@@ -282,7 +283,7 @@ class Field:
         try:
             name, dt, nullable = obj["name"], obj["data_type"], obj["nullable"]
         except (TypeError, KeyError):
-            raise PlanError(f"Malformed Field wire object: {obj!r}")
+            raise PlanError(f"Malformed Field wire object: {obj!r}") from None
         return Field(name, DataType.from_json(dt), nullable)
 
 
@@ -320,7 +321,7 @@ class Schema:
         try:
             return self._index[name]
         except KeyError:
-            raise InvalidColumnError(f"no column named {name!r}")
+            raise InvalidColumnError(f"no column named {name!r}") from None
 
     def names(self) -> list[str]:
         return [f.name for f in self.fields]

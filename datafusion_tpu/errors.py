@@ -36,6 +36,22 @@ class ExecutionError(DataFusionError):
     """Runtime failure while executing a plan (reference `error.rs:34`)."""
 
 
+class PlanVerificationError(NotSupportedError, PlanError):
+    """The static plan verifier (analysis/verify.py) rejected a plan
+    before execution.  Deliberately NOT transient: replaying an invalid
+    plan cannot make it type-check, so retry/failover layers must fail
+    fast instead of burning their budget.  Subclasses BOTH PlanError
+    (most rejections are genuine plan bugs — unknown columns, dtype
+    mismatches) and NotSupportedError (the rest are shapes the engine
+    deliberately refuses — Utf8 casts, computed GROUP BY keys) so
+    pre-existing handlers for either taxonomy keep working.
+    `diagnostics` carries the source-anchored findings."""
+
+    def __init__(self, message: str, diagnostics=()):
+        super().__init__(message)
+        self.diagnostics = list(diagnostics)
+
+
 class TransientError(DataFusionError):
     """A failure that is expected to succeed on replay (retry taxonomy
     root).  Recovery layers decide *by type*: anything under this class

@@ -1206,9 +1206,9 @@ class AggregateRelation(Relation):
         # producer encodes, but a cache-pin miss (another relation
         # scanning the same batches overwrote the group_ids slot) makes
         # the consumer re-encode concurrently with the producer
-        import threading
+        from datafusion_tpu.analysis import lockcheck
 
-        self._ids_lock = threading.Lock()
+        self._ids_lock = lockcheck.make_lock("exec.aggregate_ids")
 
     # -- delegates into the shared core (the partitioned subclass and
     # the multi-host coordinator call these by name) --
@@ -1358,9 +1358,7 @@ class AggregateRelation(Relation):
             bytes_per_row += sum(
                 w.nbytes for w in wires if isinstance(w, np.ndarray)
             ) / max(batch.capacity, 1)
-        passes = len(set(
-            repr(self.specs[j].arg) for j in host_idx
-        ))
+        passes = len({repr(self.specs[j].arg) for j in host_idx})
         ship_s = bytes_per_row / (link_rate_mbps(self.device) * 1e6)
         host_s = passes * _HOST_AGG_SECONDS_PER_ROW
         if ship_s <= host_s:

@@ -387,7 +387,7 @@ def link_rate_mbps(device=None) -> float:
         rates = []
         for _ in range(2):
             t0 = time.perf_counter()
-            jax.block_until_ready(put(arr + np.uint8(1)))
+            jax.block_until_ready(put(arr + np.uint8(1)))  # df-lint: ok(DF001) — the probe MEASURES the transfer, so it must block
             rates.append(arr.nbytes / 1e6 / max(time.perf_counter() - t0, 1e-9))
         hit = _LINK_RATE[key] = float(max(rates))
         from datafusion_tpu.utils.metrics import METRICS
@@ -828,7 +828,9 @@ def device_pull_start(tree) -> PendingPull:
     dev_leaves = [leaves[i] for i in dev_idx]
     try:
         platform = next(iter(dev_leaves[0].devices())).platform
-    except Exception:
+    except (StopIteration, AttributeError, RuntimeError):
+        # deleted buffer / tracer without device placement: fall back
+        # to the default backend's platform
         platform = jax.default_backend()
     if platform == "cpu" and os.environ.get("DATAFUSION_TPU_WIRE", "auto") != "always":
         # no link: host access to a CPU-backend buffer is an alias;

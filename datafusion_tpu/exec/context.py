@@ -252,6 +252,17 @@ class ExecutionContext:
                 from datafusion_tpu.obs.explain import explain_analyze
 
                 return explain_analyze(self, plan)
+            if stmt.verify:
+                # EXPLAIN VERIFY type-checks the plan WITHOUT executing
+                # and renders the inferred schema per operator
+                # (analysis/verify.py)
+                from datafusion_tpu.analysis import verify as _averify
+
+                with METRICS.timer("verify"):
+                    report = _averify.verify_plan(
+                        plan, functions=self.functions
+                    )
+                return _averify.ExplainVerifyResult(plan, report)
             return ExplainResult(plan)
         plan = self._plan(stmt)
         return self.execute(plan)
@@ -379,12 +390,21 @@ class ExecutionContext:
         executes normally with a capture hook attached, filled by
         `collect_columns` at the materialization boundary.  Recursive
         calls (operator subtrees) pass straight through to
-        `_execute_plan`, which subclasses override."""
+        `_execute_plan`, which subclasses override.
+
+        Root-level plans are statically verified first (analysis/
+        verify.py, `DATAFUSION_TPU_VERIFY`, default on): an unknown
+        column or mistyped expression raises `PlanVerificationError`
+        with a source-anchored diagnostic *here*, before any operator
+        is built or any batch touches a device."""
         tls = self._execute_tls
-        if getattr(tls, "in_execute", False) or self._result_cache is None:
+        if getattr(tls, "in_execute", False):
             return self._execute_plan(plan)
         tls.in_execute = True
         try:
+            if self._result_cache is None:
+                self._verify(plan)
+                return self._execute_plan(plan)
             from datafusion_tpu.cache import scan_tables
             from datafusion_tpu.cache.result import (
                 CachedResultRelation,
@@ -394,11 +414,15 @@ class ExecutionContext:
             fp = self.last_fingerprint = self.query_fingerprint(plan)
             entry = self._result_cache.get(fp)
             if entry is not None:
+                # no verify on the warm path: an identical fingerprint
+                # means this exact plan already verified on the miss
+                # that populated the entry — a repeat walk finds nothing
                 return CachedResultRelation(
                     plan.schema, entry, fp,
                     on_complete=lambda s: self._record_history(fp, s),
                     batch_size=self.batch_size,
                 )
+            self._verify(plan)
             rel = self._execute_plan(plan)
             attach_result_capture(
                 rel, self._result_cache, fp, tags=scan_tables(plan),
@@ -407,6 +431,16 @@ class ExecutionContext:
             return rel
         finally:
             tls.in_execute = False
+
+    def _verify(self, plan: LogicalPlan) -> None:
+        """Static pre-execution verification of a root-level plan
+        (DATAFUSION_TPU_VERIFY=0 skips — byte-identical behavior)."""
+        from datafusion_tpu.analysis import verify as _averify
+
+        if not _averify.verify_enabled():
+            return
+        with METRICS.timer("verify"):
+            _averify.check_plan(plan, functions=self.functions)
 
     def _execute_plan(self, plan: LogicalPlan) -> Relation:
         fns = self._jax_functions()

@@ -254,9 +254,13 @@ class ExprCompiler:
         return cast_fn
 
     def _expr_is_utf8(self, e: Expr) -> bool:
+        from datafusion_tpu.errors import DataFusionError
+
         try:
             return e.get_type(self.schema) == DataType.UTF8
-        except Exception:
+        except DataFusionError:
+            # untypeable subtree: not a string, and the real diagnostic
+            # belongs to whoever compiles it
             return False
 
     def _compile_binary(self, expr: BinaryExpr) -> Callable:

@@ -101,7 +101,7 @@ def entry_signature(entry) -> tuple:
     leaves, treedef = jax.tree.flatten(entry)
     return (
         treedef,
-        tuple((str(np.asarray(l).dtype) if np.isscalar(l) else str(l.dtype),
+        tuple((str(np.asarray(l).dtype) if np.isscalar(l) else str(l.dtype),  # df-lint: ok(DF001) — isscalar gates: only python scalars reach asarray
                tuple(getattr(l, "shape", ())))
               for l in leaves),
     )

@@ -87,7 +87,7 @@ def staged_prefetch(
                 return
             except queue.Full:
                 if stop.is_set():
-                    raise _Stop()
+                    raise _Stop() from None
 
     def producer() -> None:
         try:

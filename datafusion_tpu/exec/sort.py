@@ -39,7 +39,7 @@ stops pulling batches early (no device work at all).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import ClassVar, Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -969,7 +969,8 @@ class SortRelation(Relation):
             keys.append(k)
         return keys
 
-    _SORT_RUN_JITS: dict = {}
+    # deliberately class-shared: one jit per key signature, process-wide
+    _SORT_RUN_JITS: "ClassVar[dict]" = {}
 
     def _host_run_sort(self, keys: list[np.ndarray], n: int):
         """Host np.lexsort permutation when the link makes the device

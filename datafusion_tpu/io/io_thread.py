@@ -33,6 +33,8 @@ import queue
 import threading
 from typing import Any, Callable, Iterator
 
+from datafusion_tpu.analysis import lockcheck
+
 __all__ = ["run_on_io_thread", "confined_iter"]
 
 _POOL_SIZE = 4
@@ -44,7 +46,7 @@ class _IoWorker:
     def __init__(self, name: str) -> None:
         self._q: queue.SimpleQueue = queue.SimpleQueue()
         self._thread: threading.Thread | None = None
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("io.worker_start")
         self._name = name
 
     def _ensure_started(self) -> None:
@@ -64,7 +66,7 @@ class _IoWorker:
             import pyarrow.compute  # noqa: F401
             import pyarrow.csv  # noqa: F401
             import pyarrow.parquet  # noqa: F401
-        except Exception:  # pragma: no cover — pyarrow-less installs
+        except Exception:  # noqa: BLE001 — pyarrow-less installs; native init can raise anything
             pass
         while True:
             fn, args, kwargs, done, out = self._q.get()
@@ -86,6 +88,9 @@ class _IoWorker:
         done = threading.Event()
         out: list = []
         self._q.put((fn, args, kwargs, done, out))
+        # a caller holding a lock would stall every contender for as
+        # long as the confined call takes — lockcheck records it
+        lockcheck.note_blocking("io_thread.submit")
         done.wait()
         if out[1] is not None:
             raise out[1]

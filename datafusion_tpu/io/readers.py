@@ -166,7 +166,7 @@ class CsvReader:
                 self.path, read_options=read_opts, convert_options=convert_opts
             )
         except (pa.ArrowInvalid, OSError) as e:
-            raise IoError(f"cannot open CSV {self.path!r}: {e}")
+            raise IoError(f"cannot open CSV {self.path!r}: {e}") from e
         pending = None
         for arrow_batch in reader:
             tbl = pa.Table.from_batches([arrow_batch])
@@ -220,7 +220,7 @@ class NdJsonReader:
         try:
             f = open(self.path, "r", encoding="utf-8")
         except OSError as e:
-            raise IoError(f"cannot open NDJSON {self.path!r}: {e}")
+            raise IoError(f"cannot open NDJSON {self.path!r}: {e}") from e
         with f:
             rows: list[dict] = []
             for line in f:
@@ -230,7 +230,7 @@ class NdJsonReader:
                 try:
                     rows.append(json.loads(line))
                 except json.JSONDecodeError as e:
-                    raise IoError(f"bad NDJSON line in {self.path!r}: {e}")
+                    raise IoError(f"bad NDJSON line in {self.path!r}: {e}") from e
                 if len(rows) >= self.batch_size:
                     yield self._rows_to_batch(rows)
                     rows = []
@@ -299,7 +299,7 @@ class ParquetReader:
         try:
             pf = pq.ParquetFile(self.path, read_dictionary=dict_cols)
         except Exception as e:
-            raise IoError(f"cannot open Parquet {self.path!r}: {e}")
+            raise IoError(f"cannot open Parquet {self.path!r}: {e}") from e
         # read_dictionary only applies to string-physical columns; a
         # date/timestamp column (travels as ISO strings) keeps its type
         # and takes the cast path in _arrow_to_columns

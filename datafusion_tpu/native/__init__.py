@@ -87,7 +87,9 @@ def build_library() -> bool:
             capture_output=True, timeout=120,
         )
         return os.path.exists(_LIB_PATH)
-    except Exception:
+    except (OSError, subprocess.SubprocessError):
+        # no make / compiler missing / build error or timeout: callers
+        # fall back to the pure-Python front-end
         return False
 
 

@@ -121,6 +121,13 @@ class ExplainAnalyzeResult:
                 f"{c.get('kernel_cache.hits', 0)}/"
                 f"{c.get('kernel_cache.misses', 0)}"
             )
+            if c.get("coord.plan_rejected"):
+                # fragments the coordinator refused to dispatch because
+                # their plan failed static verification
+                lines.append(
+                    f"Plans rejected by verification: "
+                    f"{c['coord.plan_rejected']}"
+                )
         worker_spans = sum(
             1 for s in self.spans if str(s.get("proc", "")).startswith("worker")
         )
@@ -174,7 +181,7 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
 
     _WATCHED = ("device.launches", "kernel_cache.hits",
                 "kernel_cache.misses", "fused.groups",
-                "fused.group_batches")
+                "fused.group_batches", "coord.plan_rejected")
     before = dict(METRICS.counts)
     with trace.session() as tc:
         t0 = time.perf_counter()

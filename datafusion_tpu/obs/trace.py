@@ -30,6 +30,7 @@ import uuid
 from contextlib import contextmanager
 from typing import Any, Optional
 
+from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.utils.metrics import METRICS
 
 _TRUTHY = ("1", "true", "on", "yes")
@@ -38,7 +39,7 @@ _SESSION_DEPTH = 0  # active trace sessions (EXPLAIN ANALYZE runs)
 _MAX_SPANS = int(os.environ.get("DATAFUSION_TPU_TRACE_BUF", "100000") or 100000)
 _ROLE = "main"  # worker entry points set "worker" (set_process_role)
 
-_lock = threading.Lock()
+_lock = lockcheck.make_lock("obs.trace_buffer")
 _spans: list["Span"] = []
 _compile_listener_installed = False
 

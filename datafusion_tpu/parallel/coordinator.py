@@ -109,14 +109,14 @@ class WorkerHandle:
             send_msg(s, msg)
             try:
                 out = recv_msg(s)
-            except TimeoutError:
+            except TimeoutError as e:
                 # distinguish slow from dead: the connection succeeded,
                 # so surface the deadline instead of failing over
                 raise RequestTimeoutError(
                     f"worker {self.host}:{self.port} exceeded the "
                     f"{timeout}s request timeout (raise request_timeout "
                     "for long fragments)"
-                )
+                ) from e
         if out is None:
             raise ConnectionError("worker closed the connection")
         if out.get("type") == "error":
@@ -159,6 +159,11 @@ class WorkerHandle:
 def _resolve_addr(addr: str) -> str:
     """'host:port' with the host resolved to its IP (memoized; an
     unresolvable host returns unchanged)."""
+    from datafusion_tpu.analysis import lockcheck
+
+    # a cache miss blocks on the resolver — callers that might hold a
+    # lock must pre-warm the memo first (lockcheck enforces this)
+    lockcheck.note_blocking("dns.resolve")
     host, _, port = addr.rpartition(":")
     try:
         return f"{socket.gethostbyname(host)}:{port}"
@@ -429,7 +434,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                     raise ExecutionError(
                         f"fragment reassignment exhausted "
                         f"(fragment {fi}: {attempts} attempts)"
-                    )
+                    ) from None
             except RequestTimeoutError as e:
                 if sp is not None:
                     sp.attrs["timed_out"] = True
@@ -446,6 +451,23 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
 
     with ThreadPoolExecutor(max_workers=min(len(fragments) or 1, 32)) as ex:
         return list(ex.map(run, enumerate(fragments)))
+
+
+def _check_fragment_plan(plan: LogicalPlan) -> None:
+    """Reject a fragment plan that fails static verification BEFORE any
+    dispatch happens (analysis/verify.py).  `PlanVerificationError` is
+    deliberately non-transient: an invalid plan replayed on another
+    worker is still invalid, so the failover/retry machinery must not
+    burn its budget on it.  Rejections count as ``coord.plan_rejected``
+    (rendered by EXPLAIN ANALYZE when nonzero)."""
+    from datafusion_tpu.analysis import verify as _averify
+
+    if not _averify.verify_enabled():
+        return
+    report = _averify.verify_plan(plan)
+    if not report.ok:
+        METRICS.add("coord.plan_rejected")
+        report.raise_if_failed()
 
 
 def _iter_unique_responses(responses):
@@ -472,6 +494,9 @@ class DistributedAggregateRelation(Relation):
     def __init__(self, plan, agg, pred, scan, ds: PartitionedDataSource,
                  workers: list[WorkerHandle], functions=None,
                  query_deadline_s: Optional[float] = None):
+        # verified once at construction: the plan is immutable, and
+        # batches()/re-collects must not re-walk it per iteration
+        _check_fragment_plan(plan)
         in_schema = scan.schema
         self.template = AggregateRelation(
             _SchemaOnlyRelation(in_schema),
@@ -557,7 +582,7 @@ class DistributedAggregateRelation(Relation):
             for s in best_str:
                 best_str[s].extend([None] * pad)
 
-        for frag, resp in _iter_unique_responses(responses):
+        for _frag, resp in _iter_unique_responses(responses):
             g = resp["num_groups"]
             if g == 0:
                 continue  # empty partition: nothing to merge
@@ -627,6 +652,7 @@ class DistributedUnionRelation(Relation):
 
     def __init__(self, plan, ds: PartitionedDataSource, workers: list[WorkerHandle],
                  query_deadline_s: Optional[float] = None):
+        _check_fragment_plan(plan)
         self.plan = plan
         self.ds = ds
         self.workers = workers
@@ -663,7 +689,7 @@ class DistributedUnionRelation(Relation):
             StringDictionary() if f.data_type == DataType.UTF8 else None
             for f in self._schema.fields
         ]
-        for frag, resp in _iter_unique_responses(responses):
+        for _frag, resp in _iter_unique_responses(responses):
             if resp["num_rows"] == 0:
                 continue
             cols = []
@@ -786,7 +812,9 @@ class DistributedContext(ExecutionContext):
                 self._shared_tier = SharedResultTier(self.cluster)
                 self._result_cache.shared = self._shared_tier
         self._request_timeout = request_timeout
-        self._workers_lock = threading.Lock()
+        from datafusion_tpu.analysis import lockcheck
+
+        self._workers_lock = lockcheck.make_lock("coord.workers")
         self.workers = [WorkerHandle(h, p, request_timeout) for h, p in workers]
         if discovered_all:
             for w in self.workers:
@@ -874,6 +902,12 @@ class DistributedContext(ExecutionContext):
         if view is None:
             return []
         live = view.live_addresses()
+        # pre-warm the DNS memo OUTSIDE the lock: gethostbyname blocks
+        # on the resolver, and a stalled _workers_lock would freeze the
+        # dispatch path for the duration (found by analysis/lockcheck —
+        # the `dns.resolve` held-lock blocking-call finding)
+        for addr in live | {f"{w.host}:{w.port}" for w in list(self.workers)}:
+            _resolve_addr(addr)
         added = []
         with self._workers_lock:
             # joins compare RESOLVED, like retirement and _apply_view:

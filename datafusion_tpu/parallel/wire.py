@@ -41,6 +41,7 @@ from typing import Optional
 
 import numpy as np
 
+from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.errors import ExecutionError
 from datafusion_tpu.testing import faults
 
@@ -94,6 +95,9 @@ def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
     """Send one frame; returns the total bytes written (callers like
     the shared-tier publisher account wire cost from this)."""
     faults.check("wire.send", type=obj.get("type"))
+    # a sender holding an engine lock would stall its contenders for a
+    # full network write — lockcheck records any lock held across this
+    lockcheck.note_blocking("wire.send")
     if bw is not None and bw.chunks:
         sizes = [memoryview(c).nbytes for c in bw.chunks]
         obj = dict(obj)
@@ -120,7 +124,7 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
     # become writable zero-copy views into the frame buffer
     buf = bytearray()
     while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
+        chunk = sock.recv(n - len(buf))  # df-lint: ok(DF003) — helper under recv_msg's wire.recv site
         if not chunk:
             return None
         buf.extend(chunk)
@@ -144,6 +148,7 @@ def _attach_bins(node, bins: list) -> None:
 def recv_msg(sock: socket.socket) -> Optional[dict]:
     """One frame, or None on clean EOF."""
     faults.check("wire.recv")
+    lockcheck.note_blocking("wire.recv")
     header = _recv_exact(sock, _LEN.size)
     if header is None:
         return None
@@ -191,7 +196,7 @@ def recv_msg(sock: socket.socket) -> Optional[dict]:
         # a frame that cannot parse means the stream is garbage
         # (corruption, desync, protocol mismatch) — every later frame
         # boundary is suspect too, so surface a connection-level error
-        raise ProtocolError(f"unparseable frame ({len(data)} bytes): {e}")
+        raise ProtocolError(f"unparseable frame ({len(data)} bytes): {e}") from e
 
 
 def enc_array(a: np.ndarray, bw: Optional[BinWriter] = None) -> dict:

@@ -70,7 +70,7 @@ class Operator(enum.Enum):
         try:
             return Operator[obj]
         except KeyError:
-            raise PlanError(f"Unknown Operator {obj!r}")
+            raise PlanError(f"Unknown Operator {obj!r}") from None
 
 
 class ScalarValue:

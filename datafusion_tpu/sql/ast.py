@@ -167,10 +167,13 @@ class SqlCreateExternalTable(SqlNode):
 
 @dataclass
 class SqlExplain(SqlNode):
-    """EXPLAIN [ANALYZE] stmt — engine extension (the reference only
-    println!s the plan on every execute, `context.rs:104`).  With
+    """EXPLAIN [ANALYZE|VERIFY] stmt — engine extension (the reference
+    only println!s the plan on every execute, `context.rs:104`).  With
     `analyze` the statement EXECUTES and the plan is annotated with
-    measured per-operator stats (obs/explain.py)."""
+    measured per-operator stats (obs/explain.py); with `verify` the
+    plan is statically type-checked WITHOUT executing and the inferred
+    schema per operator is rendered (analysis/verify.py)."""
 
     stmt: SqlNode
     analyze: bool = False
+    verify: bool = False

@@ -28,6 +28,7 @@ from datafusion_tpu.sql import ast
 from datafusion_tpu.sql.tokenizer import EOF, NUMBER, OP, STRING, WORD, Token, tokenize
 
 _EXPLAIN_ANALYZE = re.compile(r"\s*EXPLAIN\s+ANALYZE\b", re.IGNORECASE)
+_EXPLAIN_VERIFY = re.compile(r"\s*EXPLAIN\s+VERIFY\b", re.IGNORECASE)
 
 # precedence table (higher binds tighter)
 _PREC_OR = 5
@@ -121,7 +122,10 @@ class Parser:
             return self._parse_create_external_table()
         if self.parse_keyword("EXPLAIN"):
             analyze = self.parse_keyword("ANALYZE")
-            return ast.SqlExplain(self.parse_statement(), analyze=analyze)
+            verify = False if analyze else self.parse_keyword("VERIFY")
+            return ast.SqlExplain(
+                self.parse_statement(), analyze=analyze, verify=verify
+            )
         if self.parse_keyword("SELECT"):
             return self._parse_select()
         raise ParserError(f"Expected a statement, found {self.peek()} in {self.sql!r}")
@@ -341,12 +345,15 @@ def parse_sql(sql: str) -> ast.SqlNode:
     """
     from datafusion_tpu.native.sqlfront import native_parse_sql
 
-    # EXPLAIN ANALYZE is a Python-side extension (the C++ front-end's
-    # grammar stops at plain EXPLAIN): strip the prefix here and wrap,
-    # so both front-ends accept it identically
+    # EXPLAIN ANALYZE / EXPLAIN VERIFY are Python-side extensions (the
+    # C++ front-end's grammar stops at plain EXPLAIN): strip the prefix
+    # here and wrap, so both front-ends accept them identically
     m = _EXPLAIN_ANALYZE.match(sql)
     if m:
         return ast.SqlExplain(parse_sql(sql[m.end():]), analyze=True)
+    m = _EXPLAIN_VERIFY.match(sql)
+    if m:
+        return ast.SqlExplain(parse_sql(sql[m.end():]), verify=True)
     node = native_parse_sql(sql)
     if node is not None:
         return node

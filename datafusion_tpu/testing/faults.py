@@ -67,7 +67,6 @@ import fnmatch
 import json
 import os
 import random
-import threading
 import time
 from typing import Any, Optional
 
@@ -164,11 +163,13 @@ class FaultPlan:
     """A set of rules plus their (lock-protected) firing state."""
 
     def __init__(self, spec: dict):
+        from datafusion_tpu.analysis import lockcheck
+
         self.seed = int(spec.get("seed", 0))
         self.rules = [
             _Rule(r, self.seed, i) for i, r in enumerate(spec.get("rules", []))
         ]
-        self._lock = threading.Lock()
+        self._lock = lockcheck.make_lock("faults.plan")
 
     def _due(self, site: str, role: str, ctx: dict) -> Optional[_Rule]:
         """Advance hit counters; return the rule that fires, if any."""

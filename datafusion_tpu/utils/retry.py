@@ -54,7 +54,8 @@ def seed_backoff(seed: int) -> None:
     _RNG = random.Random(seed)
 
 
-def backoff_s(attempt: int, base: float = None, cap: float = None) -> float:
+def backoff_s(attempt: int, base: "float | None" = None,
+              cap: "float | None" = None) -> float:
     """Sleep length before retry `attempt` (1-based): full jitter over
     a capped exponential — uniform in [0, min(cap, base * 2^(a-1))]."""
     base = _BASE_S if base is None else base

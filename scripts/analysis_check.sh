@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Static-verification gate (companion to asan_check.sh / tsan_check.sh,
+# which cover native/): runs the project invariant linter over the
+# Python engine, then a lockcheck-enabled fast test pass whose
+# lock-order report must come back clean (no cycles, no held-lock
+# blocking calls).  Wired into smoketest.sh and the CI lint job.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+report="$(mktemp)"
+trap 'rm -f "${report}"' EXIT
+
+echo "== self-lint (python -m datafusion_tpu.analysis) =="
+python -m datafusion_tpu.analysis datafusion_tpu
+
+echo "== plan verifier smoke (EXPLAIN VERIFY + reject) =="
+JAX_PLATFORMS="${SMOKETEST_DEVICE:-cpu}" python - <<'EOF'
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.errors import PlanVerificationError
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.plan.logical import Projection, TableScan
+from datafusion_tpu.plan.expr import Column
+import os, tempfile
+
+tmp = tempfile.mkdtemp()
+path = os.path.join(tmp, "t.csv")
+with open(path, "w", encoding="utf-8") as f:
+    f.write("city,lat\nSF,37.7\n")
+schema = Schema([Field("city", DataType.UTF8), Field("lat", DataType.FLOAT64)])
+ctx = ExecutionContext(result_cache=False)
+ctx.register_csv("t", path, schema)
+out = ctx.sql("EXPLAIN VERIFY SELECT city, MIN(lat) FROM t GROUP BY city")
+assert out.ok and "::" in repr(out), repr(out)
+try:
+    ctx.execute(Projection([Column(9)], TableScan("default", "t", schema),
+                           Schema([Field("x", DataType.INT64)])))
+    raise SystemExit("verifier failed to reject an unknown column")
+except PlanVerificationError as e:
+    assert "unknown column #9" in str(e)
+print("verifier smoke OK")
+EOF
+
+echo "== lockcheck-enabled fast tests =="
+JAX_PLATFORMS="${SMOKETEST_DEVICE:-cpu}" \
+DATAFUSION_TPU_LOCKCHECK=1 \
+DATAFUSION_TPU_LOCKCHECK_FILE="${report}" \
+python -m pytest tests/test_analysis.py tests/test_cache.py \
+    tests/test_io_thread.py -q -p no:cacheprovider
+
+echo "== lock-order report =="
+python -m datafusion_tpu.analysis --lockcheck-report "${report}"
+
+echo "ANALYSIS CHECK PASSED"

@@ -24,6 +24,9 @@ else
   echo "== unit tests skipped (SMOKETEST_SKIP_TESTS=1; CI runs them in the test matrix) =="
 fi
 
+echo "== analysis check (self-lint + plan verifier + lockcheck report) =="
+./scripts/analysis_check.sh
+
 echo "== chaos smoke (distributed query under a seeded fault plan) =="
 python scripts/chaos_smoke.py
 

@@ -1,0 +1,673 @@
+"""Static verification layer tests (analysis/): plan-IR verifier
+accept/reject table over every LogicalPlan/expr variant, invariant
+linter rules on synthetic ASTs + a self-lint gate over the package,
+lock-order detection with a deliberately inverted two-lock fixture,
+and the DATAFUSION_TPU_VERIFY=0 no-regression parity run."""
+
+import os
+import threading
+
+import pytest
+
+from datafusion_tpu.analysis import lint, lockcheck, verify
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.errors import (
+    NotSupportedError,
+    PlanError,
+    PlanVerificationError,
+    TransientError,
+)
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.plan.expr import (
+    AggregateFunction,
+    BinaryExpr,
+    Cast,
+    Column,
+    IsNotNull,
+    IsNull,
+    Literal,
+    Operator,
+    ScalarFunction,
+    ScalarValue,
+    SortExpr,
+)
+from datafusion_tpu.plan.logical import (
+    Aggregate,
+    EmptyRelation,
+    Limit,
+    Projection,
+    Selection,
+    Sort,
+    TableScan,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = Schema([
+    Field("city", DataType.UTF8),
+    Field("lat", DataType.FLOAT64),
+    Field("pop", DataType.INT64),
+    Field("flag", DataType.BOOLEAN),
+])
+
+
+def scan(schema=SCHEMA, projection=None):
+    return TableScan("default", "t", schema, projection)
+
+
+def lit_i(v):
+    return Literal(ScalarValue.int64(v))
+
+
+def lit_s(v):
+    return Literal(ScalarValue.utf8(v))
+
+
+@pytest.fixture
+def ctx(tmp_path):
+    p = tmp_path / "t.csv"
+    p.write_text(
+        "city,lat,pop,flag\n"
+        "SF,37.7,800000,true\nLA,34.0,4000000,false\nNY,40.7,8000000,true\n"
+    )
+    c = ExecutionContext(result_cache=False)
+    c.register_csv("t", str(p), SCHEMA)
+    return c
+
+
+# ---------------------------------------------------------------- verifier
+
+
+class TestVerifierAccepts:
+    """Every plan variant the engine executes must verify clean."""
+
+    @pytest.mark.parametrize("sql", [
+        "SELECT city, pop FROM t",
+        "SELECT * FROM t WHERE lat > 35.0",
+        "SELECT pop + 1, CAST(pop AS DOUBLE) FROM t",
+        "SELECT city FROM t WHERE city = 'SF'",
+        "SELECT city FROM t WHERE 'SF' = city",
+        "SELECT city FROM t WHERE city >= 'LA' AND pop > 100",
+        "SELECT city, MIN(lat), MAX(city), COUNT(pop) FROM t GROUP BY city",
+        "SELECT SUM(pop), AVG(lat) FROM t",
+        "SELECT COUNT(*) FROM t",
+        "SELECT 1 + 2",
+        "SELECT city FROM t WHERE lat IS NOT NULL ORDER BY pop DESC LIMIT 2",
+        "SELECT sqrt(lat) FROM t",
+        "SELECT city FROM t WHERE pop IS NULL",
+    ])
+    def test_planner_output_verifies(self, ctx, sql):
+        plan = ctx._plan(__import__(
+            "datafusion_tpu.sql.parser", fromlist=["parse_sql"]
+        ).parse_sql(sql))
+        report = verify.verify_plan(plan, functions=ctx.functions)
+        assert report.ok, report.render()
+
+    def test_count_star_over_empty_relation(self):
+        # COUNT(1) with no FROM: the COUNT(#0) rewrite is plan-shape
+        # parity only — #0 must NOT need to resolve in a 0-col schema
+        agg = AggregateFunction("COUNT", [Column(0)], DataType.UINT64, True)
+        plan = Aggregate(EmptyRelation(Schema([])), [], [agg],
+                         Schema([Field("COUNT", DataType.UINT64, True)]))
+        assert verify.verify_plan(plan).ok
+
+    def test_every_plan_variant_in_one_tree(self):
+        base = Selection(BinaryExpr(Column(1), Operator.Gt,
+                                    Literal(ScalarValue.float64(0.0))),
+                         scan())
+        proj = Projection(
+            [Column(0), Column(2), IsNull(Column(1)), IsNotNull(Column(3))],
+            base,
+            Schema([Field("city", DataType.UTF8),
+                    Field("pop", DataType.INT64),
+                    Field("is_null", DataType.BOOLEAN, False),
+                    Field("is_not_null", DataType.BOOLEAN, False)]),
+        )
+        sort = Sort([SortExpr(Column(1), False)], proj, proj.schema)
+        plan = Limit(2, sort, sort.schema)
+        report = verify.verify_plan(plan)
+        assert report.ok, report.render()
+        # the report carries one inferred schema per operator, root first
+        labels = [label for _, label, _ in report.operators]
+        assert labels[0].startswith("Limit")
+        assert labels[-1].startswith("TableScan")
+
+
+class TestVerifierRejects:
+    def _one(self, plan, fragment, functions=None):
+        report = verify.verify_plan(plan, functions=functions)
+        assert not report.ok
+        text = "\n".join(repr(d) for d in report.diagnostics)
+        assert fragment in text, text
+        with pytest.raises(PlanVerificationError):
+            report.raise_if_failed()
+        return report
+
+    def test_unknown_column(self):
+        plan = Projection([Column(9)], scan(),
+                          Schema([Field("x", DataType.INT64)]))
+        r = self._one(plan, "unknown column #9")
+        # source-anchored: names the plan path and the expression
+        assert r.diagnostics[0].path == "Projection.expr[0]"
+        assert r.diagnostics[0].expr == "#9"
+
+    def test_scan_projection_out_of_range(self):
+        self._one(scan(projection=[0, 12]), "out of range")
+
+    def test_non_boolean_predicate(self):
+        self._one(Selection(Column(2), scan()), "expected Boolean")
+
+    def test_utf8_vs_number_comparison(self):
+        plan = Selection(
+            BinaryExpr(Column(0), Operator.Eq, lit_i(3)), scan()
+        )
+        self._one(plan, "Utf8 column compares only against a string")
+
+    def test_utf8_column_vs_column_comparison(self):
+        plan = Selection(
+            BinaryExpr(Column(0), Operator.Lt, Column(0)), scan()
+        )
+        self._one(plan, "column-vs-literal only")
+
+    def test_bare_utf8_literal_projection(self):
+        plan = Projection([lit_s("x")], scan(),
+                          Schema([Field("lit", DataType.UTF8)]))
+        self._one(plan, "bare string literals")
+
+    def test_utf8_arithmetic(self):
+        plan = Projection(
+            [BinaryExpr(Column(0), Operator.Plus, lit_s("x"))], scan(),
+            Schema([Field("y", DataType.UTF8)]),
+        )
+        self._one(plan, "not defined on Utf8")
+
+    def test_no_common_supertype(self):
+        plan = Projection(
+            [BinaryExpr(Column(3), Operator.Plus, lit_i(1))], scan(),
+            Schema([Field("y", DataType.INT64)]),
+        )
+        self._one(plan, "no common supertype")
+
+    def test_boolean_operand_not_boolean(self):
+        plan = Selection(
+            BinaryExpr(Column(2), Operator.And, Column(3)), scan()
+        )
+        self._one(plan, "expected Boolean")
+
+    def test_utf8_cast(self):
+        plan = Projection([Cast(Column(0), DataType.INT64)], scan(),
+                          Schema([Field("cast", DataType.INT64)]))
+        self._one(plan, "CAST Utf8")
+
+    def test_unknown_aggregate(self):
+        agg = AggregateFunction("median", [Column(1)], DataType.FLOAT64)
+        plan = Aggregate(scan(), [], [agg],
+                         Schema([Field("median", DataType.FLOAT64)]))
+        self._one(plan, "unknown aggregate")
+
+    def test_aggregate_arity(self):
+        agg = AggregateFunction("min", [Column(1), Column(2)],
+                                DataType.FLOAT64)
+        plan = Aggregate(scan(), [], [agg],
+                         Schema([Field("min", DataType.FLOAT64)]))
+        self._one(plan, "exactly one argument")
+
+    def test_sum_over_utf8(self):
+        agg = AggregateFunction("sum", [Column(0)], DataType.UTF8)
+        plan = Aggregate(scan(), [], [agg],
+                         Schema([Field("sum", DataType.UTF8)]))
+        self._one(plan, "over Utf8")
+
+    def test_min_over_computed_utf8(self):
+        # fusibility + executor precondition: Utf8 MIN/MAX needs a column
+        agg = AggregateFunction(
+            "min", [Cast(Column(0), DataType.UTF8)], DataType.UTF8
+        )
+        plan = Aggregate(scan(), [], [agg],
+                         Schema([Field("min", DataType.UTF8)]))
+        self._one(plan, "bare column")
+
+    def test_computed_group_key(self):
+        agg = AggregateFunction("count", [Column(2)], DataType.UINT64)
+        key = BinaryExpr(Column(2), Operator.Plus, lit_i(1))
+        plan = Aggregate(scan(), [key], [agg],
+                         Schema([Field("k", DataType.INT64),
+                                 Field("count", DataType.UINT64)]))
+        self._one(plan, "bare column references")
+
+    def test_count_return_type(self):
+        agg = AggregateFunction("count", [Column(2)], DataType.INT64)
+        plan = Aggregate(scan(), [], [agg],
+                         Schema([Field("count", DataType.INT64)]))
+        self._one(plan, "COUNT returns UInt64")
+
+    def test_aggregate_return_type_mismatch(self):
+        agg = AggregateFunction("min", [Column(1)], DataType.INT64)
+        plan = Aggregate(scan(), [], [agg],
+                         Schema([Field("min", DataType.INT64)]))
+        self._one(plan, "argument computes Float64")
+
+    def test_declared_schema_arity_mismatch(self):
+        plan = Projection([Column(1)], scan(),
+                          Schema([Field("a", DataType.FLOAT64),
+                                  Field("b", DataType.INT64)]))
+        self._one(plan, "declared schema has 2 field(s)")
+
+    def test_declared_dtype_mismatch(self):
+        # the malformed-dtype query: schema says Int64, expr computes f64
+        plan = Projection([Column(1)], scan(),
+                          Schema([Field("lat", DataType.INT64)]))
+        self._one(plan, "declared field 0")
+
+    def test_non_column_sort_key(self):
+        key = SortExpr(BinaryExpr(Column(2), Operator.Plus, lit_i(1)), True)
+        plan = Sort([key], scan(), SCHEMA)
+        self._one(plan, "ORDER BY keys must be bare column")
+
+    def test_negative_limit(self):
+        self._one(Limit(-1, scan(), SCHEMA), "non-negative")
+
+    def test_aggregate_in_scalar_context(self):
+        agg = AggregateFunction("min", [Column(1)], DataType.FLOAT64)
+        plan = Selection(
+            BinaryExpr(agg, Operator.Gt, Literal(ScalarValue.float64(0.0))),
+            scan(),
+        )
+        self._one(plan, "outside an Aggregate operator")
+
+    def test_udf_signature_checks(self, ctx):
+        import jax.numpy as jnp
+
+        ctx.register_udf("twice", [DataType.FLOAT64], DataType.FLOAT64,
+                         jax_fn=lambda x: x * jnp.float64(2))
+        # unknown function
+        plan = Projection(
+            [ScalarFunction("nosuch", [Column(1)], DataType.FLOAT64)],
+            scan(), Schema([Field("nosuch", DataType.FLOAT64)]),
+        )
+        self._one(plan, "unknown function", functions=ctx.functions)
+        # arity
+        plan = Projection(
+            [ScalarFunction("twice", [Column(1), Column(1)],
+                            DataType.FLOAT64)],
+            scan(), Schema([Field("twice", DataType.FLOAT64)]),
+        )
+        self._one(plan, "expects 1 argument", functions=ctx.functions)
+        # argument dtype: Utf8 cannot coerce to Float64
+        plan = Projection(
+            [ScalarFunction("twice", [Column(0)], DataType.FLOAT64)],
+            scan(), Schema([Field("twice", DataType.FLOAT64)]),
+        )
+        self._one(plan, "no implicit coercion", functions=ctx.functions)
+        # declared return type disagrees with the registry
+        plan = Projection(
+            [ScalarFunction("twice", [Column(1)], DataType.INT64)],
+            scan(), Schema([Field("twice", DataType.INT64)]),
+        )
+        self._one(plan, "registry says", functions=ctx.functions)
+
+
+class TestEngineWiring:
+    def test_execute_rejects_at_plan_time(self, ctx):
+        bad = Projection([Column(9)], scan(),
+                         Schema([Field("x", DataType.INT64)]))
+        with pytest.raises(PlanVerificationError) as ei:
+            ctx.execute(bad)
+        # typed AND non-transient: failover must not retry an invalid plan
+        assert not isinstance(ei.value, TransientError)
+        assert isinstance(ei.value, PlanError)
+        assert isinstance(ei.value, NotSupportedError)
+        assert ei.value.diagnostics
+
+    def test_verify_off_is_passthrough(self, ctx, monkeypatch):
+        from datafusion_tpu.errors import DataFusionError
+
+        monkeypatch.setenv("DATAFUSION_TPU_VERIFY", "0")
+        bad = Projection([Column(9)], scan(),
+                         Schema([Field("x", DataType.INT64)]))
+        with pytest.raises(DataFusionError) as ei:
+            from datafusion_tpu.exec.materialize import collect
+
+            collect(ctx.execute(bad))
+        assert not isinstance(ei.value, PlanVerificationError)
+
+    def test_verify_off_matches_verified_results(self, ctx, monkeypatch):
+        sql = ("SELECT city, MIN(lat), COUNT(pop) FROM t "
+               "WHERE pop > 100 GROUP BY city")
+        rows_on = ctx.sql_collect(sql).to_rows()
+        monkeypatch.setenv("DATAFUSION_TPU_VERIFY", "0")
+        rows_off = ctx.sql_collect(sql).to_rows()
+        assert rows_on == rows_off
+
+    def test_explain_verify_renders_schema_per_operator(self, ctx):
+        out = ctx.sql("EXPLAIN VERIFY SELECT city, MIN(lat) FROM t "
+                      "GROUP BY city ORDER BY city LIMIT 1")
+        text = repr(out)
+        assert out.ok
+        assert "plan verified: OK" in text
+        assert "city: Utf8" in text
+        assert "MIN: Float64" in text
+        # one inferred-schema line per operator in the tree
+        assert text.count("::") == len(out.report.operators)
+
+    def test_explain_verify_reports_failure_without_executing(self, ctx):
+        # the planner accepts Utf8-vs-Utf8 (supertype exists); the
+        # verifier catches the unsupported col-vs-col comparison shape
+        out = ctx.sql("EXPLAIN VERIFY SELECT city FROM t WHERE city < city")
+        assert not out.ok
+        assert "FAILED" in repr(out)
+
+    def test_sql_query_rejected_at_plan_time(self, ctx):
+        with pytest.raises(PlanVerificationError):
+            ctx.sql_collect("SELECT city FROM t WHERE city < city")
+
+    def test_coordinator_rejects_fragment_plan(self, ctx):
+        from datafusion_tpu.parallel.coordinator import _check_fragment_plan
+        from datafusion_tpu.utils.metrics import METRICS
+
+        bad = Projection([Column(9)], scan(),
+                         Schema([Field("x", DataType.INT64)]))
+        before = METRICS.counts.get("coord.plan_rejected", 0)
+        with pytest.raises(PlanVerificationError):
+            _check_fragment_plan(bad)
+        assert METRICS.counts.get("coord.plan_rejected", 0) == before + 1
+        # a good plan passes without counting
+        _check_fragment_plan(scan())
+        assert METRICS.counts.get("coord.plan_rejected", 0) == before + 1
+
+
+# ------------------------------------------------------------------ linter
+
+
+def _lint(src, relpath="datafusion_tpu/exec/fused.py"):
+    return lint.lint_source(src, relpath)
+
+
+class TestLintRules:
+    def test_df001_host_sync(self):
+        src = "import jax\ndef f(x):\n    return jax.block_until_ready(x)\n"
+        found = _lint(src, "datafusion_tpu/exec/aggregate.py")
+        assert [f.rule for f in found] == ["DF001"]
+        # outside exec/: not a dispatch path
+        assert _lint(src, "datafusion_tpu/cli.py") == []
+
+    def test_df001_asarray_only_in_fused(self):
+        src = "import numpy as np\ndef f(x):\n    return np.asarray(x)\n"
+        assert [f.rule for f in _lint(src)] == ["DF001"]
+        assert _lint(src, "datafusion_tpu/exec/sort.py") == []
+
+    def test_df002_wall_clock_in_replayable(self):
+        src = (
+            "import time, random\n"
+            "from datafusion_tpu.testing import faults\n"
+            "def replay():\n"
+            "    faults.check('site')\n"
+            "    t = time.time()\n"
+            "    r = random.random()\n"
+            "    time.monotonic(); time.sleep(0)\n"
+            "    return t, r\n"
+            "def free():\n"
+            "    return time.time()\n"
+        )
+        found = _lint(src, "datafusion_tpu/x.py")
+        assert [f.rule for f in found] == ["DF002", "DF002"]
+        assert found[0].line == 5 and found[1].line == 6
+
+    def test_df003_raw_socket_io(self):
+        src = (
+            "def bad(sock):\n"
+            "    sock.sendall(b'x')\n"
+            "def good(sock):\n"
+            "    from datafusion_tpu.testing import faults\n"
+            "    faults.check('my.site')\n"
+            "    sock.sendall(b'x')\n"
+        )
+        found = _lint(src, "datafusion_tpu/x.py")
+        assert [(f.rule, f.line) for f in found] == [("DF003", 2)]
+
+    def test_df004_broad_except(self):
+        src = (
+            "def f():\n"
+            "    try:\n"
+            "        g()\n"
+            "    except:\n"
+            "        pass\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:\n"
+            "        raise\n"
+            "    try:\n"
+            "        g()\n"
+            "    except Exception:  # noqa: BLE001 — justified\n"
+            "        pass\n"
+            "    try:\n"
+            "        g()\n"
+            "    except ValueError:\n"
+            "        pass\n"
+        )
+        found = _lint(src, "datafusion_tpu/x.py")
+        assert [(f.rule, f.line) for f in found] == [("DF004", 4),
+                                                    ("DF004", 8)]
+
+    def test_df005_lock_in_metrics(self):
+        src = (
+            "import threading\n"
+            "class Metrics:\n"
+            "    def add(self, n):\n"
+            "        with self._lock:\n"
+            "            pass\n"
+        )
+        found = _lint(src, "datafusion_tpu/utils/metrics.py")
+        assert {f.rule for f in found} == {"DF005"}
+        # same code outside the metrics/stats scope is fine
+        assert _lint(src, "datafusion_tpu/cache/store.py") == []
+
+    def test_suppression_marker(self):
+        src = ("import jax\ndef f(x):\n"
+               "    return jax.block_until_ready(x)  "
+               "# df-lint: ok(DF001) — probe\n")
+        assert _lint(src, "datafusion_tpu/exec/batch.py") == []
+        # a marker for a DIFFERENT rule does not suppress
+        src2 = ("import jax\ndef f(x):\n"
+                "    return jax.block_until_ready(x)  "
+                "# df-lint: ok(DF004)\n")
+        assert [f.rule for f in
+                _lint(src2, "datafusion_tpu/exec/batch.py")] == ["DF001"]
+
+    def test_syntax_error_is_a_finding(self):
+        found = _lint("def f(:\n", "datafusion_tpu/x.py")
+        assert [f.rule for f in found] == ["DF000"]
+
+    def test_self_lint_is_clean(self):
+        pkg = os.path.join(REPO, "datafusion_tpu")
+        findings = lint.lint_paths([pkg])
+        assert findings == [], "\n".join(f.text() for f in findings)
+
+    def test_github_format(self):
+        f = lint.Finding("DF001", "a.py", 3, 1, "msg")
+        assert f.github() == "::error file=a.py,line=3,col=1::DF001 msg"
+
+
+# --------------------------------------------------------------- lockcheck
+
+
+class TestLockcheck:
+    def test_inverted_two_lock_fixture_cycles(self):
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("store", reg)
+        b = lockcheck.TrackedLock("publisher", reg)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        cycles = reg.cycles()
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"store", "publisher"}
+        rep = reg.report()
+        assert len(rep["cycles"]) == 1
+        assert all(e["site"] for e in rep["cycles"][0]["edges"])
+
+    def test_consistent_order_is_clean(self):
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("a", reg)
+        b = lockcheck.TrackedLock("b", reg)
+        for _ in range(3):
+            with a, b:
+                pass
+        assert reg.cycles() == []
+        assert reg.ok
+
+    def test_three_lock_cycle(self):
+        reg = lockcheck.Registry()
+        locks = {n: lockcheck.TrackedLock(n, reg) for n in "abc"}
+        for pair in (("a", "b"), ("b", "c"), ("c", "a")):
+            with locks[pair[0]]:
+                with locks[pair[1]]:
+                    pass
+        cycles = reg.cycles()
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"a", "b", "c"}
+
+    def test_blocking_call_while_holding_lock(self):
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("store", reg)
+        reg.note_blocking("wire.recv")  # holding nothing: clean
+        assert reg.report()["blocking"] == []
+        with a:
+            reg.note_blocking("wire.recv")
+        rep = reg.report()
+        assert [(b["op"], b["held"]) for b in rep["blocking"]] == [
+            ("wire.recv", "store")
+        ]
+        assert not reg.ok
+
+    def test_try_acquire_records_no_edges(self):
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("a", reg)
+        b = lockcheck.TrackedLock("b", reg)
+        with a:
+            assert b.acquire(blocking=False)
+            b.release()
+        assert reg.edges == {}
+
+    def test_condition_compatible(self):
+        reg = lockcheck.Registry()
+        lk = lockcheck.TrackedLock("cond", reg)
+        cond = threading.Condition(lk)
+        with cond:
+            assert reg.held() == ["cond"]
+            cond.wait(timeout=0.01)
+            assert reg.held() == ["cond"]
+        assert reg.held() == []
+
+    def test_non_lifo_release(self):
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("a", reg)
+        b = lockcheck.TrackedLock("b", reg)
+        a.acquire()
+        b.acquire()
+        a.release()  # out of order
+        assert reg.held() == ["b"]
+        b.release()
+        assert reg.held() == []
+
+    def test_make_lock_plain_when_disabled(self, monkeypatch):
+        monkeypatch.setattr(lockcheck, "_ENABLED", False)
+        lk = lockcheck.make_lock("x")
+        assert isinstance(lk, type(threading.Lock()))
+        monkeypatch.setattr(lockcheck, "_ENABLED", True)
+        lk = lockcheck.make_lock("x")
+        assert isinstance(lk, lockcheck.TrackedLock)
+
+    def test_cross_thread_inversion_detected(self):
+        # the two orders happen on DIFFERENT threads (no deadlock this
+        # run — the graph still records the hazard)
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("a", reg)
+        b = lockcheck.TrackedLock("b", reg)
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=order_ab)
+        t1.start()
+        t1.join()
+        order_ba()
+        assert len(reg.cycles()) == 1
+
+    def test_dns_resolve_is_a_noted_blocking_site(self, monkeypatch):
+        # the regression fixed this PR: DNS under coord.workers (the
+        # pre-warm in _fold_view_workers keeps resolution outside the
+        # lock; this pins the detector that caught it)
+        monkeypatch.setattr(lockcheck, "_ENABLED", True)
+        from datafusion_tpu.parallel import coordinator as co
+
+        reg = lockcheck.Registry()
+        monkeypatch.setattr(lockcheck, "GLOBAL", reg)
+        co._resolve_addr.cache_clear()
+        lk = lockcheck.TrackedLock("coord.workers", reg)
+        with lk:
+            co._resolve_addr("127.0.0.1:1234")
+        assert [(b["op"], b["held"]) for b in reg.report()["blocking"]] == [
+            ("dns.resolve", "coord.workers")
+        ]
+        co._resolve_addr.cache_clear()
+
+
+# ------------------------------------------------------- CLI / report glue
+
+
+class TestAnalysisCli:
+    def test_lint_cli_clean_package(self, capsys):
+        from datafusion_tpu.analysis.__main__ import main
+
+        rc = main([os.path.join(REPO, "datafusion_tpu")])
+        assert rc == 0
+        assert "0 finding(s)" in capsys.readouterr().out
+
+    def test_lint_cli_github_format(self, tmp_path, capsys):
+        from datafusion_tpu.analysis.__main__ import main
+
+        bad = tmp_path / "datafusion_tpu" / "exec"
+        bad.mkdir(parents=True)
+        f = bad / "fused.py"
+        f.write_text("import numpy as np\ndef g(x):\n"
+                     "    return np.asarray(x)\n")
+        rc = main([str(f), "--format=github"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "::error file=" in out
+
+    def test_lockcheck_report_evaluation(self, tmp_path, capsys):
+        import json
+
+        from datafusion_tpu.analysis.__main__ import main
+
+        reg = lockcheck.Registry()
+        a = lockcheck.TrackedLock("a", reg)
+        b = lockcheck.TrackedLock("b", reg)
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        path = tmp_path / "lockcheck.json"
+        path.write_text(json.dumps(reg.report()))
+        assert main(["--lockcheck-report", str(path)]) == 1
+        assert "lock-order cycle" in capsys.readouterr().out
+        clean = tmp_path / "clean.json"
+        clean.write_text(json.dumps(lockcheck.Registry().report()))
+        assert main(["--lockcheck-report", str(clean)]) == 0

@@ -315,7 +315,7 @@ class TestProfilerTrace:
                 ctx.sql_collect("SELECT SUM(x), COUNT(1) FROM t WHERE x > 1")
         # a plugins/profile/<ts>/ tree with at least one trace artifact
         found = []
-        for root, _dirs, files in os.walk(out_dir):
+        for _root, _dirs, files in os.walk(out_dir):
             found.extend(files)
         assert found, "profiler produced no trace files"
 

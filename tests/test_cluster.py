@@ -30,6 +30,7 @@ import pytest
 
 from datafusion_tpu.cache.result import CachedResult, CachedResultRelation
 from datafusion_tpu.cache.store import CacheStore
+from datafusion_tpu.errors import ExecutionError
 from datafusion_tpu.cluster import (
     ClusterNode,
     ClusterState,
@@ -603,7 +604,7 @@ class TestClusterIntegration:
         ctx = DistributedContext([("127.0.0.1", 1)], result_cache=False)
         assert ctx.cluster is None and ctx.membership is None
         assert ctx._shared_tier is None
-        with pytest.raises(Exception):
+        with pytest.raises(ExecutionError):
             ctx.cluster_epoch()
         assert ctx.sync_workers() == []
         assert ctx.broadcast_invalidate("t") == 0
@@ -730,7 +731,7 @@ class TestReplication:
             {"site": "cluster.election", "op": "raise",
              "exc": "ExecutionError", "count": 1},
         ]}):
-            with pytest.raises(Exception):
+            with pytest.raises(ExecutionError):
                 b.maybe_promote(now=now)
             assert b.role == "standby"  # the aborted round changed nothing
         assert b.maybe_promote(now=now)

@@ -75,7 +75,9 @@ class TestDataFrame:
         assert "Projection" in text and "Selection" in text and "TableScan" in text
 
     def test_col_errors(self, ctx):
-        with pytest.raises(Exception):
+        from datafusion_tpu.errors import DataFusionError
+
+        with pytest.raises(DataFusionError):
             ctx.table("uk_cities").col("nope")
 
     def test_df_udf_udt_golden(self):

@@ -78,7 +78,7 @@ def test_csv_sql_example(ctx):
     assert t.schema.names() == ["city", "lat", "lng", "binary_expr"]
     rows = t.to_rows()
     assert len(rows) == 18  # uk_cities.csv rows with 51 < lat < 53
-    for city, lat, lng, s in rows:
+    for _city, lat, lng, s in rows:
         assert 51.0 < lat < 53.0
         assert s == pytest.approx(lat + lng)
     assert any(r[0].startswith("Solihull") for r in rows)

@@ -174,11 +174,11 @@ class TestNativeEngine:
         schema = Schema([Field("k", DataType.UTF8, False), Field("v", DataType.INT64, False)])
         ctx = PartitionedContext(mesh=make_mesh(2), batch_size=4)
         ctx.register_partitioned_csv("t", paths, schema)
-        got = dict(
-            (r[0], r[1]) for r in ctx.sql_collect(
+        got = {
+            r[0]: r[1] for r in ctx.sql_collect(
                 "SELECT k, COUNT(v) FROM t GROUP BY k"
             ).to_rows()
-        )
+        }
         import csv as _csv
 
         want = {}

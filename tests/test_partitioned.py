@@ -37,7 +37,7 @@ def _write_partitions(tmp_path, n_parts=5, rows_per_part=200, seed=7):
     for p in range(n_parts):
         path = tmp_path / f"part{p}.csv"
         lines = ["region,qty,price"]
-        for i in range(rows_per_part):
+        for _i in range(rows_per_part):
             region = REGIONS[rng.integers(len(REGIONS))]
             qty = "" if rng.random() < 0.05 else str(int(rng.integers(-50, 500)))
             price = f"{rng.random() * 100:.4f}"
@@ -367,7 +367,7 @@ class TestMeshStringMinMax:
         )
         rng = np.random.default_rng(23)
         parts = []
-        for p in range(4):
+        for _p in range(4):
             d = StringDictionary()
             names = [f"name_{int(i):03d}" for i in rng.integers(0, 200, 300)]
             codes = d.encode(names)
