@@ -98,8 +98,11 @@ def _pass_metrics(fn, bytes_per_pass: float, runs: int = 3) -> dict:
     both monotonically."""
     from datafusion_tpu.utils.metrics import METRICS
 
+    from datafusion_tpu.obs import recorder
+
     fn()  # ensure warm before counting
     before = METRICS.snapshot()["counts"].get("device.launches", 0)
+    flight_before = recorder.emitted()
     t0 = time.perf_counter()
     for _ in range(runs):
         fn()
@@ -111,6 +114,13 @@ def _pass_metrics(fn, bytes_per_pass: float, runs: int = 3) -> dict:
         "launches_per_pass": round(launches, 1),
         "hbm_gbps_achieved": round(hbm, 2),
         "hbm_util_pct": round(100 * hbm / _hbm_peak_gbps(), 2),
+        # flight-recorder cost accounting: events emitted per warm pass
+        # (each emit is ~1µs lock-free work — the ≤2% overhead budget
+        # holds as long as this stays in the tens per millisecond-scale
+        # query; see tests/test_telemetry.py::test_emit_overhead)
+        "flight_events_per_pass": round(
+            (recorder.emitted() - flight_before) / runs, 1
+        ),
     }
 
 

@@ -268,10 +268,16 @@ class LockInMetricsCallback(_Rule):
 
     _STATS_FNS = ("record_h2d", "record_d2h", "record_retry",
                   "record_launch", "current_op")
+    # the flight recorder's emit path carries the same contract: it is
+    # called inside other subsystems' critical sections (cluster state
+    # lock, device dispatch) and must never acquire a lock
+    _RECORDER_FNS = ("record", "observe", "observe_latency")
 
     def applies(self, relpath: str) -> bool:
         p = relpath.replace(os.sep, "/")
-        return p.endswith(("utils/metrics.py", "obs/stats.py"))
+        return p.endswith(("utils/metrics.py", "obs/stats.py",
+                           "obs/recorder.py", "obs/aggregate.py",
+                           "obs/slo.py"))
 
     def _scan(self, node, relpath, where):
         out = []
@@ -312,9 +318,13 @@ class LockInMetricsCallback(_Rule):
         p = relpath.replace(os.sep, "/")
         if p.endswith("utils/metrics.py"):
             return self._scan(tree, relpath, "utils/metrics.py")
+        wanted = (self._RECORDER_FNS
+                  if p.endswith(("obs/recorder.py", "obs/aggregate.py",
+                                 "obs/slo.py"))
+                  else self._STATS_FNS)
         out = []
         for fn in _functions_in(tree):
-            if fn.name in self._STATS_FNS:
+            if fn.name in wanted:
                 out.extend(self._scan(fn, relpath, f"{fn.name}()"))
         return out
 

@@ -59,6 +59,54 @@ def make_context(device: Optional[str] = None, batch_size: int = 131072):
     return ctx
 
 
+def fleet_top_text(ctx=None) -> str:
+    """The `datafusion-tpu top` view.  A DistributedContext aggregates
+    its whole fleet (worker snapshots via the cluster heartbeat
+    piggyback or direct pulls); any other context renders this
+    process's own histograms/counters as node "local"."""
+    if ctx is not None and hasattr(ctx, "top_text"):
+        return ctx.top_text()
+    from datafusion_tpu.obs import slo
+    from datafusion_tpu.obs.aggregate import FleetAggregator
+
+    rows = slo.WATCHDOG.evaluate() if slo.WATCHDOG.armed() else None
+    return FleetAggregator().top_text(slo_rows=rows)
+
+
+def run_top(workers: Optional[str], cluster: Optional[str],
+            watch_s: float, out=None) -> int:
+    """`datafusion-tpu top [--workers a:1,b:2 | --cluster host:p]
+    [--watch N]`: print the fleet telemetry view once, or every N
+    seconds until interrupted."""
+    import os
+
+    out = out if out is not None else sys.stdout
+    ctx = None
+    cluster = cluster or os.environ.get("DATAFUSION_TPU_CLUSTER")
+    if workers or cluster:
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+
+        addrs = []
+        for addr in (workers or "").split(","):
+            addr = addr.strip()
+            if addr:
+                host, _, port = addr.rpartition(":")
+                addrs.append((host, int(port)))
+        ctx = DistributedContext(addrs, cluster=cluster)
+    try:
+        while True:
+            print(fleet_top_text(ctx), file=out)
+            if not watch_s:
+                return 0
+            print("", file=out)
+            time.sleep(watch_s)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if ctx is not None:
+            ctx.close()
+
+
 class Console:
     """Statement executor (reference `Console`, main.rs:113-153).
 
@@ -118,6 +166,12 @@ class Console:
             # cluster control plane introspection (datafusion_tpu/cluster):
             # membership epoch, live workers + lease ages, shared tier
             self._cluster_status()
+            return True
+        if cmd == "\\top":
+            # fleet telemetry view (obs/aggregate.py): merged latency
+            # percentiles, cache hit rates, SLO burn rates — fleet-wide
+            # on a DistributedContext, local-node otherwise
+            self._print(fleet_top_text(self.ctx))
             return True
         return False
 
@@ -317,6 +371,12 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="tpusql", description="DataFusion-TPU SQL console"
     )
+    parser.add_argument(
+        "mode", nargs="?", choices=["top"],
+        help="'top': print the fleet telemetry view (latency "
+             "percentiles, cache hit rates, SLO burn rates) and exit "
+             "(or repeat with --watch)",
+    )
     parser.add_argument("--script", help="execute commands from file, then exit")
     parser.add_argument(
         "--device", default=None, help="execution device (cpu / tpu; default: auto)"
@@ -326,7 +386,24 @@ def main(argv=None) -> int:
         "--timing", action="store_true",
         help="print per-query engine stage timings (same as \\timing)",
     )
+    parser.add_argument(
+        "--workers", default=None,
+        help="top mode: comma-separated worker addresses host:port to "
+             "aggregate directly (default: discover via --cluster)",
+    )
+    parser.add_argument(
+        "--cluster", default=None,
+        help="top mode: cluster service address (default: env "
+             "DATAFUSION_TPU_CLUSTER)",
+    )
+    parser.add_argument(
+        "--watch", type=float, default=0.0, metavar="SECONDS",
+        help="top mode: refresh every N seconds until interrupted",
+    )
     args = parser.parse_args(argv)
+
+    if args.mode == "top":
+        return run_top(args.workers, args.cluster, args.watch)
 
     print("DataFusion Console")
     console = Console(make_context(args.device, args.batch_size), timing=args.timing)

@@ -32,6 +32,7 @@ import time
 from typing import Optional
 
 from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.obs import recorder
 from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.metrics import METRICS
 
@@ -63,6 +64,18 @@ class WorkerClusterAgent:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    def _telemetry(self) -> Optional[dict]:
+        """The node snapshot piggybacked on each heartbeat (None when
+        the worker state doesn't expose one — bare embedders)."""
+        fn = getattr(self.worker_state, "telemetry_snapshot", None)
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — a broken snapshot must not break the lease
+            METRICS.add("worker.telemetry_snapshot_errors")
+            return None
+
     # -- registration / heartbeat --
     def register(self) -> None:
         granted = self.client.lease_grant(self.ttl_s)
@@ -86,17 +99,20 @@ class WorkerClusterAgent:
         faults.check("cluster.lease.refresh", addr=self.addr)
         if self.lease is None:
             self.register()
-        resp = self.client.lease_refresh(self.lease, since=self.last_rev)
+        resp = self.client.lease_refresh(self.lease, since=self.last_rev,
+                                         telemetry=self._telemetry())
         if not resp.get("found"):
             # lease lapsed out from under us (expiry, service restart):
             # we may have missed invalidations, so the cache is suspect
             self.reregistrations += 1
             METRICS.add("worker.cluster_reregistered")
+            recorder.record("lease.reregistered", addr=self.addr)
             cache = self.worker_state.fragment_cache
             if cache is not None:
                 cache.clear()
             self.register()
-            resp = self.client.lease_refresh(self.lease, since=self.last_rev)
+            resp = self.client.lease_refresh(self.lease, since=self.last_rev,
+                                             telemetry=self._telemetry())
         self._lease_refreshed = time.monotonic()
         self.epoch = resp.get("epoch", self.epoch)
         new_term = int(resp.get("term", self.term))
@@ -104,6 +120,8 @@ class WorkerClusterAgent:
             # the control plane failed over under us; the lease
             # survived (the new primary re-armed it) — just record it
             METRICS.add("worker.cluster_term_changes")
+            recorder.record("cluster.term_change", addr=self.addr,
+                            old_term=self.term, new_term=new_term)
         self.term = max(self.term, new_term)
         if resp.get("rev", self.last_rev) < self.last_rev:
             # the service's revision counter went BACKWARDS: a failover

@@ -128,10 +128,15 @@ class _ClientApi:
     def lease_grant(self, ttl_s: float) -> dict:
         return self.request({"type": "lease_grant", "ttl_s": ttl_s})
 
-    def lease_refresh(self, lease: str, since: Optional[int] = None) -> dict:
+    def lease_refresh(self, lease: str, since: Optional[int] = None,
+                      telemetry: Optional[dict] = None) -> dict:
         msg: dict = {"type": "lease_refresh", "lease": lease}
         if since is not None:
             msg["since"] = since
+        if telemetry is not None:
+            # worker node snapshot piggybacked on the heartbeat
+            # (obs/aggregate.py; served back via `telemetry()`)
+            msg["telemetry"] = telemetry
         return self.request(msg)
 
     def lease_revoke(self, lease: str) -> bool:
@@ -154,6 +159,12 @@ class _ClientApi:
 
     def membership(self) -> dict:
         return self.request({"type": "membership"})
+
+    def telemetry(self) -> dict:
+        """Latest heartbeat-piggybacked node snapshot per live worker
+        ({"workers": {addr: snapshot}}) — ONE round trip feeds the
+        coordinator's whole fleet aggregation."""
+        return self.request({"type": "telemetry"})
 
     def events_since(self, since: int) -> dict:
         return self.request({"type": "events", "since": since})

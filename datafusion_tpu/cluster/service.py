@@ -58,6 +58,7 @@ from typing import Any, Optional
 
 from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.cache.store import CacheStore
+from datafusion_tpu.obs import recorder
 from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.metrics import METRICS
 
@@ -116,6 +117,12 @@ class ClusterState:
         # wakeup predicate is one comparison, not a log scan
         self._last_client_rev = 0
         self.started = time.time()
+        # latest telemetry snapshot per worker (obs/aggregate.py node
+        # snapshots piggybacked on lease refreshes).  Deliberately
+        # EPHEMERAL: not replicated, not evented — after a failover the
+        # map refills within one heartbeat interval, which is exactly
+        # the staleness the data had anyway
+        self._telemetry: dict[str, dict] = {}
         # the shared result tier: raw numpy snapshots, tagged by the
         # tables they scanned so invalidate(table) drops exactly them
         self.results = CacheStore(
@@ -127,7 +134,22 @@ class ClusterState:
         self._rev += 1
         return self._rev
 
+    _FLIGHT_KINDS = frozenset((
+        "join", "leave", "invalidate", "lease_gone", "promoted",
+    ))
+
     def _append_event(self, kind: str, **payload) -> int:
+        if kind in self._FLIGHT_KINDS:
+            # lease/membership churn lands in the flight recorder (the
+            # emit path is lock-free, so recording under self._lock
+            # introduces no lock-order edge); scalar payload fields win
+            # over the ambient term (the "promoted" event carries its own)
+            attrs = {"term": self.term}
+            attrs.update(
+                (k, v) for k, v in payload.items()
+                if isinstance(v, (str, int, float, bool))
+            )
+            recorder.record(f"cluster.{kind}", **attrs)
         rev = self._next_rev()
         self._events.append(
             {"rev": rev, "kind": kind, "term": self.term, **payload}
@@ -158,6 +180,7 @@ class ClusterState:
                 lease.keys.discard(key)
         if self._is_member_key(key):
             self._epoch += 1
+            self._telemetry.pop(key.split("/", 1)[1], None)
             self._append_event(
                 "leave", key=key, addr=key.split("/", 1)[1], reason=reason
             )
@@ -195,9 +218,13 @@ class ClusterState:
                     "rev": self._rev, "term": self.term}
 
     def lease_refresh(self, lease_id: str, since: Optional[int] = None,
-                      now: Optional[float] = None) -> dict:
+                      now: Optional[float] = None,
+                      telemetry: Optional[dict] = None) -> dict:
         """Renew a lease; one round trip also returns the epoch and the
-        event-log tail past `since` (the worker-heartbeat piggyback)."""
+        event-log tail past `since` (the worker-heartbeat piggyback),
+        and accepts the worker's `telemetry` node snapshot — the same
+        heartbeat that keeps the lease alive feeds the coordinator-side
+        fleet aggregation, zero extra round trips."""
         now = time.monotonic() if now is None else now
         with self._lock:
             self._expire(now)
@@ -210,11 +237,28 @@ class ClusterState:
                 entry = self._kv.get(key)
                 if entry is not None:
                     entry.refreshed = now
+                if telemetry is not None and self._is_member_key(key):
+                    self._telemetry[key.split("/", 1)[1]] = telemetry
             out: dict = {"found": True, "epoch": self._epoch,
                          "rev": self._rev, "term": self.term}
             if since is not None:
                 out.update(self._events_since(since, CLIENT_EVENT_KINDS))
             return out
+
+    def telemetry(self, now: Optional[float] = None) -> dict:
+        """Latest piggybacked node snapshot per live worker (a worker
+        whose membership key is gone drops out with it)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            live = {
+                k.split("/", 1)[1]
+                for k in self._kv if self._is_member_key(k)
+            }
+            return {
+                addr: snap for addr, snap in self._telemetry.items()
+                if addr in live
+            }
 
     def lease_revoke(self, lease_id: str, now: Optional[float] = None) -> bool:
         """Explicit deregistration: drop the lease and its keys NOW
@@ -552,6 +596,7 @@ class ClusterState:
                 "cluster.members": sum(
                     1 for k in self._kv if self._is_member_key(k)
                 ),
+                "cluster.telemetry_nodes": len(self._telemetry),
             }
         out.update(self.results.gauges())
         return out
@@ -617,7 +662,8 @@ def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
         out = state.lease_grant(float(msg["ttl_s"]))
         return {"type": "lease", **out}
     if kind == "lease_refresh":
-        out = state.lease_refresh(msg["lease"], since=msg.get("since"))
+        out = state.lease_refresh(msg["lease"], since=msg.get("since"),
+                                  telemetry=msg.get("telemetry"))
         return {"type": "lease", **out}
     if kind == "lease_revoke":
         return {"type": "ok", "found": state.lease_revoke(msg["lease"])}
@@ -654,6 +700,8 @@ def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
             out["value"] = _encode_result_value(value, bw) if bw is not None \
                 else value
         return out
+    if kind == "telemetry":
+        return {"type": "telemetry", "workers": state.telemetry()}
     if kind == "status":
         return state.status()
     return {"type": "error", "message": f"unknown request {kind!r}"}

@@ -49,11 +49,19 @@ from datafusion_tpu.plan.logical import (
     Sort,
     TableScan,
 )
+from datafusion_tpu.obs import recorder
 from datafusion_tpu.sql import ast
 from datafusion_tpu.sql.optimizer import push_down_projection
 from datafusion_tpu.sql.parser import parse_sql
 from datafusion_tpu.sql.planner import SqlToRel, convert_data_type
 from datafusion_tpu.utils.metrics import METRICS
+
+# admission/backpressure counter contract for the serving path
+# (ROADMAP item 2): `queries_admitted` counts here and now (every root
+# query that enters execute); `queries_queued`/`queries_shed` are
+# declared stubs the async front door will increment — dashboards and
+# the fleet aggregator bind to these names today.
+METRICS.declare("queries_admitted", "queries_queued", "queries_shed")
 
 
 class _EmptyRelationExec(Relation):
@@ -144,6 +152,10 @@ class ExecutionContext:
         # context must not see each other's in-execute state (a subtree
         # expansion mistaken for a root would mis-wire the cache seam)
         self._execute_tls = threading.local()
+        # root queries on this context feed the fleet telemetry funnel
+        # (latency histogram, SLO watchdog, slow/failed-query capture);
+        # workers' per-fragment contexts flip this off
+        self._telemetry = True
         if device is not None:
             import jax
 
@@ -281,6 +293,7 @@ class ExecutionContext:
         if self._optimize:
             with METRICS.timer("optimize"):
                 plan = push_down_projection(plan)
+        recorder.record("query.plan", plan=type(plan).__name__)
         return plan
 
     def _execute_ddl(self, stmt: ast.SqlCreateExternalTable) -> DdlResult:
@@ -402,9 +415,17 @@ class ExecutionContext:
             return self._execute_plan(plan)
         tls.in_execute = True
         try:
+            # admission boundary: every root query counts here (the
+            # serving path's queue/shed counters join this registry).
+            # Workers' per-fragment contexts don't count — a fragment
+            # is one shard of an already-admitted query, and the fleet
+            # aggregator sums this counter across nodes
+            if self._telemetry:
+                METRICS.add("queries_admitted")
+                recorder.record("query.admit", plan=type(plan).__name__)
             if self._result_cache is None:
                 self._verify(plan)
-                return self._execute_plan(plan)
+                return self._tag_root(self._execute_plan(plan), plan)
             from datafusion_tpu.cache import scan_tables
             from datafusion_tpu.cache.result import (
                 CachedResultRelation,
@@ -417,20 +438,34 @@ class ExecutionContext:
                 # no verify on the warm path: an identical fingerprint
                 # means this exact plan already verified on the miss
                 # that populated the entry — a repeat walk finds nothing
-                return CachedResultRelation(
+                recorder.record("cache.hit", level="result",
+                                fingerprint=fp[:16])
+                return self._tag_root(CachedResultRelation(
                     plan.schema, entry, fp,
                     on_complete=lambda s: self._record_history(fp, s),
                     batch_size=self.batch_size,
-                )
+                ), plan)
+            recorder.record("cache.miss", level="result",
+                            fingerprint=fp[:16])
             self._verify(plan)
             rel = self._execute_plan(plan)
             attach_result_capture(
                 rel, self._result_cache, fp, tags=scan_tables(plan),
                 on_complete=lambda s: self._record_history(fp, s, root=rel),
             )
-            return rel
+            return self._tag_root(rel, plan)
         finally:
             tls.in_execute = False
+
+    def _tag_root(self, rel: Relation, plan: LogicalPlan) -> Relation:
+        """Mark a root relation for the per-query telemetry funnel
+        (`obs/aggregate.query_completed` fires at its materialization
+        boundary).  Workers' per-fragment contexts disable this —
+        their work records as fragment latency on the serve path, not
+        as fleet query latency."""
+        if self._telemetry:
+            rel._telemetry_query = type(plan).__name__
+        return rel
 
     def _verify(self, plan: LogicalPlan) -> None:
         """Static pre-execution verification of a root-level plan
@@ -439,6 +474,7 @@ class ExecutionContext:
 
         if not _averify.verify_enabled():
             return
+        recorder.record("query.verify", plan=type(plan).__name__)
         with METRICS.timer("verify"):
             _averify.check_plan(plan, functions=self.functions)
 

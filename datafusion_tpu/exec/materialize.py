@@ -289,6 +289,7 @@ def collect_columns(relation):
     import time as _time
 
     t0 = _time.perf_counter()
+    query_label = getattr(relation, "_telemetry_query", None)
     schema = relation.schema
     ncols = len(schema)
     parts: list[list[np.ndarray]] = [[] for _ in range(ncols)]
@@ -319,12 +320,23 @@ def collect_columns(relation):
     from collections import deque
 
     pending: deque = deque()
-    for batch in iter_with_mask_prefetch(relation.batches()):
-        pending.append(compact_dispatch(batch))
-        if len(pending) > 1:
+    try:
+        for batch in iter_with_mask_prefetch(relation.batches()):
+            pending.append(compact_dispatch(batch))
+            if len(pending) > 1:
+                consume(pending.popleft())
+        while pending:
             consume(pending.popleft())
-    while pending:
-        consume(pending.popleft())
+    except Exception as e:
+        # failed root query: the telemetry funnel observes the error
+        # (SLO error budget, flight event, auto-captured artifact set)
+        # before the exception continues to the caller unchanged
+        if query_label is not None:
+            _query_telemetry(
+                relation, query_label, _time.perf_counter() - t0,
+                rows=total, error=f"{type(e).__name__}: {e}",
+            )
+        raise
     columns = []
     validity: list[Optional[np.ndarray]] = []
     for i in range(ncols):
@@ -343,7 +355,30 @@ def collect_columns(relation):
     fill = getattr(relation, "_result_cache_fill", None)
     if fill is not None:
         fill(columns, validity, dicts, total, _time.perf_counter() - t0)
+    if query_label is not None:
+        _query_telemetry(relation, query_label,
+                         _time.perf_counter() - t0, rows=total)
     return columns, validity, dicts, total
+
+
+def _query_telemetry(relation, label: str, wall_s: float, rows: int,
+                     error: "Optional[str]" = None) -> None:
+    """Feed one root query's outcome to the telemetry funnel (latency
+    histogram, SLO watchdog, flight recorder, slow/failed-query
+    artifact capture).  The funnel itself never raises."""
+    from datafusion_tpu.obs import trace as obs_trace
+    from datafusion_tpu.obs.aggregate import query_completed
+
+    tc = obs_trace.current_trace()
+    query_completed(
+        wall_s, rows=rows,
+        # EXPLAIN ANALYZE's _RootTap facade forwards the real tree here
+        root=getattr(relation, "_telemetry_root", relation),
+        label=label, error=error,
+        trace_id=None if tc is None else tc.trace_id,
+        # the explain path exports the complete drained span set itself
+        export_otlp=not getattr(relation, "_telemetry_skip_otlp", False),
+    )
 
 
 def collect(relation) -> ResultTable:

@@ -19,11 +19,27 @@ fragment, per worker:
   timelines merged by trace_id) and a Prometheus-style text dump of the
   engine counters (`utils.metrics.METRICS` is the counter backend —
   nothing is double-counted).
+- `obs.recorder` — the always-on query flight recorder: a lock-free
+  bounded ring of trace-correlated lifecycle events on every node,
+  dumped as JSON on demand, on slow/failed queries, and on crash.
+- `obs.otlp` — OTLP/JSON span exporter (file or HTTP, stdlib-only):
+  coordinator + worker spans stitch into one distributed trace any
+  OpenTelemetry backend renders.
+- `obs.aggregate` — per-node latency histograms merged into fleet-wide
+  p50/p95/p99 views by the coordinator (worker snapshots piggyback on
+  cluster heartbeats); renders as Prometheus gauges and the
+  `datafusion-tpu top` view.
+- `obs.slo` — SLO watchdog: declared latency/error objectives over
+  sliding windows, burn-rate gauges, flight-recorder dump on breach.
 
 Env knobs: `DATAFUSION_TPU_TRACE=1` enables span collection engine-wide;
 `DATAFUSION_TPU_TRACE_FILE=path.json` additionally writes a Chrome trace
 at process exit; `DATAFUSION_TPU_TRACE_BUF` bounds the in-memory span
 buffer (default 100000; overflow counts in `obs.spans_dropped`).
+Flight recorder: `DATAFUSION_TPU_FLIGHT[_BUF|_SLOW_S|_DIR|...]`
+(obs/recorder.py).  OTLP: `DATAFUSION_TPU_OTLP_FILE` /
+`DATAFUSION_TPU_OTLP_ENDPOINT`.  SLOs: `DATAFUSION_TPU_SLO_*`
+(obs/slo.py).
 """
 
 from datafusion_tpu.obs.trace import (  # noqa: F401 — public API surface

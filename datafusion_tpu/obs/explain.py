@@ -147,6 +147,16 @@ class ExplainAnalyzeResult:
 
         return write_chrome_trace(path, self.spans)
 
+    def otlp(self) -> dict:
+        from datafusion_tpu.obs.otlp import spans_to_otlp
+
+        return spans_to_otlp(self.spans)
+
+    def write_otlp(self, path: str) -> str:
+        from datafusion_tpu.obs.otlp import write_otlp
+
+        return write_otlp(path, self.spans)
+
     def __repr__(self):
         return self.report()
 
@@ -163,6 +173,21 @@ class _RootTap:
         fill = getattr(rel, "_result_cache_fill", None)
         if fill is not None:
             self._result_cache_fill = fill
+        # forward the telemetry markers too: an analyzed query is still
+        # a query — it feeds the same latency histogram / SLO funnel
+        label = getattr(rel, "_telemetry_query", None)
+        if label is not None:
+            self._telemetry_query = label
+            # the funnel's operator-report walk needs the real tree,
+            # not this facade
+            self._telemetry_root = rel
+            # explain_analyze exports the COMPLETE drained span set
+            # after the run; the funnel's in-flight export would ship
+            # an overlapping document missing only the root span
+            self._telemetry_skip_otlp = True
+        dumps = getattr(rel, "collect_flight_dumps", None)
+        if dumps is not None:
+            self.collect_flight_dumps = dumps
 
     @property
     def schema(self):
@@ -200,6 +225,11 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
     )
     spans = trace.drain(tc.trace_id)
     spans.sort(key=lambda s: s["start_ns"])
+    # env-gated OTLP push of the COMPLETE span set (the in-flight
+    # export at the materialization boundary misses the root span)
+    from datafusion_tpu.obs.otlp import export_spans
+
+    export_spans(spans)
     return ExplainAnalyzeResult(
         plan, rel, table, spans, tc.trace_id, wall, counters
     )

@@ -17,7 +17,7 @@ from typing import Optional
 
 from datafusion_tpu.utils.metrics import METRICS
 
-_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]+")
 
 
 def chrome_trace(spans: list[dict]) -> dict:
@@ -65,7 +65,27 @@ def write_chrome_trace(path: str, spans: list[dict]) -> str:
 
 
 def _metric_name(name: str) -> str:
-    return _NAME_RE.sub("_", name)
+    """Sanitize a string into a legal Prometheus metric IDENTIFIER
+    (`[a-zA-Z_:][a-zA-Z0-9_:]*`): runs of illegal characters collapse
+    to one underscore (so `a.b` and `a-b` stay distinguishable from a
+    literal `a_b` only via labels — identifiers genuinely cannot carry
+    dots), and a leading digit gains a `_` prefix.  Only for names
+    used AS identifiers; label values go through `_label_value`, which
+    preserves the original spelling."""
+    out = _NAME_RE.sub("_", name) or "_"
+    if out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _label_value(value: str) -> str:
+    """Escape a label VALUE per the exposition format (backslash,
+    double-quote, newline).  Label values are free-form UTF-8 — dotted
+    engine metric names (`cache.result.hits`) pass through verbatim
+    instead of being flattened to underscores, so two counters that
+    differ only in punctuation can no longer collide in a scrape."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
 
 
 def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
@@ -74,7 +94,9 @@ def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
     Timings render as `datafusion_tpu_timing_seconds_total{stage=...}`,
     counters as `datafusion_tpu_events_total{name=...}`; `extra_gauges`
     ({name: value}) lets callers add point-in-time gauges (queue depths,
-    buffered spans) without minting a second registry.
+    buffered spans) without minting a second registry.  Engine metric
+    names land in label values with their dots intact (see
+    `_label_value`).
     """
     snap = (metrics if metrics is not None else METRICS).snapshot()
     lines = [
@@ -84,7 +106,7 @@ def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
     ]
     for k in sorted(snap["timings_s"]):
         lines.append(
-            f'datafusion_tpu_timing_seconds_total{{stage="{_metric_name(k)}"}} '
+            f'datafusion_tpu_timing_seconds_total{{stage="{_label_value(k)}"}} '
             f"{snap['timings_s'][k]:.9f}"
         )
     lines += [
@@ -93,7 +115,7 @@ def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
     ]
     for k in sorted(snap["counts"]):
         lines.append(
-            f'datafusion_tpu_events_total{{name="{_metric_name(k)}"}} '
+            f'datafusion_tpu_events_total{{name="{_label_value(k)}"}} '
             f"{snap['counts'][k]}"
         )
     gauges = dict(snap.get("gauges") or {})
@@ -103,7 +125,7 @@ def prometheus_text(metrics=None, extra_gauges: Optional[dict] = None) -> str:
         lines.append("# TYPE datafusion_tpu_gauge gauge")
         for k in sorted(gauges):
             lines.append(
-                f'datafusion_tpu_gauge{{name="{_metric_name(k)}"}} '
+                f'datafusion_tpu_gauge{{name="{_label_value(k)}"}} '
                 f"{gauges[k]}"
             )
     return "\n".join(lines) + "\n"

@@ -1,0 +1,191 @@
+"""OTLP/JSON span exporter (stdlib-only).
+
+Converts the engine's span dicts (obs/trace.py — local or
+worker-ingested, any mix) into the OpenTelemetry OTLP/JSON trace
+format (``ExportTraceServiceRequest``): each distinct span ``proc``
+becomes one ``resourceSpans`` entry whose resource carries
+``service.name`` (the role) and ``service.instance.id`` (role:pid), so
+coordinator and worker spans stitch into ONE distributed trace that
+any OTLP-compatible backend (Jaeger, Tempo, an OpenTelemetry
+collector) renders with per-node lanes — the vendor-neutral sibling of
+the Chrome-trace exporter.
+
+Export targets (both stdlib-only, both optional):
+
+- ``write_otlp(path, spans)`` — a JSON file;
+- ``post_otlp(endpoint, spans)`` — HTTP POST of the JSON document
+  (``urllib.request``; the conventional collector path is
+  ``http://host:4318/v1/traces``).
+
+``export_spans(spans)`` routes to whichever of
+``DATAFUSION_TPU_OTLP_FILE`` / ``DATAFUSION_TPU_OTLP_ENDPOINT`` is
+set.  ``otlp_to_spans`` is the exact inverse of ``spans_to_otlp`` —
+the schema round-trip the test suite locks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from datafusion_tpu.utils.metrics import METRICS
+
+_SCOPE = {"name": "datafusion_tpu", "version": "1"}
+# OTLP ids are fixed-width lowercase hex: 16 bytes trace, 8 bytes span.
+# The engine mints 8-byte (16-hex) ids for both; trace ids zero-pad.
+_TRACE_ID_HEX = 32
+_SPAN_ID_HEX = 16
+
+
+def _pad_id(raw: Optional[str], width: int) -> str:
+    s = "".join(c for c in str(raw or "") if c in "0123456789abcdef")
+    return s[:width].rjust(width, "0")
+
+
+def _attr_value(v) -> dict:
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # OTLP/JSON carries int64 as string
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def _attr_list(attrs: dict) -> list[dict]:
+    return [{"key": str(k), "value": _attr_value(v)}
+            for k, v in attrs.items()]
+
+
+def _attr_dict(kvs) -> dict:
+    out = {}
+    for kv in kvs or ():
+        val = kv.get("value") or {}
+        if "boolValue" in val:
+            v = bool(val["boolValue"])
+        elif "intValue" in val:
+            v = int(val["intValue"])
+        elif "doubleValue" in val:
+            v = float(val["doubleValue"])
+        else:
+            v = val.get("stringValue", "")
+        out[kv.get("key", "")] = v
+    return out
+
+
+def spans_to_otlp(span_dicts: list[dict]) -> dict:
+    """Span dicts -> OTLP/JSON ExportTraceServiceRequest."""
+    by_proc: dict[str, list[dict]] = {}
+    for sp in span_dicts:
+        by_proc.setdefault(str(sp.get("proc", "?")), []).append(sp)
+    resource_spans = []
+    for proc in sorted(by_proc):
+        role = proc.split(":", 1)[0]
+        otlp_spans = []
+        for sp in by_proc[proc]:
+            out = {
+                "traceId": _pad_id(sp.get("trace_id"), _TRACE_ID_HEX),
+                "spanId": _pad_id(sp.get("span_id"), _SPAN_ID_HEX),
+                "name": sp.get("name", "?"),
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(int(sp.get("start_ns", 0))),
+                "endTimeUnixNano": str(int(sp.get("end_ns", 0))),
+            }
+            if sp.get("parent_id"):
+                out["parentSpanId"] = _pad_id(sp["parent_id"], _SPAN_ID_HEX)
+            attrs = dict(sp.get("attrs") or {})
+            # thread id survives as an attribute (OTLP has no tid slot)
+            if sp.get("tid"):
+                attrs["thread.id"] = int(sp["tid"])
+            if attrs:
+                out["attributes"] = _attr_list(attrs)
+            otlp_spans.append(out)
+        resource_spans.append({
+            "resource": {"attributes": _attr_list({
+                "service.name": f"datafusion_tpu.{role}",
+                "service.instance.id": proc,
+            })},
+            "scopeSpans": [{"scope": dict(_SCOPE), "spans": otlp_spans}],
+        })
+    return {"resourceSpans": resource_spans}
+
+
+def otlp_to_spans(doc: dict) -> list[dict]:
+    """Inverse of ``spans_to_otlp`` (modulo trace-id zero-padding —
+    ids come back in OTLP's canonical width)."""
+    out = []
+    for rs in doc.get("resourceSpans", ()):
+        res_attrs = _attr_dict((rs.get("resource") or {}).get("attributes"))
+        proc = str(res_attrs.get("service.instance.id", "?"))
+        for ss in rs.get("scopeSpans", ()):
+            for sp in ss.get("spans", ()):
+                attrs = _attr_dict(sp.get("attributes"))
+                tid = int(attrs.pop("thread.id", 0))
+                out.append({
+                    "name": sp.get("name", "?"),
+                    "trace_id": sp.get("traceId", ""),
+                    "span_id": sp.get("spanId", ""),
+                    "parent_id": sp.get("parentSpanId") or None,
+                    "start_ns": int(sp.get("startTimeUnixNano", 0)),
+                    "end_ns": int(sp.get("endTimeUnixNano", 0)),
+                    "attrs": attrs,
+                    "tid": tid,
+                    "proc": proc,
+                })
+    return out
+
+
+def write_otlp(path: str, span_dicts: list[dict]) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(spans_to_otlp(span_dicts), f)
+    METRICS.add("obs.otlp_exported", len(span_dicts))
+    return path
+
+
+def post_otlp(endpoint: str, span_dicts: list[dict],
+              timeout_s: float = 5.0) -> int:
+    """POST the OTLP/JSON document to an HTTP endpoint; returns the
+    response status.  Raises on transport errors — callers on query
+    paths go through ``export_spans``, which never does."""
+    import urllib.request
+
+    body = json.dumps(spans_to_otlp(span_dicts)).encode("utf-8")
+    req = urllib.request.Request(
+        endpoint, data=body, method="POST",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:  # noqa: S310 — operator-configured endpoint
+        status = int(getattr(resp, "status", 200))
+    METRICS.add("obs.otlp_exported", len(span_dicts))
+    return status
+
+
+def export_spans(span_dicts: list[dict]) -> Optional[str]:
+    """Best-effort export to the env-configured OTLP target(s):
+    ``DATAFUSION_TPU_OTLP_FILE`` appends one JSON document per line
+    (a long-lived worker's successive exports stay parseable);
+    ``DATAFUSION_TPU_OTLP_ENDPOINT`` POSTs.  Returns a description of
+    where the spans went, or None when no target is configured or the
+    export failed (counted, never raised — span export must not fail
+    the query that produced the spans)."""
+    if not span_dicts:
+        return None
+    where = []
+    path = os.environ.get("DATAFUSION_TPU_OTLP_FILE")
+    endpoint = os.environ.get("DATAFUSION_TPU_OTLP_ENDPOINT")
+    if not path and not endpoint:
+        return None
+    try:
+        if path:
+            with open(path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(spans_to_otlp(span_dicts)) + "\n")
+            METRICS.add("obs.otlp_exported", len(span_dicts))
+            where.append(path)
+        if endpoint:
+            post_otlp(endpoint, span_dicts)
+            where.append(endpoint)
+    except Exception:  # noqa: BLE001 — export is best-effort by contract
+        METRICS.add("obs.otlp_errors")
+        return None
+    return ", ".join(where)

@@ -1,0 +1,336 @@
+"""Always-on query flight recorder: a lock-free bounded ring buffer of
+trace-correlated structured events.
+
+The span tracer (obs/trace.py) answers "where did THIS query's time
+go" when you asked in advance; the flight recorder answers "what was
+the engine doing just before things went wrong" when you didn't.
+Every node — coordinator, worker, cluster service — records lifecycle
+events (query admit/plan/verify/dispatch/launch/merge, cache hit/miss,
+retry, failover, lease churn) into a fixed-size ring on every query,
+always, and the ring is dumpable as JSON:
+
+- on demand (``dump()``, the worker ``{"type": "flight_dump"}``
+  request);
+- automatically on slow queries — a query whose materialization wall
+  time crosses ``DATAFUSION_TPU_FLIGHT_SLOW_S`` captures a correlated
+  artifact set (ring dump + span tree as a stitched OTLP document +
+  the EXPLAIN ANALYZE operator report) with no prior configuration;
+- automatically on a failed query and on process crash (a chained
+  ``sys.excepthook``).
+
+Cost model: the emit path is LOCK-FREE — one module-flag read, one
+``itertools.count`` bump (atomic under the GIL; the C-implemented
+iterator never releases it mid-``next``), one list-slot store.  No
+lock, no allocation beyond the event tuple, no syscalls.  This is the
+property that makes "always on" honest: emit rides inside other
+subsystems' critical sections (the cluster service records lease churn
+while holding its state lock; METRICS callbacks record launches inside
+device dispatch) and must never introduce a lock-order edge — lint
+rule DF005 and the lockcheck soak enforce it.  Concurrent writers may
+interleave slot writes arbitrarily; a reader takes an atomic snapshot
+of the slot list and tolerates torn ordering (events carry their own
+nanosecond timestamps).
+
+Env knobs: ``DATAFUSION_TPU_FLIGHT`` (default on; ``0`` disables and
+restores the zero-cost no-op), ``DATAFUSION_TPU_FLIGHT_BUF`` (ring
+capacity, default 8192), ``DATAFUSION_TPU_FLIGHT_SLOW_S`` (slow-query
+threshold seconds, default 10), ``DATAFUSION_TPU_FLIGHT_DIR`` (dump
+directory, default ``$TMPDIR/datafusion_tpu_flight``),
+``DATAFUSION_TPU_FLIGHT_DUMP_INTERVAL_S`` (auto-dump throttle, default
+30 — a failure storm produces one artifact per interval, not one per
+failure).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from datafusion_tpu.obs.trace import _current_trace
+
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("0", "false", "off", "no")
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    v = os.environ.get(name, "").lower()
+    if v in _TRUTHY:
+        return True
+    if v in _FALSY:
+        return False
+    return default
+
+
+_ENABLED = _env_flag("DATAFUSION_TPU_FLIGHT", True)
+_CAP = max(int(os.environ.get("DATAFUSION_TPU_FLIGHT_BUF", "8192") or 8192), 8)
+_SLOW_S = float(os.environ.get("DATAFUSION_TPU_FLIGHT_SLOW_S", "10") or 10)
+_DIR = os.environ.get("DATAFUSION_TPU_FLIGHT_DIR") or os.path.join(
+    tempfile.gettempdir(), "datafusion_tpu_flight"
+)
+_DUMP_INTERVAL_S = float(
+    os.environ.get("DATAFUSION_TPU_FLIGHT_DUMP_INTERVAL_S", "30") or 30
+)
+
+# the ring: a preallocated slot list plus a monotonically increasing
+# cursor.  Slot i%cap holds the i'th event ever emitted; the cursor
+# value doubles as the total-events-emitted counter.  Slots and
+# capacity live in ONE tuple so a resize swaps both with a single
+# atomic store — an emitter that read the tuple just before the swap
+# indexes the OLD list with the OLD capacity, never a mix (a stale
+# larger cap against a fresh smaller list would IndexError the
+# lock-free emit path).
+_ring: tuple[list, int] = ([None] * _CAP, _CAP)
+_cursor = itertools.count()
+_last_auto_dump = 0.0  # time.monotonic of the last throttled auto dump
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def slow_threshold_s() -> float:
+    """Queries whose wall time crosses this auto-capture an artifact."""
+    return _SLOW_S
+
+
+def dump_dir() -> str:
+    return _DIR
+
+
+def configure(enabled: Optional[bool] = None,
+              capacity: Optional[int] = None,
+              slow_s: Optional[float] = None,
+              directory: Optional[str] = None,
+              dump_interval_s: Optional[float] = None) -> None:
+    """Test/embedding override of the env-derived knobs.  Resizing the
+    ring clears it (slot arithmetic is modulo the capacity)."""
+    global _ENABLED, _CAP, _SLOW_S, _DIR, _DUMP_INTERVAL_S
+    global _ring, _cursor, _last_auto_dump
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if capacity is not None and capacity != _CAP:
+        _CAP = max(int(capacity), 8)
+        _cursor = itertools.count()
+        _ring = ([None] * _CAP, _CAP)  # one atomic swap (see above)
+    if slow_s is not None:
+        _SLOW_S = float(slow_s)
+    if directory is not None:
+        _DIR = directory
+    if dump_interval_s is not None:
+        _DUMP_INTERVAL_S = float(dump_interval_s)
+        _last_auto_dump = 0.0
+
+
+def clear() -> None:
+    """Drop every buffered event (tests; the ring never needs this in
+    production — old events age out by wraparound)."""
+    global _ring, _cursor
+    _cursor = itertools.count()
+    _ring = ([None] * _CAP, _CAP)
+
+
+def record(kind: str, **attrs: Any) -> None:
+    """Emit one flight event.  LOCK-FREE hot path (see module doc):
+    flag read, contextvar read for trace correlation, counter bump,
+    slot store.  ``attrs`` must be JSON-representable scalars."""
+    if not _ENABLED:
+        return
+    tc = _current_trace.get()
+    slots, cap = _ring  # one read: list and capacity always match
+    i = next(_cursor)
+    slots[i % cap] = (
+        time.time_ns(),
+        kind,
+        None if tc is None else tc.trace_id,
+        threading.get_ident(),
+        attrs or None,
+    )
+
+
+def emitted() -> int:
+    """Total events ever emitted (wraparound does not reset this —
+    ``emitted() - len(events())`` is the number aged out)."""
+    # peek without consuming: count.__reduce__ exposes the next value
+    return _cursor.__reduce__()[1][0]
+
+
+def events(trace_id: Optional[str] = None) -> list[dict]:
+    """Snapshot of the ring as event dicts, oldest first.  Tolerates
+    concurrent emit: the slot list is copied atomically and each event
+    carries its own timestamp; a torn snapshot can at worst miss or
+    double-see events still being overwritten at the wrap boundary."""
+    slots, cap = _ring
+    snap = list(slots)
+    n = emitted()
+    if n >= cap:
+        # ring has wrapped: slot (n % cap) is the oldest surviving slot
+        start = n % cap
+        ordered = snap[start:] + snap[:start]
+    else:
+        ordered = snap[:n]
+    out = []
+    for ev in ordered:
+        if ev is None:
+            continue
+        ts, kind, tid_trace, tid, attrs = ev
+        if trace_id is not None and tid_trace != trace_id:
+            continue
+        d = {"ts_ns": ts, "kind": kind, "tid": tid}
+        if tid_trace is not None:
+            d["trace_id"] = tid_trace
+        if attrs:
+            d["attrs"] = dict(attrs)
+        out.append(d)
+    # defensive ordering: concurrent wrap-boundary writes can land a
+    # newer event before an older one in the copied list
+    out.sort(key=lambda d: d["ts_ns"])
+    return out
+
+
+def _node_label() -> str:
+    from datafusion_tpu.obs.trace import _ROLE
+
+    return f"{_ROLE}:{os.getpid()}"
+
+
+def dump(reason: str, path: Optional[str] = None,
+         extra: Optional[dict] = None) -> str:
+    """Write the ring to a JSON artifact; returns the path.  ``extra``
+    folds caller context (query label, wall time, worker dumps) into
+    the document."""
+    import json
+
+    if path is None:
+        os.makedirs(_DIR, exist_ok=True)
+        path = os.path.join(
+            _DIR, f"flight-{_node_label().replace(':', '-')}-"
+                  f"{time.time_ns()}.json"
+        )
+    doc = {
+        "reason": reason,
+        "node": _node_label(),
+        "recorded_at_ns": time.time_ns(),
+        "events_emitted": emitted(),
+        "events": events(),
+    }
+    if extra:
+        doc.update(extra)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=str)
+    from datafusion_tpu.utils.metrics import METRICS
+
+    METRICS.add("flight.dumps")
+    return path
+
+
+def auto_capture(reason: str, extra_fn: Optional[Callable[[], dict]] = None,
+                 ) -> Optional[str]:
+    """Throttled automatic dump (slow query, failed query, SLO breach):
+    at most one artifact per ``DATAFUSION_TPU_FLIGHT_DUMP_INTERVAL_S``
+    per process, and never raises — observability must not fail the
+    query it observes.  ``extra_fn`` builds the correlated context
+    lazily, only when a dump actually happens."""
+    global _last_auto_dump
+    if not _ENABLED:
+        return None
+    now = time.monotonic()
+    if _DUMP_INTERVAL_S > 0 and now - _last_auto_dump < _DUMP_INTERVAL_S:
+        from datafusion_tpu.utils.metrics import METRICS
+
+        METRICS.add("flight.dumps_throttled")
+        return None
+    _last_auto_dump = now
+    try:
+        extra = extra_fn() if extra_fn is not None else None
+        return dump(reason, extra=extra)
+    except Exception:  # noqa: BLE001 — capture is best-effort by contract
+        from datafusion_tpu.utils.metrics import METRICS
+
+        METRICS.add("flight.dump_errors")
+        return None
+
+
+def capture_query_artifacts(reason: str, *, wall_s: Optional[float] = None,
+                            trace_id: Optional[str] = None,
+                            root=None, label: Optional[str] = None,
+                            error: Optional[str] = None,
+                            node_dumps_fn: Optional[Callable[[], dict]] = None,
+                            ) -> Optional[str]:
+    """The single correlated artifact set for a slow or failed query:
+    this node's flight events, every involved node's events
+    (``node_dumps_fn``: addr -> event list, gathered over the wire by
+    the distributed coordinator — invoked LAZILY, so a throttled
+    capture never touches the network), the query's span tree as a
+    stitched OTLP/JSON trace document, and the EXPLAIN ANALYZE-style
+    operator report when the run was instrumented.  One file, one
+    query, every layer."""
+
+    def _extra() -> dict:
+        from datafusion_tpu.obs import trace as obs_trace
+        from datafusion_tpu.obs.otlp import spans_to_otlp
+
+        spans = obs_trace.spans(trace_id) if trace_id else []
+        extra: dict = {"query": {
+            "label": label,
+            "wall_s": wall_s,
+            "trace_id": trace_id,
+            "error": error,
+        }}
+        if spans:
+            extra["otlp"] = spans_to_otlp(spans)
+        if node_dumps_fn is not None:
+            try:
+                extra["nodes"] = node_dumps_fn()
+            except Exception:  # noqa: BLE001 — survivors' evidence only
+                pass
+        if root is not None:
+            try:
+                from datafusion_tpu.obs.explain import _op_line
+                from datafusion_tpu.obs.stats import collect_tree
+
+                extra["explain"] = [
+                    "  " * depth + _op_line(rel)
+                    for depth, rel in collect_tree(root)
+                ]
+            except Exception:  # noqa: BLE001 — a half-built tree must not block the dump
+                pass
+        return extra
+
+    return auto_capture(reason, _extra)
+
+
+# -- crash hook -------------------------------------------------------
+_prev_excepthook = None
+_hook_installed = False
+
+
+def install_crash_hook() -> None:
+    """Chain a ``sys.excepthook`` that dumps the ring on an unhandled
+    exception (the post-mortem the reference engine never had).
+    Idempotent; KeyboardInterrupt/SystemExit pass through undumped."""
+    global _prev_excepthook, _hook_installed
+    if _hook_installed:
+        return
+    _hook_installed = True
+    _prev_excepthook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        if not issubclass(exc_type, (KeyboardInterrupt, SystemExit)):
+            try:
+                dump("crash", extra={
+                    "error": f"{exc_type.__name__}: {exc}",
+                })
+            except Exception:  # noqa: BLE001 — the hook must reach the original handler
+                pass
+        (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+
+
+if _ENABLED:
+    install_crash_hook()

@@ -53,6 +53,7 @@ from datafusion_tpu.exec.context import ExecutionContext
 from datafusion_tpu.exec.relation import Relation
 from datafusion_tpu.parallel.partition import PartitionedDataSource
 from datafusion_tpu.plan.logical import Aggregate
+from datafusion_tpu.obs import recorder as flight
 from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.parallel.physical import PlanFragment
 from datafusion_tpu.parallel.wire import (
@@ -104,7 +105,15 @@ class WorkerHandle:
             # advertise the protocol version (the CRC handshake): a v2
             # worker answers binary frames with per-segment CRC32s
             msg = {**msg, "wire_version": WIRE_VERSION}
-        with socket.create_connection((self.host, self.port), timeout=10.0) as s:
+        # connect is bounded by the per-call timeout too (capped at
+        # 10s): a scrape-path pull with timeout=2.0 must not spend 10s
+        # in SYN retries against a blackholed worker.  timeout=None
+        # means "wait however long for the RESPONSE" — the connect
+        # itself still gets the 10s cap
+        connect_timeout = 10.0 if timeout is None else min(timeout, 10.0)
+        with socket.create_connection(
+            (self.host, self.port), timeout=connect_timeout
+        ) as s:
             s.settimeout(timeout)
             send_msg(s, msg)
             try:
@@ -153,6 +162,34 @@ class WorkerHandle:
         metrics snapshot (the worker web UI the reference planned,
         delivered over the fragment protocol instead)."""
         return self.request({"type": "status"}, timeout=10.0)
+
+    def telemetry(self) -> Optional[dict]:
+        """The worker's node snapshot for fleet aggregation (None for
+        unreachable/old workers).  The tight timeout bounds what a
+        wedged worker can cost a scrape: `metrics_text` refreshes the
+        fleet inline, and a Prometheus scrape window is ~10s total —
+        one slow node must not consume it all."""
+        try:
+            return self.request(
+                {"type": "telemetry"}, timeout=2.0
+            ).get("snapshot")
+        except (ConnectionError, OSError, ExecutionError):
+            return None
+
+    def flight_dump(self, trace_id: Optional[str] = None) -> Optional[dict]:
+        """The worker's flight-recorder ring (trace-filtered when
+        assembling one query's artifact set); None when unreachable.
+        Tight timeout: the capture runs INLINE at the victim query's
+        materialization boundary (throttled to once per dump interval,
+        so the amortized cost is ~zero, but the one query that pays
+        must pay seconds, not N*10s of a wedged fleet)."""
+        msg: dict = {"type": "flight_dump"}
+        if trace_id:
+            msg["trace_id"] = trace_id
+        try:
+            return self.request(msg, timeout=2.0)
+        except (ConnectionError, OSError, ExecutionError):
+            return None
 
 
 @functools.lru_cache(maxsize=256)
@@ -405,6 +442,8 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 )
                 # worker-side spans parent under THIS dispatch span
                 msg["trace"] = {**trace_wire, "parent_span_id": sp.span_id}
+            flight.record("query.dispatch", shard=frag.shard,
+                          worker=f"{w.host}:{w.port}", attempt=attempts)
             try:
                 faults.check("coord.request", shard=frag.shard)
                 resp = w.request(msg, timeout=timeout)
@@ -429,6 +468,9 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 # not a failover: slow != dead.)
                 w.mark_down()
                 METRICS.add("coord.fragment_reassigned")
+                flight.record("worker.failover", shard=frag.shard,
+                              worker=f"{w.host}:{w.port}",
+                              attempt=attempts)
                 attempts += 1
                 if attempts > len(workers) + _DISPATCH_PROBE_ROUNDS:
                     raise ExecutionError(
@@ -468,6 +510,25 @@ def _check_fragment_plan(plan: LogicalPlan) -> None:
     if not report.ok:
         METRICS.add("coord.plan_rejected")
         report.raise_if_failed()
+
+
+def _collect_worker_flight_dumps(workers: list[WorkerHandle],
+                                 trace_id: Optional[str]) -> dict:
+    """One query's flight events from every reachable worker (addr ->
+    {events, events_emitted}) — the "all involved nodes" half of the
+    correlated artifact set a slow or failed distributed query
+    captures.  Unreachable workers are skipped, not fatal: a capture
+    triggered BY a worker death must still ship the survivors'
+    evidence."""
+    out: dict = {}
+    for w in workers:
+        dump = w.flight_dump(trace_id)
+        if dump is not None:
+            out[f"{w.host}:{w.port}"] = {
+                "events": dump.get("events", []),
+                "events_emitted": dump.get("events_emitted"),
+            }
+    return out
 
 
 def _iter_unique_responses(responses):
@@ -511,6 +572,9 @@ class DistributedAggregateRelation(Relation):
         self.workers = workers
         self.in_schema = in_schema
         self.query_deadline_s = query_deadline_s
+
+    def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
+        return _collect_worker_flight_dumps(self.workers, trace_id)
 
     @property
     def schema(self) -> Schema:
@@ -633,6 +697,8 @@ class DistributedAggregateRelation(Relation):
                         ):
                             bl[gi] = s
 
+        flight.record("query.merge", partitions=len(self.ds.partitions),
+                      groups=int(len(counts)))
         # convert best strings to coordinator dictionary codes so the
         # standard finalize path decodes them
         for i, bl in best_str.items():
@@ -658,6 +724,9 @@ class DistributedUnionRelation(Relation):
         self.workers = workers
         self._schema = plan.schema
         self.query_deadline_s = query_deadline_s
+
+    def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
+        return _collect_worker_flight_dumps(self.workers, trace_id)
 
     @property
     def schema(self) -> Schema:
@@ -689,6 +758,8 @@ class DistributedUnionRelation(Relation):
             StringDictionary() if f.data_type == DataType.UTF8 else None
             for f in self._schema.fields
         ]
+        flight.record("query.merge", partitions=n,
+                      responses=len(responses))
         for _frag, resp in _iter_unique_responses(responses):
             if resp["num_rows"] == 0:
                 continue
@@ -812,6 +883,14 @@ class DistributedContext(ExecutionContext):
                 self._shared_tier = SharedResultTier(self.cluster)
                 self._result_cache.shared = self._shared_tier
         self._request_timeout = request_timeout
+        # fleet telemetry aggregation (obs/aggregate.py): latest node
+        # snapshot per worker, merged into fleet p50/p95/p99 latency,
+        # cache hit rates, launches-per-pass — refreshed on scrape
+        # (`metrics_text`) and on the `top` view, pulled from the
+        # cluster service (heartbeat piggyback) or the workers directly
+        from datafusion_tpu.obs.aggregate import FleetAggregator
+
+        self.telemetry = FleetAggregator()
         from datafusion_tpu.analysis import lockcheck
 
         self._workers_lock = lockcheck.make_lock("coord.workers")
@@ -985,14 +1064,61 @@ class DistributedContext(ExecutionContext):
                 # the registration that already succeeded locally
                 METRICS.add("coord.invalidation_broadcast_errors")
 
+    def fleet_refresh(self) -> int:
+        """Pull the latest worker telemetry snapshots into the
+        aggregator: in cluster mode ONE service round trip returns the
+        snapshots every worker piggybacked on its lease heartbeat; off
+        cluster, one `telemetry` request per live worker.  Returns the
+        number of worker snapshots held."""
+        n = 0
+        if self.cluster is not None:
+            try:
+                snaps = self.cluster.telemetry().get("workers", {})
+            except (ConnectionError, OSError, ExecutionError):
+                METRICS.add("coord.telemetry_refresh_errors")
+                snaps = {}
+            for addr, snap in snaps.items():
+                self.telemetry.ingest(addr, snap)
+                n += 1
+        else:
+            for w in list(self.workers):
+                if not w.alive:
+                    continue
+                snap = w.telemetry()
+                if snap is not None:
+                    self.telemetry.ingest(f"{w.host}:{w.port}", snap)
+                    n += 1
+        return n
+
+    def fleet_gauges(self) -> dict:
+        """Fleet-aggregated gauges (freshly refreshed) plus SLO burn
+        rates — the extra_gauges block every scrape path folds in."""
+        from datafusion_tpu.obs import slo
+
+        self.fleet_refresh()
+        gauges = self.telemetry.gauges()
+        if slo.WATCHDOG.armed():
+            slo.WATCHDOG.evaluate()  # refreshes the slo.* METRICS gauges
+        return gauges
+
+    def top_text(self) -> str:
+        """The `datafusion-tpu top` operator view: fleet summary, one
+        row per node, SLO burn-rate table."""
+        from datafusion_tpu.obs import slo
+
+        self.fleet_refresh()
+        rows = slo.WATCHDOG.evaluate() if slo.WATCHDOG.armed() else None
+        return self.telemetry.top_text(slo_rows=rows)
+
     def metrics_text(self) -> str:
-        """Prometheus text with the cluster gauges folded in (epoch,
-        live workers, watch lag) when cluster mode is on."""
-        if self.membership is None:
-            return super().metrics_text()
+        """Prometheus text with the fleet-aggregated telemetry gauges
+        (and, in cluster mode, the membership gauges) folded in."""
         from datafusion_tpu.obs.export import prometheus_text
 
-        return prometheus_text(METRICS, extra_gauges=self.membership.gauges())
+        gauges = self.fleet_gauges()
+        if self.membership is not None:
+            gauges.update(self.membership.gauges())
+        return prometheus_text(METRICS, extra_gauges=gauges)
 
     def _execute_plan(self, plan: LogicalPlan) -> Relation:
         # unlike the single-host mesh matcher this one keeps Utf8

@@ -22,6 +22,7 @@ Responses: {"type": "pong", ...} / {"type": "status", ...} /
 
 from __future__ import annotations
 
+import os
 import socket
 import socketserver
 import threading
@@ -196,6 +197,10 @@ class WorkerState:
                 if self.cluster_agent is None
                 else self.cluster_agent.snapshot()
             ),
+            # the fleet-aggregation payload: latency histograms +
+            # counter/gauge registries (obs/aggregate.py) — the same
+            # snapshot the cluster heartbeat piggybacks
+            "telemetry": self.telemetry_snapshot(),
             "metrics": {
                 "timings_s": {
                     k: round(v, 3) for k, v in snap["timings_s"].items()
@@ -206,6 +211,16 @@ class WorkerState:
                 METRICS, extra_gauges=self._gauges()
             ),
         }
+
+    def telemetry_snapshot(self) -> dict:
+        """This worker's node snapshot for fleet aggregation, with the
+        cluster gauges (lease age, term, epoch) folded in so the
+        coordinator's top view renders them per node."""
+        from datafusion_tpu.obs.aggregate import node_snapshot
+
+        snap = node_snapshot()
+        snap["gauges"].update(self._gauges())
+        return snap
 
     def _relation(self, frag: PlanFragment):
         plan = frag.logical_plan()
@@ -226,6 +241,10 @@ class WorkerState:
         # and fragment-level caching happens one layer up anyway
         ctx = ExecutionContext(device=self.device, batch_size=self.batch_size,
                                result_cache=False)
+        # fragments are not fleet queries: their latency records on the
+        # serve path below (fragment.latency histogram), not in the
+        # coordinator-facing query funnel
+        ctx._telemetry = False
         ctx.register_datasource(scan.table_name, ds)
         return ctx.execute(plan), plan
 
@@ -236,6 +255,11 @@ class WorkerState:
         cached serve does no partition scan, so injected execution
         faults don't fire on it (a replayed fragment after a chaos kill
         is exactly the dispatch this cache exists to make free)."""
+        import time
+
+        from datafusion_tpu.obs import recorder
+        from datafusion_tpu.obs.aggregate import observe_latency
+
         cache = self.fragment_cache
         key = None
         if cache is not None:
@@ -243,6 +267,8 @@ class WorkerState:
             hit = cache.get(key)
             if hit is not None:
                 self.cache_hits += 1
+                recorder.record("cache.hit", level="fragment",
+                                shard=frag.shard)
                 # zero-work span marking the free serve in the timeline
                 with obs_trace.span("worker.fragment", cache_hit=True,
                                     **frag.span_attrs()):
@@ -251,8 +277,22 @@ class WorkerState:
         faults.check(
             "worker.fragment", shard=frag.shard, fragment_id=frag.fragment_id
         )
-        with obs_trace.span("worker.fragment", **frag.span_attrs()):
-            raw = compute(frag)
+        t0 = time.perf_counter()
+        try:
+            with obs_trace.span("worker.fragment", **frag.span_attrs()):
+                raw = compute(frag)
+        except Exception as e:
+            recorder.record("fragment.error", shard=frag.shard,
+                            error=f"{type(e).__name__}: {e}")
+            recorder.auto_capture("fragment_failure", lambda: {
+                "fragment": frag.span_attrs(),
+                "error": f"{type(e).__name__}: {e}",
+            })
+            raise
+        dt = time.perf_counter() - t0
+        observe_latency("fragment.latency", dt)
+        recorder.record("fragment.serve", shard=frag.shard,
+                        wall_s=round(dt, 6))
         if cache is not None:
             stored = _copy_raw(raw)
             # tagged by scanned table so a coordinator's invalidation
@@ -379,6 +419,25 @@ class _Handler(socketserver.BaseRequestHandler):
                     out = {"type": "pong", "queries": state.queries}
                 elif kind == "status":
                     out = state.status()
+                elif kind == "telemetry":
+                    # the non-cluster fleet-aggregation pull: one
+                    # round trip returns the node snapshot alone
+                    out = {"type": "telemetry",
+                           "snapshot": state.telemetry_snapshot()}
+                elif kind == "flight_dump":
+                    # the ring, on demand — trace-filtered when the
+                    # coordinator is assembling one query's artifact
+                    # set across every involved node
+                    from datafusion_tpu.obs import recorder
+
+                    out = {
+                        "type": "flight_dump",
+                        "node": f"worker:{os.getpid()}",
+                        "events": recorder.events(
+                            msg.get("trace_id") or None
+                        ),
+                        "events_emitted": recorder.emitted(),
+                    }
                 elif kind == "execute_fragment":
                     with adoption, deadline_scope(deadline):
                         out = state.execute_fragment(msg["fragment"], bw)

@@ -20,11 +20,14 @@ class Metrics:
         self.timings: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
         self.gauges: dict[str, float] = {}
+        self._declared: set[str] = set()
 
     def reset(self):
         self.timings.clear()
         self.counts.clear()
         self.gauges.clear()
+        for name in self._declared:  # declared names survive resets
+            self.counts[name] += 0
 
     @contextmanager
     def timer(self, name: str):
@@ -36,6 +39,16 @@ class Metrics:
 
     def add(self, name: str, n: int = 1):
         self.counts[name] += n
+
+    def declare(self, *names: str) -> None:
+        """Materialize counters at zero so their names render in every
+        snapshot/scrape from process start.  The contract for metric
+        names downstream dashboards depend on BEFORE the code that
+        increments them lands (the serving path's admission counters
+        are declared this way).  Declared names survive `reset()`."""
+        self._declared.update(names)
+        for name in names:
+            self.counts[name] += 0
 
     def observe(self, name: str, seconds: float):
         """Fold an externally-measured duration into a stage timing

@@ -83,9 +83,11 @@ def device_call(fn, /, *args, **kwargs):
             # EXPLAIN ANALYZE / bench derives from this counter);
             # counted AFTER fn so failed attempts/retries don't inflate
             METRICS.add("device.launches")
+            from datafusion_tpu.obs.recorder import record as flight_record
             from datafusion_tpu.obs.stats import record_launch
 
             record_launch()
+            flight_record("device.launch", attempt=attempt)
             return out
         except Exception as e:  # jax.errors.JaxRuntimeError and kin
             transient = classify_transient(e)
@@ -103,7 +105,11 @@ def device_call(fn, /, *args, **kwargs):
                     f"{delay:.3f}s retry backoff"
                 ) from transient
             METRICS.add("device.transient_retries")
+            from datafusion_tpu.obs.recorder import record as flight_record
             from datafusion_tpu.obs.stats import record_retry
 
             record_retry()  # ambient-operator attribution (EXPLAIN ANALYZE)
+            flight_record("device.retry", attempt=attempt,
+                          error=type(transient).__name__,
+                          backoff_s=round(delay, 4))
             time.sleep(delay)

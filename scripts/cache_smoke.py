@@ -146,7 +146,7 @@ def main() -> int:
         status = dctx.worker_status()[f"{addr[0]}:{addr[1]}"]
         frag = status["cache"]["fragment"]
         assert frag and frag["hits"] >= 2, frag
-        assert "cache_fragment_bytes" in status["prometheus"]
+        assert 'name="cache.fragment.bytes"' in status["prometheus"]
         print(f"fragment cache: replay after lost response served from "
               f"memory ({hits} cache-hit responses at merge, worker "
               f"{frag['hits']} hits / {frag['bytes']} bytes)", flush=True)

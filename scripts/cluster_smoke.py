@@ -183,6 +183,50 @@ def control_plane_smoke(schema, sql, paths, env) -> None:
             time.sleep(0.2)
         print("invalidation broadcast: worker fragment caches dropped",
               flush=True)
+
+        # fleet telemetry: both workers piggyback node snapshots on
+        # their lease heartbeats; ONE service round trip hands the
+        # coordinator fleet-aggregated p50/p95/p99, cache hit rates,
+        # and (with an objective armed) SLO burn-rate gauges
+        from datafusion_tpu.obs import slo
+
+        # re-run a query so fragment latency histograms are non-empty
+        collect(ca.sql(sql))
+        deadline = time.monotonic() + 30  # next heartbeat ships them
+        while ca.fleet_refresh() < 2:
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    "worker telemetry never reached the service: "
+                    f"{client.telemetry()}"
+                )
+            time.sleep(0.5)
+        fleet = ca.telemetry.fleet()
+        assert fleet["nodes"] >= 3, fleet["node_names"]  # 2 workers + local
+        frag_hist = fleet["histograms"].get("fragment.latency")
+        assert frag_hist is not None and frag_hist.count >= 2, (
+            "fleet fragment-latency histogram missing worker samples"
+        )
+        slo.WATCHDOG.add(slo.Objective("smoke_p99", "p99", 300.0))
+        try:
+            prom = ca.metrics_text()
+        finally:
+            slo.WATCHDOG.objectives.pop()
+        for needle in ('name="fleet.nodes"',
+                       'name="fleet.fragment.latency.p50_s"',
+                       'name="fleet.fragment.latency.p95_s"',
+                       'name="fleet.fragment.latency.p99_s"',
+                       'name="fleet.query.latency.p99_s"',
+                       'name="fleet.result_cache_hit_rate"',
+                       'name="slo.smoke_p99.burn_rate"'):
+            assert needle in prom, needle
+        top = ca.top_text()
+        worker_rows = [ln for ln in top.splitlines()
+                       if ln.strip().startswith("node ")
+                       and "local" not in ln]
+        assert len(worker_rows) >= 2, top
+        print("fleet telemetry: p50/p95/p99 + cache hit rates aggregated "
+              f"from {len(worker_rows)} workers via heartbeat piggyback",
+              flush=True)
         ca.close()
         cb.close()
         print("CONTROL PLANE OK", flush=True)

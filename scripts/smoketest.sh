@@ -30,7 +30,7 @@ echo "== analysis check (self-lint + plan verifier + lockcheck report) =="
 echo "== chaos smoke (distributed query under a seeded fault plan) =="
 python scripts/chaos_smoke.py
 
-echo "== trace smoke (EXPLAIN ANALYZE + merged worker trace) =="
+echo "== trace smoke (EXPLAIN ANALYZE + merged worker trace + flight-recorder artifact + OTLP export) =="
 python scripts/trace_smoke.py
 
 echo "== cache smoke (result + fragment caches, invalidation, off-switch) =="
@@ -39,7 +39,7 @@ python scripts/cache_smoke.py
 echo "== kernel smoke (fused vs unfused parity, no-recompile-on-repeat, Pallas interpret parity) =="
 python scripts/kernel_smoke.py
 
-echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast, primary/standby HA) =="
+echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast, fleet telemetry aggregation, primary/standby HA) =="
 python scripts/cluster_smoke.py
 
 echo "== example (reference csv_sql.rs workload) =="

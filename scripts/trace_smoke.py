@@ -12,7 +12,14 @@ and asserts the observability contract end to end:
    parented under a coordinator `coord.dispatch` span;
 3. the Chrome-trace export is valid JSON with events from both
    processes;
-4. the Prometheus text dump renders the engine counters.
+4. the Prometheus text dump renders the engine counters;
+5. (telemetry plane) the slow-query hook auto-captures a correlated
+   artifact set with no per-query configuration — flight-recorder
+   events from the coordinator AND every worker, the stitched
+   OTLP/JSON trace, and the operator report, in ONE file — and the
+   explicit OTLP export round-trips the span set;
+6. the fleet aggregator merges both workers' heartbeat-shaped
+   snapshots into p50/p95/p99 gauges in the coordinator's scrape.
 
 Exit non-zero on any violation.  `scripts/smoketest.sh` runs this after
 the chaos smoke.
@@ -142,10 +149,71 @@ def main() -> int:
         assert "datafusion_tpu_events_total" in text
         assert "datafusion_tpu_timing_seconds_total" in text
 
+        # 5. telemetry plane: a "slow" distributed query (threshold 0)
+        # auto-captures ONE correlated artifact — local + worker flight
+        # events, stitched OTLP trace, operator report
+        from datafusion_tpu.obs import recorder
+        from datafusion_tpu.obs.otlp import otlp_to_spans
+
+        flight_dir = os.path.join(tmpdir, "flight")
+        recorder.configure(slow_s=0.0, directory=flight_dir,
+                           dump_interval_s=0.0)
+        slow_ctx = make_ctx()
+        res2 = slow_ctx.sql_collect(f"EXPLAIN ANALYZE {sql}")
+        artifacts = [
+            os.path.join(flight_dir, f) for f in os.listdir(flight_dir)
+        ]
+        assert artifacts, "slow query produced no flight artifact"
+        with open(artifacts[0], "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        assert doc["reason"] == "slow_query", doc["reason"]
+        assert doc["query"]["trace_id"] == res2.trace_id
+        worker_addrs = {f"{h}:{p}" for h, p in addrs}
+        assert set(doc["nodes"]) == worker_addrs, (
+            f"artifact covers {set(doc['nodes'])}, expected {worker_addrs}"
+        )
+        worker_kinds = {e["kind"]
+                        for nd in doc["nodes"].values()
+                        for e in nd["events"]}
+        # a repeat of an earlier phase's fragments may serve from the
+        # worker fragment cache: either way the ring shows the work
+        assert worker_kinds & {"fragment.serve", "cache.hit"}, worker_kinds
+        assert any(e["kind"] == "query.dispatch" for e in doc["events"])
+        assert "resourceSpans" in doc["otlp"]
+        assert any("rows=" in line for line in doc["explain"])
+        recorder.configure(slow_s=10.0)  # restore
+
+        # ...and the explicit OTLP export round-trips the full span set
+        otlp_path = os.path.join(tmpdir, "trace.otlp.json")
+        res2.write_otlp(otlp_path)
+        with open(otlp_path, "r", encoding="utf-8") as f:
+            otlp_doc = json.load(f)
+        rt = otlp_to_spans(otlp_doc)
+        assert len(rt) == len(res2.spans)
+        rt_procs = {s["proc"] for s in rt}
+        assert any(p.startswith("worker") for p in rt_procs), rt_procs
+        assert any(p.startswith("main") for p in rt_procs), rt_procs
+
+        # 6. fleet aggregation: both workers' snapshots merge into the
+        # coordinator's scrape gauges
+        agg_ctx = make_ctx()
+        agg_ctx.sql_collect(sql)
+        assert agg_ctx.fleet_refresh() == 2, "expected 2 worker snapshots"
+        fleet_text = agg_ctx.metrics_text()
+        for needle in ('name="fleet.nodes"',
+                       'name="fleet.fragment.latency.p99_s"',
+                       'name="fleet.query.latency.p50_s"'):
+            assert needle in fleet_text, needle
+        top = agg_ctx.top_text()
+        for addr in worker_addrs:
+            assert addr in top, top
+
         print(res.report())
         print(f"\nTRACE SMOKE PASSED ({len(res.spans)} spans, "
               f"{len(frags)} worker fragments, {len(procs_in_trace)} "
-              f"processes in the Chrome trace)")
+              f"processes in the Chrome trace; flight artifact covers "
+              f"{1 + len(doc['nodes'])} nodes, OTLP round-trips "
+              f"{len(rt)} spans)")
         return 0
     finally:
         for p in procs:

@@ -511,8 +511,9 @@ class TestWorkerFragmentCache:
             # with counter lines and the cache/span-buffer gauges
             prom = status["prometheus"]
             assert "datafusion_tpu_events_total" in prom
-            assert "cache_fragment_bytes" in prom
-            assert "obs_span_buffer_depth" in prom
+            # dotted gauge names keep their dots post-sanitization-fix
+            assert 'name="cache.fragment.bytes"' in prom
+            assert 'name="obs.span_buffer_depth"' in prom
         finally:
             proc.terminate()
             proc.wait(timeout=10)

@@ -163,7 +163,8 @@ class TestClients:
             assert out["found"] and out["value"]["snapshot"] == {"n": 1}
             assert c.invalidate("t")["dropped"] == 1
             status = c.status()
-            assert status["epoch"] == 1 and "cluster_epoch" in status["prometheus"]
+            assert status["epoch"] == 1
+            assert 'name="cluster.epoch"' in status["prometheus"]
         finally:
             server.shutdown()
             server.server_close()
@@ -626,8 +627,10 @@ class TestClusterIntegration:
         with DistributedContext(cluster=cluster.client,
                                 result_cache=False) as ctx:
             text = ctx.metrics_text()
-            assert "cluster_epoch" in text
-            assert "cluster_watch_lag_s" in text
+            assert 'name="cluster.epoch"' in text
+            assert 'name="cluster.watch_lag_s"' in text
+            # the fleet telemetry gauges ride the same scrape
+            assert 'name="fleet.nodes"' in text
 
     def test_sync_workers_discovers_late_joiner(self, cluster):
         with DistributedContext(cluster=cluster.client,

@@ -333,7 +333,7 @@ class TestFusedPasses:
         assert res.counters["device.launches"] >= 1
         # the gauges export through the Prometheus text path
         text = ctx.metrics_text()
-        assert 'name="query_launches_per_pass"' in text
+        assert 'name="query.launches_per_pass"' in text
 
     def test_repeat_query_no_kernel_cache_misses(self, monkeypatch):
         monkeypatch.setenv("DATAFUSION_TPU_FUSE", "1")

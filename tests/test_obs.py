@@ -405,7 +405,9 @@ class TestPrometheusExport:
         ctx.sql_collect("SELECT region, SUM(v) FROM t GROUP BY region")
         text = prometheus_text()
         assert "datafusion_tpu_timing_seconds_total" in text
-        assert 'datafusion_tpu_events_total{name="scan_rows"}' in text
+        # dotted engine names keep their dots in label values (the
+        # sanitization fix: label values escape, not flatten)
+        assert 'datafusion_tpu_events_total{name="scan.rows"}' in text
         assert text == ctx.metrics_text()
         # exposition format sanity: every sample line is name{labels} value
         for line in text.strip().splitlines():
@@ -423,6 +425,6 @@ class TestPrometheusExport:
         m.add("x.y", 3)
         m.observe("stage-a", 0.5)
         text = prometheus_text(m, extra_gauges={"spans_buffered": 7})
-        assert 'datafusion_tpu_events_total{name="x_y"} 3' in text
-        assert 'stage="stage_a"' in text
+        assert 'datafusion_tpu_events_total{name="x.y"} 3' in text
+        assert 'stage="stage-a"' in text
         assert 'datafusion_tpu_gauge{name="spans_buffered"} 7' in text

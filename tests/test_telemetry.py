@@ -1,0 +1,722 @@
+"""Fleet telemetry plane (datafusion_tpu/obs/): flight-recorder ring
+semantics (wraparound, concurrency, lock-free emit cost), OTLP/JSON
+schema round-trip, Prometheus exposition format lock, fleet histogram
+aggregation, SLO burn rates, and the slow/failed-query artifact
+capture end to end (single-process and across real worker
+subprocesses)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.obs import aggregate, otlp, recorder, slo
+from datafusion_tpu.utils.metrics import METRICS, Metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = Schema(
+    [
+        Field("region", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+    ]
+)
+
+
+def _write_csv(path, rows=200, seed=3):
+    rng = np.random.default_rng(seed)
+    regions = ["north", "south", "east", "west"]
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("region,v\n")
+        for _ in range(rows):
+            f.write(f"{regions[rng.integers(0, 4)]},"
+                    f"{int(rng.integers(-100, 100))}\n")
+    return str(path)
+
+
+@pytest.fixture()
+def flight(tmp_path):
+    """Flight recorder scoped to this test: fresh ring, tmp dump dir,
+    no throttle; every knob restored afterward so the always-on
+    defaults hold for the rest of the suite."""
+    saved = (recorder._ENABLED, recorder._CAP, recorder._SLOW_S,
+             recorder._DIR, recorder._DUMP_INTERVAL_S)
+    recorder.configure(enabled=True, directory=str(tmp_path),
+                       dump_interval_s=0.0)
+    recorder.clear()
+    yield recorder
+    recorder.configure(enabled=saved[0], capacity=saved[1],
+                       slow_s=saved[2], directory=saved[3],
+                       dump_interval_s=saved[4])
+    recorder.clear()
+
+
+class TestFlightRecorder:
+    def test_emit_snapshot_and_trace_correlation(self, flight):
+        from datafusion_tpu.obs import trace
+
+        recorder.record("a", x=1)
+        with trace.session() as tc:
+            recorder.record("b", y="z")
+        trace.drain(tc.trace_id)
+        ev = recorder.events()
+        assert [e["kind"] for e in ev] == ["a", "b"]
+        assert ev[0]["attrs"] == {"x": 1}
+        assert "trace_id" not in ev[0]
+        assert ev[1]["trace_id"] == tc.trace_id
+        # trace filter returns exactly the correlated events
+        assert [e["kind"] for e in recorder.events(tc.trace_id)] == ["b"]
+
+    def test_ring_wraparound(self, flight):
+        recorder.configure(capacity=16)
+        for i in range(40):
+            recorder.record("e", i=i)
+        ev = recorder.events()
+        assert len(ev) == 16
+        assert [e["attrs"]["i"] for e in ev] == list(range(24, 40))
+        assert recorder.emitted() == 40  # total survives the wrap
+
+    def test_concurrent_emit(self, flight):
+        recorder.configure(capacity=1024)
+        n_threads, per = 8, 2000
+        errors = []
+
+        def emit(t):
+            try:
+                for i in range(per):
+                    recorder.record("c", t=t, i=i)
+            except Exception as e:  # noqa: BLE001 — collected and asserted empty
+                errors.append(e)
+
+        threads = [threading.Thread(target=emit, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        # itertools.count is GIL-atomic: no emission is ever lost
+        assert recorder.emitted() == n_threads * per
+        ev = recorder.events()
+        assert len(ev) == 1024
+        assert all(e["kind"] == "c" for e in ev)
+
+    def test_emit_is_cheap(self, flight):
+        """The ≤2% warm-path budget: emit must stay in single-digit
+        microseconds (bound is generous for CI noise — typical is
+        ~1µs; a warm query emits ~10 events against a multi-ms wall)."""
+        recorder.configure(capacity=4096)
+        n = 20000
+        t0 = time.perf_counter()
+        for i in range(n):
+            recorder.record("perf", i=i)
+        per_emit = (time.perf_counter() - t0) / n
+        assert per_emit < 50e-6, f"emit cost {per_emit * 1e6:.1f}µs"
+
+    def test_disabled_is_noop(self, flight):
+        recorder.configure(enabled=False)
+        before = recorder.emitted()
+        recorder.record("x")
+        assert recorder.emitted() == before
+        assert recorder.auto_capture("nope") is None
+
+    def test_dump_and_throttle(self, flight, tmp_path):
+        recorder.record("a")
+        path = recorder.dump("manual")
+        doc = json.loads(open(path, encoding="utf-8").read())
+        assert doc["reason"] == "manual"
+        assert doc["events"][0]["kind"] == "a"
+        assert doc["node"].split(":")[0] in ("main", "worker")
+        # throttle: with a long interval only the first auto dump lands
+        recorder.configure(dump_interval_s=1000.0)
+        assert recorder.auto_capture("one") is not None
+        assert recorder.auto_capture("two") is None
+        assert METRICS.counts.get("flight.dumps_throttled", 0) >= 1
+
+    def test_crash_hook_dumps_and_chains(self, flight):
+        calls = []
+        prev, recorder._hook_installed = sys.excepthook, False
+        sys.excepthook = lambda *a: calls.append(a)
+        try:
+            recorder.install_crash_hook()
+            recorder.record("before-crash")
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                sys.excepthook(*sys.exc_info())
+            assert len(calls) == 1  # chained to the previous hook
+            dumps = glob.glob(os.path.join(recorder.dump_dir(),
+                                           "flight-*.json"))
+            docs = [json.loads(open(p, encoding="utf-8").read())
+                    for p in dumps]
+            assert any(d["reason"] == "crash"
+                       and "boom" in d.get("error", "") for d in docs)
+        finally:
+            sys.excepthook = prev
+            recorder._hook_installed = False
+            recorder._prev_excepthook = None
+
+
+class TestOtlp:
+    SPANS = [
+        {"name": "query", "trace_id": "aa11", "span_id": "bb22",
+         "parent_id": None, "start_ns": 100, "end_ns": 900,
+         "attrs": {"n": 3, "f": 0.5, "ok": True, "s": "x"},
+         "tid": 9, "proc": "main:1"},
+        {"name": "worker.fragment", "trace_id": "aa11", "span_id": "cc33",
+         "parent_id": "bb22", "start_ns": 200, "end_ns": 800,
+         "attrs": {}, "tid": 4, "proc": "worker:2"},
+    ]
+
+    def test_schema_shape(self):
+        doc = otlp.spans_to_otlp(self.SPANS)
+        assert len(doc["resourceSpans"]) == 2  # one per process
+        sp = doc["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+        assert len(sp["traceId"]) == 32 and len(sp["spanId"]) == 16
+        assert sp["traceId"].endswith("aa11")
+        assert isinstance(sp["startTimeUnixNano"], str)  # int64-as-string
+        # attribute typing follows the OTLP value union
+        vals = {a["key"]: a["value"] for a in sp["attributes"]}
+        assert vals["n"] == {"intValue": "3"}
+        assert vals["f"] == {"doubleValue": 0.5}
+        assert vals["ok"] == {"boolValue": True}
+        assert vals["s"] == {"stringValue": "x"}
+        res = {a["key"]: a["value"]["stringValue"]
+               for a in doc["resourceSpans"][0]["resource"]["attributes"]}
+        assert res["service.name"] == "datafusion_tpu.main"
+        assert res["service.instance.id"] == "main:1"
+
+    def test_round_trip(self):
+        back = otlp.otlp_to_spans(otlp.spans_to_otlp(self.SPANS))
+        by_name = {s["name"]: s for s in back}
+        assert set(by_name) == {"query", "worker.fragment"}
+        q = by_name["query"]
+        assert q["attrs"] == self.SPANS[0]["attrs"]
+        assert q["tid"] == 9 and q["proc"] == "main:1"
+        assert q["start_ns"] == 100 and q["end_ns"] == 900
+        frag = by_name["worker.fragment"]
+        # parent/child linkage survives (modulo canonical padding)
+        assert frag["parent_id"] == q["span_id"]
+        assert frag["trace_id"] == q["trace_id"]
+
+    def test_export_file_env(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "otlp.jsonl")
+        monkeypatch.setenv("DATAFUSION_TPU_OTLP_FILE", path)
+        monkeypatch.delenv("DATAFUSION_TPU_OTLP_ENDPOINT", raising=False)
+        assert otlp.export_spans(self.SPANS) == path
+        assert otlp.export_spans(self.SPANS) == path  # appends
+        lines = open(path, encoding="utf-8").read().strip().splitlines()
+        assert len(lines) == 2
+        assert len(otlp.otlp_to_spans(json.loads(lines[0]))) == 2
+
+    def test_export_http_post(self, monkeypatch):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        bodies = []
+
+        class _H(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
+                n = int(self.headers["Content-Length"])
+                bodies.append(json.loads(self.rfile.read(n)))
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        srv = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        try:
+            endpoint = f"http://127.0.0.1:{srv.server_address[1]}/v1/traces"
+            status = otlp.post_otlp(endpoint, self.SPANS)
+            assert status == 200
+            assert bodies and "resourceSpans" in bodies[0]
+            # env-routed export swallows a dead endpoint instead of
+            # failing the query path
+            monkeypatch.delenv("DATAFUSION_TPU_OTLP_FILE", raising=False)
+            monkeypatch.setenv("DATAFUSION_TPU_OTLP_ENDPOINT",
+                               "http://127.0.0.1:9/v1/traces")
+            assert otlp.export_spans(self.SPANS) is None
+            assert METRICS.counts.get("obs.otlp_errors", 0) >= 1
+        finally:
+            srv.shutdown()
+
+
+class TestExpositionFormat:
+    """Locks the Prometheus text format after the `_metric_name` fix:
+    identifiers sanitize, label values ESCAPE (dots survive)."""
+
+    def test_dotted_names_keep_dots_in_labels(self):
+        m = Metrics()
+        m.add("cache.result.hits", 2)
+        m.add("cache_result_hits", 5)  # must NOT collide post-fix
+        from datafusion_tpu.obs.export import prometheus_text
+
+        text = prometheus_text(m)
+        assert 'datafusion_tpu_events_total{name="cache.result.hits"} 2' \
+            in text
+        assert 'datafusion_tpu_events_total{name="cache_result_hits"} 5' \
+            in text
+
+    def test_label_values_escape(self):
+        m = Metrics()
+        m.add('odd"name\\with\nnasties', 1)
+        from datafusion_tpu.obs.export import prometheus_text
+
+        text = prometheus_text(m)
+        line = next(ln for ln in text.splitlines() if "odd" in ln)
+        assert line == (
+            'datafusion_tpu_events_total{name="odd\\"name\\\\with\\nnasties"} 1'
+        )
+
+    def test_metric_name_identifier_rules(self):
+        from datafusion_tpu.obs.export import _metric_name
+
+        assert _metric_name("a.b-c") == "a_b_c"
+        assert _metric_name("a..b") == "a_b"  # runs collapse
+        assert _metric_name("9lives") == "_9lives"  # no leading digit
+        assert _metric_name("") == "_"
+
+    def test_every_sample_line_parses(self):
+        m = Metrics()
+        m.add("x.y")
+        m.observe("stage-a", 0.25)
+        m.gauge("g.h", 1.5)
+        from datafusion_tpu.obs.export import prometheus_text
+
+        import re
+
+        sample = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*\{[a-z]+="[^\n]*"\} [-0-9.e+]+$'
+        )
+        for line in prometheus_text(m).strip().splitlines():
+            if not line.startswith("#"):
+                assert sample.match(line), line
+
+
+class TestHistogramAggregation:
+    def test_quantiles_and_merge(self):
+        h = aggregate.LatencyHistogram()
+        for _ in range(98):
+            h.observe(0.001)
+        for _ in range(2):
+            h.observe(2.0)
+        assert h.quantile(0.5) == pytest.approx(0.001024, rel=0.01)
+        assert h.quantile(0.99) > 1.0
+        other = aggregate.LatencyHistogram()
+        other.observe(0.001)
+        other.merge(h.snapshot())  # merge accepts wire-form dicts
+        assert other.count == 101
+        assert other.sum_s == pytest.approx(h.sum_s + 0.001)
+
+    def test_overflow_quantile_is_a_lower_bound(self):
+        # 98 fast queries + 2 hung ones whose latency exceeds every
+        # finite bucket: the p99 lands in the +inf overflow slot.  The
+        # report must be a LOWER bound on the tail (the largest finite
+        # bucket edge, ~67s), never the whole-population mean (~4s) that
+        # would hide a hang behind the fast majority.
+        h = aggregate.LatencyHistogram()
+        for _ in range(98):
+            h.observe(0.001)
+        for _ in range(2):
+            h.observe(200.0)
+        p99 = h.quantile(0.99)
+        assert p99 >= aggregate.bucket_upper_bound_s(26)  # largest finite
+        mean = h.sum_s / h.count
+        assert p99 > mean  # not the mean-of-everything dodge
+        # when overflow members dominate, the mean exceeds the edge and
+        # becomes the tighter lower bound
+        h2 = aggregate.LatencyHistogram()
+        for _ in range(10):
+            h2.observe(500.0)
+        assert h2.quantile(0.99) == pytest.approx(500.0)
+
+    def test_fleet_merge_and_gauges(self):
+        agg = aggregate.FleetAggregator(include_local=False)
+        h1 = aggregate.LatencyHistogram()
+        h2 = aggregate.LatencyHistogram()
+        for _ in range(50):
+            h1.observe(0.002)
+        for _ in range(50):
+            h2.observe(0.5)
+        now = time.time()
+        agg.ingest("w1:1", {"ts": now,
+                            "histograms": {"fragment.latency": h1.snapshot()},
+                            "counts": {"cache.fragment.hits": 30,
+                                       "cache.fragment.misses": 10},
+                            "gauges": {}})
+        agg.ingest("w2:2", {"ts": now,
+                            "histograms": {"fragment.latency": h2.snapshot()},
+                            "counts": {"cache.fragment.hits": 10,
+                                       "cache.fragment.misses": 10},
+                            "gauges": {}})
+        fleet = agg.fleet()
+        assert fleet["nodes"] == 2
+        merged = fleet["histograms"]["fragment.latency"]
+        assert merged.count == 100
+        # the fleet p99 sees w2's slow half even though w1 is fast
+        assert merged.quantile(0.99) > 0.25
+        assert fleet["derived"]["fragment_cache_hit_rate"] == \
+            pytest.approx(40 / 60)
+        gauges = agg.gauges()
+        assert gauges["fleet.nodes"] == 2
+        assert gauges["fleet.fragment.latency.count"] == 100
+        assert "fleet.fragment.latency.p99_s" in gauges
+        top = agg.top_text()
+        assert "w1:1" in top and "w2:2" in top and "fleet: 2 node(s)" in top
+
+    def test_stale_snapshots_drop_out(self):
+        agg = aggregate.FleetAggregator(stale_s=0.01, include_local=False)
+        agg.ingest("old:1", {"ts": time.time() - 10, "histograms": {},
+                             "counts": {}, "gauges": {}})
+        assert agg.fleet()["nodes"] == 0
+
+    def test_malformed_snapshot_ignored(self):
+        agg = aggregate.FleetAggregator(include_local=False)
+        agg.ingest("bad:1", None)
+        agg.ingest("bad:2", {"no": "histograms"})
+        assert agg.fleet()["nodes"] == 0
+
+
+class TestSlo:
+    def test_env_declaration(self):
+        objs = slo.objectives_from_env({
+            "DATAFUSION_TPU_SLO_WARM_Q1_P99": "0.5",
+            "DATAFUSION_TPU_SLO_INGEST_P50": "2.0",
+            "DATAFUSION_TPU_SLO_ERROR_RATE": "0.01",
+            "DATAFUSION_TPU_SLO_WINDOW_S": "60",  # knob, not objective
+            "DATAFUSION_TPU_SLO_BOGUS": "zzz",    # unparseable: skipped
+            # out-of-domain thresholds skip too (this parser runs at
+            # module import — an env typo must not fail every query)
+            "DATAFUSION_TPU_SLO_ZERO_P99": "0",
+            "DATAFUSION_TPU_SLO_NEG_ERROR_RATE": "-1",
+        })
+        by_name = {o.name: o for o in objs}
+        assert set(by_name) == {"warm_q1", "ingest", "error_rate"}
+        assert by_name["warm_q1"].kind == "p99"
+        assert by_name["warm_q1"].threshold == 0.5
+        assert by_name["error_rate"].kind == "error_rate"
+
+    def test_error_rate_burn(self):
+        wd = slo.SloWatchdog(min_samples=10, capture_on_breach=False)
+        wd.add(slo.Objective("err", "error_rate", 0.01))
+        for i in range(100):
+            wd.observe(0.001, error=(i % 10 == 0))  # 10% failures
+        row = wd.evaluate()[0]
+        assert row["value"] == pytest.approx(0.10)
+        assert row["burn_rate"] == pytest.approx(10.0)
+        assert row["breached"]
+        assert METRICS.gauges["slo.err.burn_rate"] == pytest.approx(10.0)
+        assert METRICS.gauges["slo.err.breached"] == 1
+
+    def test_latency_burn_healthy_and_breached(self):
+        wd = slo.SloWatchdog(min_samples=10, capture_on_breach=False)
+        wd.add(slo.Objective("lat", "p99", 0.1))
+        for _ in range(100):
+            wd.observe(0.01)
+        row = wd.evaluate()[0]
+        assert row["burn_rate"] == 0.0 and not row["breached"]
+        for _ in range(5):
+            wd.observe(0.5)  # ~4.8% now over the p99 threshold
+        row = wd.evaluate()[0]
+        assert row["burn_rate"] > 1.0 and row["breached"]
+
+    def test_min_samples_quorum(self):
+        wd = slo.SloWatchdog(min_samples=50, capture_on_breach=False)
+        wd.add(slo.Objective("q", "p99", 0.001))
+        for _ in range(10):
+            wd.observe(1.0)  # 100% bad, but below quorum
+        assert not wd.evaluate()[0]["breached"]
+
+    def test_breach_captures_flight_dump(self, flight):
+        wd = slo.SloWatchdog(min_samples=5, capture_on_breach=True)
+        wd.add(slo.Objective("cap", "error_rate", 0.01))
+        for _ in range(10):
+            wd.observe(0.001, error=True)
+        assert wd.evaluate()[0]["breached"]
+        dumps = glob.glob(os.path.join(recorder.dump_dir(),
+                                       "flight-*.json"))
+        docs = [json.loads(open(p, encoding="utf-8").read())
+                for p in dumps]
+        assert any(d["reason"] == "slo_breach"
+                   and d["slo"]["name"] == "cap" for d in docs)
+
+
+class TestQueryFunnel:
+    @pytest.fixture()
+    def ctx(self, tmp_path):
+        c = ExecutionContext(device="cpu")
+        c.register_csv("t", _write_csv(tmp_path / "t.csv"), SCHEMA)
+        return c
+
+    def test_query_events_and_histogram(self, ctx, flight):
+        before = aggregate.HISTOGRAMS.get("query.latency")
+        before_n = before.count if before else 0
+        ctx.sql_collect("SELECT region, SUM(v) FROM t GROUP BY region")
+        kinds = [e["kind"] for e in recorder.events()]
+        for expected in ("query.plan", "query.admit", "query.verify",
+                         "query.done"):
+            assert expected in kinds, kinds
+        assert aggregate.HISTOGRAMS["query.latency"].count == before_n + 1
+
+    def test_admission_counters(self, ctx, flight):
+        base = METRICS.counts["queries_admitted"]
+        ctx.sql_collect("SELECT region FROM t")
+        assert METRICS.counts["queries_admitted"] == base + 1
+        # the declared stubs render at zero and survive reset()
+        text = ctx.metrics_text()
+        assert 'name="queries_queued"' in text
+        assert 'name="queries_shed"' in text
+        METRICS.reset()
+        assert "queries_shed" in METRICS.counts
+        ctx.sql_collect("SELECT region FROM t")  # restore some state
+
+    def test_cached_repeat_records_hit_event(self, ctx, flight):
+        sql = "SELECT region, SUM(v) FROM t GROUP BY region"
+        ctx.sql_collect(sql)
+        recorder.clear()
+        ctx.sql_collect(sql)
+        kinds = [e["kind"] for e in recorder.events()]
+        assert "cache.hit" in kinds
+        hit = next(e for e in recorder.events()
+                   if e["kind"] == "cache.hit")
+        assert hit["attrs"]["level"] == "result"
+
+    def test_slow_query_auto_capture(self, ctx, flight, tmp_path):
+        recorder.configure(slow_s=0.0)  # every query is "slow"
+        ctx.sql_collect("SELECT region, SUM(v) FROM t GROUP BY region")
+        dumps = glob.glob(os.path.join(str(tmp_path), "flight-*.json"))
+        docs = [json.loads(open(p, encoding="utf-8").read())
+                for p in dumps]
+        doc = next(d for d in docs if d["reason"] == "slow_query")
+        assert doc["query"]["label"] == "Aggregate"
+        assert doc["query"]["wall_s"] >= 0
+        assert any(e["kind"] == "query.done" for e in doc["events"])
+        assert METRICS.counts.get("flight.slow_queries", 0) >= 1
+
+    def test_failed_query_auto_capture(self, ctx, flight, tmp_path):
+        from datafusion_tpu.errors import IoError
+
+        ctx.register_csv("gone", str(tmp_path / "missing.csv"), SCHEMA)
+        with pytest.raises(IoError, match="missing.csv"):
+            ctx.sql_collect("SELECT region FROM gone")
+        kinds = [e["kind"] for e in recorder.events()]
+        assert "query.error" in kinds
+        dumps = glob.glob(os.path.join(str(tmp_path), "flight-*.json"))
+        docs = [json.loads(open(p, encoding="utf-8").read())
+                for p in dumps]
+        doc = next(d for d in docs if d["reason"] == "query_failure")
+        assert doc["query"]["error"]
+
+    def test_explain_analyze_capture_includes_otlp(self, ctx, flight,
+                                                   tmp_path):
+        recorder.configure(slow_s=0.0)
+        res = ctx.sql_collect(
+            "EXPLAIN ANALYZE SELECT region, SUM(v) FROM t GROUP BY region"
+        )
+        assert res.spans
+        dumps = glob.glob(os.path.join(str(tmp_path), "flight-*.json"))
+        docs = [json.loads(open(p, encoding="utf-8").read())
+                for p in dumps]
+        doc = next(d for d in docs if d["reason"] == "slow_query")
+        # instrumented run: the artifact embeds the stitched OTLP trace
+        # and the operator report beside the flight events
+        assert doc["query"]["trace_id"]
+        assert doc["otlp"]["resourceSpans"]
+        got = otlp.otlp_to_spans(doc["otlp"])
+        # captured mid-session: finished operator spans are in (the
+        # root "query" span is still open at the materialization
+        # boundary, so it is not — the full set goes to the env-gated
+        # OTLP export at session end)
+        assert any(s["name"].startswith("op.") for s in got)
+        assert all(
+            s["trace_id"].endswith(doc["query"]["trace_id"]) for s in got
+        )
+        assert any("rows=" in line for line in doc["explain"])
+
+    def test_explain_analyze_exports_otlp_once(self, ctx, flight,
+                                               tmp_path, monkeypatch):
+        # the funnel's in-flight export yields to explain_analyze's
+        # complete-set export: ONE document per analyzed query (a
+        # consumer that trusts span ids would double-count otherwise),
+        # and it carries the root span the mid-session set lacks
+        out = tmp_path / "q.otlp.jsonl"
+        monkeypatch.setenv("DATAFUSION_TPU_OTLP_FILE", str(out))
+        ctx.sql_collect(
+            "EXPLAIN ANALYZE SELECT region, SUM(v) FROM t GROUP BY region"
+        )
+        lines = out.read_text(encoding="utf-8").strip().splitlines()
+        assert len(lines) == 1, f"expected one OTLP document, got {len(lines)}"
+        spans = otlp.otlp_to_spans(json.loads(lines[0]))
+        assert any(s["name"] == "query" for s in spans)  # root included
+
+    def test_plain_query_exports_otlp_once(self, ctx, flight, tmp_path,
+                                           monkeypatch):
+        from datafusion_tpu.obs import trace as obs_trace
+
+        out = tmp_path / "plain.otlp.jsonl"
+        monkeypatch.setenv("DATAFUSION_TPU_OTLP_FILE", str(out))
+        with obs_trace.session():
+            ctx.sql_collect("SELECT region FROM t")
+        lines = out.read_text(encoding="utf-8").strip().splitlines()
+        assert len(lines) == 1
+
+
+class TestClusterTelemetryPiggyback:
+    def test_lease_refresh_carries_snapshot(self):
+        from datafusion_tpu.cluster.client import LocalClusterClient
+        from datafusion_tpu.cluster.service import ClusterState
+
+        state = ClusterState()
+        c = LocalClusterClient(state)
+        lease = c.lease_grant(30.0)["lease"]
+        c.put("workers/10.0.0.1:99", {"addr": "10.0.0.1:99"}, lease=lease)
+        snap = {"ts": time.time(), "histograms": {}, "counts": {"x": 1},
+                "gauges": {}}
+        c.lease_refresh(lease, telemetry=snap)
+        served = c.telemetry()["workers"]
+        assert served == {"10.0.0.1:99": snap}
+        # the snapshot dies with the membership key
+        c.lease_revoke(lease)
+        assert c.telemetry()["workers"] == {}
+
+    def test_expired_lease_drops_snapshot(self):
+        from datafusion_tpu.cluster.service import ClusterState
+
+        state = ClusterState()
+        lease = state.lease_grant(10.0, now=0.0)["lease"]
+        state.put("workers/a:1", {"addr": "a:1"}, lease=lease, now=1.0)
+        state.lease_refresh(lease, now=2.0,
+                            telemetry={"histograms": {}, "counts": {}})
+        assert "a:1" in state.telemetry(now=3.0)
+        assert state.telemetry(now=100.0) == {}  # TTL lapsed
+
+    def test_lease_churn_lands_in_flight_ring(self, flight):
+        from datafusion_tpu.cluster.service import ClusterState
+
+        state = ClusterState()
+        lease = state.lease_grant(10.0, now=0.0)["lease"]
+        state.put("workers/b:2", {"addr": "b:2"}, lease=lease, now=0.5)
+        state.membership(now=100.0)  # expiry sweep
+        kinds = [e["kind"] for e in recorder.events()]
+        assert "cluster.join" in kinds
+        assert "cluster.leave" in kinds
+        assert "cluster.lease_gone" in kinds
+
+
+class TestDistributedFleet:
+    """Two real worker OS processes: fleet aggregation from >= 2
+    workers, the worker flight_dump request, and the correlated
+    artifact set for a slow distributed query."""
+
+    @pytest.fixture(scope="class")
+    def workers(self, tmp_path_factory):
+        tmpdir = str(tmp_path_factory.mktemp("fleet"))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("XLA_FLAGS", None)
+        procs, addrs = [], []
+        try:
+            for _ in range(2):
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "datafusion_tpu.worker",
+                     "--bind", "127.0.0.1:0", "--device", "cpu"],
+                    cwd=REPO, env=env, stdout=subprocess.PIPE,
+                    stderr=subprocess.DEVNULL, text=True,
+                )
+                procs.append(proc)
+                line = proc.stdout.readline()
+                assert "listening on" in line, line
+                host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+                addrs.append((host, int(port)))
+            yield tmpdir, addrs
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                p.wait(timeout=10)
+
+    def _ctx(self, tmpdir, addrs):
+        from datafusion_tpu.exec.datasource import CsvDataSource
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+        from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+        paths = [
+            _write_csv(os.path.join(tmpdir, f"p{i}.csv"), seed=i)
+            for i in range(3)
+        ]
+        ctx = DistributedContext(addrs)
+        ctx.register_datasource("t", PartitionedDataSource(
+            [CsvDataSource(p, SCHEMA, True, 131072) for p in paths]
+        ))
+        return ctx
+
+    def test_fleet_aggregation_from_two_workers(self, workers, flight):
+        tmpdir, addrs = workers
+        ctx = self._ctx(tmpdir, addrs)
+        ctx.sql_collect("SELECT region, SUM(v) FROM t GROUP BY region")
+        assert ctx.fleet_refresh() == 2
+        fleet = ctx.telemetry.fleet()
+        assert fleet["nodes"] == 3  # 2 workers + local
+        frag = fleet["histograms"].get("fragment.latency")
+        assert frag is not None and frag.count >= 3  # 3 partitions served
+        gauges = ctx.telemetry.gauges()
+        assert "fleet.fragment.latency.p99_s" in gauges
+        assert "fleet.query.latency.p99_s" in gauges
+        text = ctx.metrics_text()
+        assert 'name="fleet.fragment.latency.p99_s"' in text
+        top = ctx.top_text()
+        for host, port in addrs:
+            assert f"{host}:{port}" in top
+        # SLO burn gauges ride the same scrape once an objective arms
+        slo.WATCHDOG.add(slo.Objective("fleet_p99", "p99", 60.0))
+        try:
+            ctx.metrics_text()
+            assert "slo.fleet_p99.burn_rate" in METRICS.gauges
+        finally:
+            slo.WATCHDOG.objectives.pop()
+
+    def test_worker_flight_dump_request(self, workers, flight):
+        tmpdir, addrs = workers
+        ctx = self._ctx(tmpdir, addrs)
+        ctx.sql_collect("SELECT region, SUM(v) FROM t GROUP BY region")
+        dumped = [w.flight_dump() for w in ctx.workers]
+        assert all(d is not None for d in dumped)
+        kinds = {e["kind"] for d in dumped for e in d["events"]}
+        assert "fragment.serve" in kinds
+
+    def test_slow_distributed_query_artifact_set(self, workers, flight,
+                                                 tmp_path):
+        tmpdir, addrs = workers
+        recorder.configure(slow_s=0.0, directory=str(tmp_path))
+        ctx = self._ctx(tmpdir, addrs)
+        res = ctx.sql_collect(
+            "EXPLAIN ANALYZE SELECT region, SUM(v) FROM t GROUP BY region"
+        )
+        dumps = glob.glob(os.path.join(str(tmp_path), "flight-*.json"))
+        docs = [json.loads(open(p, encoding="utf-8").read())
+                for p in dumps]
+        doc = next(d for d in docs if d["reason"] == "slow_query")
+        # one correlated artifact: local events + every worker's ring +
+        # the stitched OTLP trace + the operator report
+        assert set(doc["nodes"]) == {f"{h}:{p}" for h, p in addrs}
+        worker_kinds = {
+            e["kind"]
+            for nd in doc["nodes"].values() for e in nd["events"]
+        }
+        assert "fragment.serve" in worker_kinds
+        otlp_spans = otlp.otlp_to_spans(doc["otlp"])
+        procs = {s["proc"] for s in otlp_spans}
+        assert any(p.startswith("worker") for p in procs)
+        assert any(p.startswith("main") for p in procs)
+        assert res.spans  # the analyzed run itself succeeded
