@@ -41,7 +41,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m datafusion_tpu.analysis",
         description="datafusion-tpu invariant linter "
-                    "(project rules DF001-DF005)",
+                    "(project rules DF001-DF006)",
     )
     ap.add_argument("paths", nargs="*", default=["datafusion_tpu"],
                     help="files/directories to lint "

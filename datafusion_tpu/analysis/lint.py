@@ -30,6 +30,13 @@ class of bug that once cost a debugging session:
   (``obs/stats.py``): they run inside other subsystems' critical
   sections (CacheStore eviction, retry loops), where taking a lock
   would build silent lock-order edges.
+- **DF006 raw-device-put** — no ``jax.device_put`` reference outside
+  ``obs/device.py``: every device placement goes through the HBM
+  residency ledger seam (``LEDGER.put``/``transfer``/``adopt``), or
+  the live-bytes/peak-watermark gauges silently under-count and the
+  transfer profiler misses the copy.  The one reviewed exception is
+  the link-rate probe (``exec/batch.py``), which must measure the raw
+  transport without the ledger's bookkeeping inside the timed region.
 
 Suppression: append ``# df-lint: ok(DF00N)`` (or a blanket
 ``# df-lint: ok``) to the offending line, with a justification — the
@@ -267,17 +274,25 @@ class LockInMetricsCallback(_Rule):
     id = "DF005"
 
     _STATS_FNS = ("record_h2d", "record_d2h", "record_retry",
-                  "record_launch", "current_op")
+                  "record_launch", "current_op",
+                  "record_h2d_time", "record_d2h_time")
     # the flight recorder's emit path carries the same contract: it is
     # called inside other subsystems' critical sections (cluster state
     # lock, device dispatch) and must never acquire a lock
     _RECORDER_FNS = ("record", "observe", "observe_latency")
+    # the device ledger's put/adopt/release path (obs/device.py)
+    # advertises the same lock-free contract in its module doc — this
+    # list keeps it enforced, not just documented (weakref finalizers
+    # especially run at arbitrary refcount drops, possibly while other
+    # subsystems hold locks)
+    _DEVICE_FNS = ("put", "transfer", "adopt", "retag", "_register",
+                   "_release", "note_h2d", "sweep", "record_d2h")
 
     def applies(self, relpath: str) -> bool:
         p = relpath.replace(os.sep, "/")
         return p.endswith(("utils/metrics.py", "obs/stats.py",
                            "obs/recorder.py", "obs/aggregate.py",
-                           "obs/slo.py"))
+                           "obs/slo.py", "obs/device.py"))
 
     def _scan(self, node, relpath, where):
         out = []
@@ -318,14 +333,50 @@ class LockInMetricsCallback(_Rule):
         p = relpath.replace(os.sep, "/")
         if p.endswith("utils/metrics.py"):
             return self._scan(tree, relpath, "utils/metrics.py")
-        wanted = (self._RECORDER_FNS
-                  if p.endswith(("obs/recorder.py", "obs/aggregate.py",
-                                 "obs/slo.py"))
-                  else self._STATS_FNS)
+        if p.endswith("obs/device.py"):
+            wanted = self._DEVICE_FNS
+        elif p.endswith(("obs/recorder.py", "obs/aggregate.py",
+                         "obs/slo.py")):
+            wanted = self._RECORDER_FNS
+        else:
+            wanted = self._STATS_FNS
         out = []
         for fn in _functions_in(tree):
             if fn.name in wanted:
                 out.extend(self._scan(fn, relpath, f"{fn.name}()"))
+        return out
+
+
+class RawDevicePut(_Rule):
+    """DF006: raw jax.device_put outside the obs/device.py ledger seam."""
+
+    id = "DF006"
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace(os.sep, "/")
+        return not p.endswith("obs/device.py")
+
+    def check(self, tree, relpath):
+        # flag every REFERENCE, not just calls: `put = jax.device_put`
+        # aliases escape a call-only rule, and the ledger seam only
+        # stays load-bearing if nothing routes around it
+        out = []
+        for sub in ast.walk(tree):
+            name = None
+            if isinstance(sub, ast.Attribute) and sub.attr == "device_put":
+                name = "jax.device_put" if (
+                    isinstance(sub.value, ast.Name)
+                ) else "device_put"
+            elif isinstance(sub, ast.Name) and sub.id == "device_put":
+                name = "device_put"
+            if name is not None:
+                out.append(self._finding(
+                    relpath, sub,
+                    f"raw {name} bypasses the HBM residency ledger "
+                    "(obs/device.py): use LEDGER.put/transfer/adopt so "
+                    "live-bytes, the peak watermark, and the transfer "
+                    "profiler see the placement",
+                ))
         return out
 
 
@@ -335,6 +386,7 @@ RULES: list[_Rule] = [
     UnguardedIoBoundary(),
     SwallowedBroadExcept(),
     LockInMetricsCallback(),
+    RawDevicePut(),
 ]
 
 

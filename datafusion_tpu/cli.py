@@ -173,6 +173,13 @@ class Console:
             # on a DistributedContext, local-node otherwise
             self._print(fleet_top_text(self.ctx))
             return True
+        if cmd == "\\hbm":
+            # device-memory ledger view (obs/device.py): live/peak HBM
+            # bytes with the per-owner and per-device breakdowns
+            from datafusion_tpu.obs.device import LEDGER
+
+            self._print(LEDGER.report_text())
+            return True
         return False
 
     def _cluster_status(self) -> None:

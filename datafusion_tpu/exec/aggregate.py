@@ -1496,11 +1496,12 @@ class AggregateRelation(Relation):
                 c = chunk[0]
                 return device_call(
                     core.jit, c[0], c[1], c[2], c[3], c[4], c[5], state,
-                    c[6], params,
+                    c[6], params, _tag="agg",
                 )
             if not fused_mode:
                 return device_call(
-                    core.fused_jit, tuple(chunk), state, params
+                    core.fused_jit, tuple(chunk), state, params,
+                    _tag="agg.chunk",
                 )
             # one launch per shape-homogeneous batch group, padded to
             # the group-size ladder with zero-row (identity) entries so
@@ -1512,7 +1513,7 @@ class AggregateRelation(Relation):
                     c = chunk[idxs[0]]
                     state = device_call(
                         core.jit, c[0], c[1], c[2], c[3], c[4], c[5],
-                        state, c[6], params,
+                        state, c[6], params, _tag="agg",
                     )
                     continue
                 group = pad_group(
@@ -1523,7 +1524,7 @@ class AggregateRelation(Relation):
                 METRICS.add("fused.group_batches", len(idxs))
                 state = device_call(
                     core.group_jit, tuple(group), state, aux, str_aux,
-                    params,
+                    params, _tag="agg.group",
                 )
             return state
 
@@ -1693,15 +1694,17 @@ class AggregateRelation(Relation):
                 wire = ids_np.astype(np.int8)
             elif n_groups <= 32767:
                 wire = ids_np.astype(np.int16)
+        from datafusion_tpu.obs.device import LEDGER
+
         dev_wire = (
-            jax.device_put(wire, self.device)
+            LEDGER.put(wire, self.device, owner="agg.ids")
             if self.device is not None
-            else jnp.asarray(wire)
+            else LEDGER.adopt(jnp.asarray(wire), owner="agg.ids")
         )
         ids = (
             dev_wire
             if wire.dtype == np.int32
-            else _WIDEN_IDS_JIT(dev_wire)
+            else LEDGER.adopt(_WIDEN_IDS_JIT(dev_wire), owner="agg.ids")
         )
         batch.cache["group_ids"] = (self.encoder, ids)
         return ids

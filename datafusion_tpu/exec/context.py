@@ -465,6 +465,12 @@ class ExecutionContext:
         as fleet query latency."""
         if self._telemetry:
             rel._telemetry_query = type(plan).__name__
+            # stage-timer snapshot: the funnel diffs against this at
+            # completion to decompose the query into phases
+            # (decode/H2D/compile/execute/D2H — obs/device.py)
+            from datafusion_tpu.obs.device import phase_snapshot
+
+            rel._phase_before = phase_snapshot()
         return rel
 
     def _verify(self, plan: LogicalPlan) -> None:
@@ -490,7 +496,10 @@ class ExecutionContext:
                 raise ExecutionError(f"No datasource registered as {plan.table_name!r}")
             if plan.projection is not None:
                 ds = ds.with_projection(plan.projection)
-            return DataSourceRelation(ds)
+            # the table name rides the relation so the datasource
+            # boundary can feed the per-table scan histograms
+            # (`scan.<table>.latency` / `scan.<table>.bytes`)
+            return DataSourceRelation(ds, table_name=plan.table_name)
         if isinstance(plan, EmptyRelation):
             return _EmptyRelationExec()
         if isinstance(plan, Selection):
@@ -645,7 +654,10 @@ class ExecutionContext:
 
     def metrics_text(self) -> str:
         """Engine counters/timings in Prometheus text exposition format
-        (obs/export.py; `METRICS` is the single counter backend)."""
+        (obs/export.py; `METRICS` is the single counter backend), plus
+        this process's histogram quantiles (query latency, per-table
+        `scan.<t>.latency`/`scan.<t>.bytes`) as gauges."""
+        from datafusion_tpu.obs.aggregate import histogram_gauges
         from datafusion_tpu.obs.export import prometheus_text
 
-        return prometheus_text(METRICS)
+        return prometheus_text(METRICS, extra_gauges=histogram_gauges())

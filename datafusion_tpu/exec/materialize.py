@@ -369,6 +369,16 @@ def _query_telemetry(relation, label: str, wall_s: float, rows: int,
     from datafusion_tpu.obs import trace as obs_trace
     from datafusion_tpu.obs.aggregate import query_completed
 
+    # cold-path phase breakdown: diff the engine's stage timers against
+    # the snapshot taken when the query was telemetry-tagged
+    # (exec/context.py) — decode/H2D/compile/execute/D2H/other per
+    # query, in ms, riding the flight event and slow-query artifact
+    phases = None
+    before = getattr(relation, "_phase_before", None)
+    if before:  # empty snapshot = ledger disabled, no breakdown
+        from datafusion_tpu.obs.device import phase_breakdown, phase_ms
+
+        phases = phase_ms(phase_breakdown(before, wall_s)) or None
     tc = obs_trace.current_trace()
     query_completed(
         wall_s, rows=rows,
@@ -378,6 +388,7 @@ def _query_telemetry(relation, label: str, wall_s: float, rows: int,
         trace_id=None if tc is None else tc.trace_id,
         # the explain path exports the complete drained span set itself
         export_otlp=not getattr(relation, "_telemetry_skip_otlp", False),
+        phases=phases,
     )
 
 

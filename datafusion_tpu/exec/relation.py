@@ -98,10 +98,20 @@ class Relation:
 
 
 class DataSourceRelation(Relation):
-    """Adapts a DataSource into a Relation (reference `relation.rs:34-54`)."""
+    """Adapts a DataSource into a Relation (reference `relation.rs:34-54`).
 
-    def __init__(self, datasource):
+    When the scan knows its table name (the plan->operator boundary
+    passes it), each complete scan observes into the per-table
+    histograms `scan.<table>.latency` (seconds spent *producing*
+    batches — parse, decode, dictionary encode) and `scan.<table>.bytes`
+    (host bytes scanned), which merge fleet-wide like `query.latency`
+    (obs/aggregate.py).  Cost: one perf_counter pair per batch and two
+    histogram bumps per scan.
+    """
+
+    def __init__(self, datasource, table_name: Optional[str] = None):
         self.datasource = datasource
+        self.table_name = table_name
 
     @property
     def schema(self) -> Schema:
@@ -115,7 +125,38 @@ class DataSourceRelation(Relation):
         return f"Scan[{src}{f': {path}' if path else ''}]"
 
     def batches(self) -> Iterator[RecordBatch]:
-        return self.datasource.batches()
+        if self.table_name is None:
+            return self.datasource.batches()
+        return self._observed_batches()
+
+    def _observed_batches(self) -> Iterator[RecordBatch]:
+        import time as _time
+
+        from datafusion_tpu.obs.aggregate import observe_scan
+
+        produce_s = 0.0
+        nbytes = 0
+        it = self.datasource.batches()
+        try:
+            while True:
+                t0 = _time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    return
+                finally:
+                    produce_s += _time.perf_counter() - t0
+                for arr in batch.data:
+                    if isinstance(arr, np.ndarray):
+                        nbytes += arr.nbytes
+                for v in batch.validity:
+                    if isinstance(v, np.ndarray):
+                        nbytes += v.nbytes
+                yield batch
+        finally:
+            # observed once per scan, abandoned scans (bare LIMIT)
+            # included — partial work is still work the table cost us
+            observe_scan(self.table_name, produce_s, nbytes)
 
 
 def _host_routed(e, metas, in_schema, host_scalar: bool) -> bool:
@@ -504,6 +545,7 @@ class PipelineRelation(Relation):
                         np.int32(batch.num_rows),
                         mask_in,
                         self._params,
+                        _tag="pipeline",
                     )
             if core.proj_fns is None:
                 # filter-only: the input columns, untouched
@@ -580,7 +622,8 @@ class PipelineRelation(Relation):
                 if len(buf) == 1:
                     b, e, aux = buf[0]
                     outs = [device_call(
-                        core.jit, e[0], e[1], aux, e[2], e[3], self._params
+                        core.jit, e[0], e[1], aux, e[2], e[3],
+                        self._params, _tag="pipeline",
                     )]
                 else:
                     group = pad_group(
@@ -590,7 +633,8 @@ class PipelineRelation(Relation):
                     METRICS.add("fused.groups")
                     METRICS.add("fused.group_batches", len(buf))
                     outs = device_call(
-                        core.group_jit, tuple(group), buf[0][2], self._params
+                        core.group_jit, tuple(group), buf[0][2],
+                        self._params, _tag="pipeline.group",
                     )
             emitted = [
                 self._emit_kernel_output(b, list(cols), list(valids), mask)
@@ -662,11 +706,15 @@ class PipelineRelation(Relation):
         if batch.mask is None:
             return pm
         if hasattr(batch.mask, "copy_to_host_async"):  # device mask
+            from datafusion_tpu.obs.device import LEDGER
+
             global _MASK_AND_JIT
             if _MASK_AND_JIT is None:
                 _MASK_AND_JIT = jax.jit(lambda a, b: a & b)
             with device_scope(self.device):
-                return _MASK_AND_JIT(jax.device_put(pm), batch.mask)
+                return _MASK_AND_JIT(
+                    LEDGER.put(pm, None, owner="mask"), batch.mask
+                )
         return np.asarray(batch.mask) & pm
 
     def _device_mask(self, batch):

@@ -630,11 +630,13 @@ class SortRelation(Relation):
         key = ("sort_img", kp.index, None if self.device is None else repr(self.device))
         hit = batch.cache.get(key)
         if hit is None:
+            from datafusion_tpu.obs.device import LEDGER
+
             img = _TopKCore.f64_image(col)
             hit = (
-                jax.device_put(img, self.device)
+                LEDGER.put(img, self.device, owner="sort.image")
                 if self.device is not None
-                else jnp.asarray(img)
+                else LEDGER.adopt(jnp.asarray(img), owner="sort.image")
             )
             batch.cache[key] = hit
         return hit
@@ -731,9 +733,10 @@ class SortRelation(Relation):
                 args = [k, state, c[0], c[1], c[2], c[3], c[4], c[5]]
                 if core.wide:
                     args.append(c[6])
-                return device_call(topk_jit, *args)
+                return device_call(topk_jit, *args, _tag="topk")
             if not fused_mode:
-                return device_call(core.fused_jit, k, state, tuple(chunk))
+                return device_call(core.fused_jit, k, state, tuple(chunk),
+                                   _tag="topk.chunk")
             # one launch per shape-homogeneous batch group (lax.scan
             # over the stacked group), padded to the ladder with
             # zero-row entries that merge as all-dead
@@ -745,7 +748,7 @@ class SortRelation(Relation):
                     args = [k, state, c[0], c[1], c[2], c[3], c[4], c[5]]
                     if core.wide:
                         args.append(c[6])
-                    state = device_call(topk_jit, *args)
+                    state = device_call(topk_jit, *args, _tag="topk")
                     continue
                 group = pad_group(
                     [entries[i] for i in idxs],
@@ -754,7 +757,8 @@ class SortRelation(Relation):
                 METRICS.add("fused.groups")
                 METRICS.add("fused.group_batches", len(idxs))
                 state = device_call(
-                    core.group_jit, k, state, tuple(group), ranks
+                    core.group_jit, k, state, tuple(group), ranks,
+                    _tag="topk.group",
                 )
             return state
 

@@ -53,17 +53,45 @@ def bucket_upper_bound_s(i: int) -> float:
 
 
 class LatencyHistogram:
-    """Mergeable log2 latency histogram with quantile estimation."""
+    """Mergeable log2 histogram with quantile estimation.
 
-    __slots__ = ("buckets", "count", "sum_s")
+    Default geometry covers latencies ([1us, ~137s) over 28 buckets);
+    a custom ``base``/``nbuckets`` re-purposes the same machinery for
+    other log2-distributed values — the per-table ``scan.<t>.bytes``
+    histograms use base=1 byte over 48 buckets (~140TB ceiling).  The
+    geometry rides the snapshot so fleet merges reconstruct it."""
 
-    def __init__(self):
-        self.buckets = [0] * _BUCKETS
+    __slots__ = ("buckets", "count", "sum_s", "base", "nbuckets")
+
+    def __init__(self, base: float = _BASE_S, nbuckets: int = _BUCKETS):
+        self.base = float(base)
+        self.nbuckets = int(nbuckets)
+        self.buckets = [0] * self.nbuckets
         self.count = 0
         self.sum_s = 0.0
 
+    @classmethod
+    def empty_like(cls, other) -> "LatencyHistogram":
+        """A fresh zero histogram with ``other``'s geometry (``other``
+        may be an instance or a snapshot dict)."""
+        if isinstance(other, dict):
+            bk = other.get("buckets") or []
+            return cls(base=float(other.get("base", _BASE_S)),
+                       nbuckets=max(len(bk), 1) if bk else _BUCKETS)
+        return cls(base=other.base, nbuckets=other.nbuckets)
+
+    def _index(self, value: float) -> int:
+        if value <= self.base:
+            return 0
+        return min(int(math.log2(value / self.base)) + 1, self.nbuckets - 1)
+
+    def _upper(self, i: int) -> float:
+        if i >= self.nbuckets - 1:
+            return math.inf
+        return self.base * (2.0 ** i)
+
     def observe(self, seconds: float) -> None:
-        self.buckets[_bucket_index(seconds)] += 1
+        self.buckets[self._index(seconds)] += 1
         self.count += 1
         self.sum_s += seconds
 
@@ -71,12 +99,12 @@ class LatencyHistogram:
         """Fold another histogram (object or snapshot dict) in."""
         if isinstance(other, dict):
             bk = other.get("buckets") or []
-            for i, n in enumerate(bk[:_BUCKETS]):
+            for i, n in enumerate(bk[:self.nbuckets]):
                 self.buckets[i] += int(n)
             self.count += int(other.get("count", sum(int(n) for n in bk)))
             self.sum_s += float(other.get("sum_s", 0.0))
         else:
-            for i in range(_BUCKETS):
+            for i in range(min(self.nbuckets, other.nbuckets)):
                 self.buckets[i] += other.buckets[i]
             self.count += other.count
             self.sum_s += other.sum_s
@@ -93,7 +121,7 @@ class LatencyHistogram:
         for i, n in enumerate(self.buckets):
             seen += n
             if seen >= rank:
-                ub = bucket_upper_bound_s(i)
+                ub = self._upper(i)
                 if math.isinf(ub):
                     break  # overflow bucket: no finite bound
                 return ub
@@ -103,15 +131,18 @@ class LatencyHistogram:
         # members dominate).  Never the plain mean — 2 hung 200s
         # queries among 98 fast ones would render a "4s p99" during an
         # incident where the true tail is 50x that.
-        return max(bucket_upper_bound_s(_BUCKETS - 2),
+        return max(self._upper(self.nbuckets - 2),
                    self.sum_s / self.count)
 
     def snapshot(self) -> dict:
-        return {
+        out = {
             "buckets": list(self.buckets),
             "count": self.count,
             "sum_s": self.sum_s,
         }
+        if self.base != _BASE_S:
+            out["base"] = self.base
+        return out
 
     def __repr__(self):
         return (f"LatencyHistogram(n={self.count}, "
@@ -137,16 +168,74 @@ def reset_histograms() -> None:
     HISTOGRAMS.clear()
 
 
+# scan-bytes histogram geometry: base 1 byte, 48 buckets (~140TB cap)
+_BYTES_BASE = 1.0
+_BYTES_BUCKETS = 48
+
+
+def observe_scan(table: str, seconds: float, nbytes: int) -> None:
+    """One complete table scan at the datasource boundary: latency into
+    ``scan.<table>.latency`` (default log2-latency geometry) and host
+    bytes scanned into ``scan.<table>.bytes`` (log2-bytes geometry).
+    Both merge fleet-wide exactly like ``query.latency``."""
+    observe_latency(f"scan.{table}.latency", seconds)
+    name = f"scan.{table}.bytes"
+    h = HISTOGRAMS.get(name)
+    if h is None:
+        h = HISTOGRAMS.setdefault(
+            name, LatencyHistogram(base=_BYTES_BASE, nbuckets=_BYTES_BUCKETS)
+        )
+    h.observe(float(nbytes))
+
+
+def histogram_gauges(hists: Optional[dict] = None,
+                     prefix: str = "") -> dict:
+    """Quantile/count gauges for a histogram set (the local scrape's
+    view of HISTOGRAMS; the fleet aggregator passes its merged set with
+    prefix="fleet.").  ``.bytes`` histograms label their quantiles
+    without the ``_s`` unit suffix."""
+    out: dict = {}
+    for name, h in sorted((hists if hists is not None
+                           else HISTOGRAMS).items()):
+        unit = "" if name.endswith(".bytes") else "_s"
+        for q, label in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            v = h.quantile(q)
+            if v is not None:
+                out[f"{prefix}{name}.{label}{unit}"] = (
+                    round(v) if not unit else round(v, 6)
+                )
+        out[f"{prefix}{name}.count"] = h.count
+    return out
+
+
 def node_snapshot() -> dict:
     """This process's telemetry snapshot: the histogram set plus the
     flat counter/gauge registries — the payload a worker piggybacks on
     its cluster heartbeat and folds into its status response."""
+    # refresh the device-ledger gauges first: live_bytes() recomputes
+    # the exact sum (correcting any lock-free-writer drift) and rewrites
+    # device.hbm.live_bytes/peak_bytes, so the piggybacked snapshot —
+    # and every fleet.hbm.* sum derived from it — reports measured
+    # residency, not the last put's running estimate.  A ledger-off
+    # node publishes NO hbm gauges at all: a zero from a node that
+    # measures nothing would sum into fleet.hbm.* looking like a
+    # measured empty device
+    from datafusion_tpu.obs import device as _device
+
+    if _device.enabled():
+        _device.LEDGER.live_bytes()
     snap = METRICS.snapshot()
+    gauges = snap["gauges"]
+    if not _device.enabled():
+        gauges = {
+            k: v for k, v in gauges.items()
+            if not k.startswith("device.hbm.")
+        }
     return {
         "ts": time.time(),
         "histograms": {k: h.snapshot() for k, h in HISTOGRAMS.items()},
         "counts": snap["counts"],
-        "gauges": snap["gauges"],
+        "gauges": gauges,
     }
 
 
@@ -166,7 +255,8 @@ def query_completed(wall_s: float, rows: Optional[int] = None,
                     root=None, label: Optional[str] = None,
                     error: Optional[str] = None,
                     trace_id: Optional[str] = None,
-                    export_otlp: bool = True) -> None:
+                    export_otlp: bool = True,
+                    phases: Optional[dict] = None) -> None:
     """The per-query telemetry funnel, called once per root query at
     the materialization boundary (exec/materialize.py) — success or
     failure.  Feeds the latency histogram and the SLO watchdog,
@@ -187,7 +277,14 @@ def query_completed(wall_s: float, rows: Optional[int] = None,
         recorder.record(
             "query.done" if error is None else "query.error",
             wall_s=round(wall_s, 6), rows=rows, label=label, error=error,
+            phases=phases,
         )
+        # device-ledger leak sweep: non-cache buffers this query placed
+        # that outlive it become candidates; earlier candidates still
+        # alive past the grace report as leaks (obs/device.py)
+        from datafusion_tpu.obs.device import LEDGER
+
+        LEDGER.sweep(trace_id)
         slow = error is None and wall_s >= recorder.slow_threshold_s()
         if slow:
             METRICS.add("flight.slow_queries")
@@ -200,7 +297,7 @@ def query_completed(wall_s: float, rows: Optional[int] = None,
             recorder.capture_query_artifacts(
                 "slow_query" if slow else "query_failure",
                 wall_s=wall_s, trace_id=trace_id, root=root, label=label,
-                error=error,
+                error=error, phases=phases,
                 node_dumps_fn=(
                     None if dumps_fn is None
                     else lambda: dumps_fn(trace_id)
@@ -262,11 +359,23 @@ class FleetAggregator:
         nodes = self.nodes()
         hists: dict[str, LatencyHistogram] = {}
         counts: dict[str, float] = {}
+        hbm: dict[str, float] = {}
         for snap in nodes.values():
             for name, h in (snap.get("histograms") or {}).items():
-                hists.setdefault(name, LatencyHistogram()).merge(h)
+                tgt = hists.get(name)
+                if tgt is None:
+                    # geometry rides the snapshot (scan-bytes histograms
+                    # use a different base than latency ones)
+                    tgt = hists[name] = LatencyHistogram.empty_like(h)
+                tgt.merge(h)
             for name, n in (snap.get("counts") or {}).items():
                 counts[name] = counts.get(name, 0) + n
+            # device-ledger residency sums across the fleet: every
+            # node's HBM live/peak gauges fold into fleet.hbm.*
+            g = snap.get("gauges") or {}
+            for name in ("device.hbm.live_bytes", "device.hbm.peak_bytes"):
+                if name in g:
+                    hbm[name] = hbm.get(name, 0) + float(g[name])
         derived = {
             "result_cache_hit_rate": _rate(
                 counts.get("cache.result.hits", 0),
@@ -283,18 +392,20 @@ class FleetAggregator:
                 / counts["fused.groups"]),
         }
         return {"nodes": len(nodes), "node_names": sorted(nodes),
-                "histograms": hists, "counts": counts, "derived": derived}
+                "histograms": hists, "counts": counts, "derived": derived,
+                "hbm": hbm}
 
     def gauges(self) -> dict:
         """Fleet gauges for ``prometheus_text(extra_gauges=...)``."""
         f = self.fleet()
         out: dict = {"fleet.nodes": f["nodes"]}
-        for name, h in sorted(f["histograms"].items()):
-            for q, label in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-                v = h.quantile(q)
-                if v is not None:
-                    out[f"fleet.{name}.{label}_s"] = round(v, 6)
-            out[f"fleet.{name}.count"] = h.count
+        out.update(histogram_gauges(f["histograms"], prefix="fleet."))
+        # fleet HBM residency: summed device-ledger gauges — the fleet-
+        # wide answer to "how much accelerator memory is pinned"
+        if "device.hbm.live_bytes" in f["hbm"]:
+            out["fleet.hbm.live_bytes"] = int(f["hbm"]["device.hbm.live_bytes"])
+        if "device.hbm.peak_bytes" in f["hbm"]:
+            out["fleet.hbm.peak_bytes"] = int(f["hbm"]["device.hbm.peak_bytes"])
         for name, v in f["derived"].items():
             if v is not None:
                 out[f"fleet.{name}"] = round(v, 4)
@@ -336,6 +447,15 @@ class FleetAggregator:
             + ("" if d["launches_per_pass"] is None
                else f"   launches/pass={d['launches_per_pass']:.2f}")
         )
+        if f.get("hbm"):
+            from datafusion_tpu.obs.device import _fmt_bytes
+
+            live = f["hbm"].get("device.hbm.live_bytes", 0)
+            peak = f["hbm"].get("device.hbm.peak_bytes", 0)
+            lines.append(
+                f"  hbm: live={_fmt_bytes(live)} peak={_fmt_bytes(peak)} "
+                f"(device ledger, fleet sum)"
+            )
         admitted = f["counts"].get("queries_admitted", 0)
         shed = f["counts"].get("queries_shed", 0)
         lines.append(
@@ -360,6 +480,11 @@ class FleetAggregator:
                     f"repl_lag={g['cluster.replication_lag_revisions']}")
             if g.get("cluster.lease_age_s") is not None:
                 extras.append(f"lease_age={g['cluster.lease_age_s']}s")
+            if g.get("device.hbm.live_bytes"):
+                from datafusion_tpu.obs.device import _fmt_bytes
+
+                extras.append(
+                    f"hbm={_fmt_bytes(g['device.hbm.live_bytes'])}")
             lines.append(
                 f"  node {addr}: work={h.count} p50={_q(h, 0.5)} "
                 f"p99={_q(h, 0.99)} launches="

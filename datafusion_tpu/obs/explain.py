@@ -15,17 +15,8 @@ import time
 from typing import Optional
 
 from datafusion_tpu.obs import trace
+from datafusion_tpu.obs.device import _fmt_bytes
 from datafusion_tpu.obs.stats import collect_tree, iter_stats
-
-
-def _fmt_bytes(n: int) -> str:
-    if n >= 1 << 30:
-        return f"{n / (1 << 30):.2f}GiB"
-    if n >= 1 << 20:
-        return f"{n / (1 << 20):.2f}MiB"
-    if n >= 1 << 10:
-        return f"{n / (1 << 10):.1f}KiB"
-    return f"{n}B"
 
 
 def _fmt_s(s: float) -> str:
@@ -91,7 +82,8 @@ class ExplainAnalyzeResult:
     the annotated report; `chrome_trace()` exports the timeline."""
 
     def __init__(self, plan, root, result, spans: list[dict],
-                 trace_id: str, wall_s: float, counters: Optional[dict] = None):
+                 trace_id: str, wall_s: float, counters: Optional[dict] = None,
+                 phases: Optional[dict] = None, hbm: Optional[dict] = None):
         self.plan = plan
         self.root = root
         self.result = result
@@ -102,10 +94,26 @@ class ExplainAnalyzeResult:
         # cache hits/misses, fused batch groups) — the fused-pass
         # observability satellite
         self.counters = counters or {}
+        # cold-path phase breakdown (seconds per phase, obs/device.py)
+        # and the query's HBM residency watermark from the device ledger
+        self.phases = phases or {}
+        self.hbm = hbm or {}
 
     def report(self) -> str:
         lines = [f"EXPLAIN ANALYZE  (trace {self.trace_id}, "
                  f"wall {_fmt_s(self.wall_s)}, rows {self.result.num_rows})"]
+        if self.phases:
+            from datafusion_tpu.obs.device import phase_bar
+
+            lines.append(
+                "Phases: " + phase_bar(self.phases, self.wall_s)
+            )
+        if self.hbm:
+            lines.append(
+                f"HBM: peak {_fmt_bytes(self.hbm.get('peak_bytes', 0))} "
+                f"(live {_fmt_bytes(self.hbm.get('live_bytes', 0))}, "
+                f"{self.hbm.get('buffers', 0)} buffer(s); device ledger)"
+            )
         for depth, rel in collect_tree(self.root):
             fused_chain = getattr(rel, "_fused_chain", None)
             marker = f"  <- fused pass [{fused_chain}]" if fused_chain else ""
@@ -181,6 +189,11 @@ class _RootTap:
             # the funnel's operator-report walk needs the real tree,
             # not this facade
             self._telemetry_root = rel
+            # ...and the phase breakdown needs the pre-query stage-timer
+            # snapshot the context stamped on the real relation
+            pb = getattr(rel, "_phase_before", None)
+            if pb is not None:
+                self._phase_before = pb
             # explain_analyze exports the COMPLETE drained span set
             # after the run; the funnel's in-flight export would ship
             # an overlapping document missing only the root span
@@ -208,12 +221,33 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
                 "kernel_cache.misses", "fused.groups",
                 "fused.group_batches", "coord.plan_rejected")
     before = dict(METRICS.counts)
-    with trace.session() as tc:
+    # device data-plane instruments (obs/device.py): the phase
+    # breakdown diffs the stage timers across the run, and a peak
+    # WINDOW makes peak_bytes THIS query's high-water mark without
+    # clobbering the process-wide watermark scrapes and fleet.hbm
+    # aggregation report
+    from datafusion_tpu.obs import device as _device
+    from datafusion_tpu.obs.device import (
+        LEDGER,
+        phase_breakdown,
+        phase_snapshot,
+    )
+
+    phase_before = phase_snapshot()
+    LEDGER.begin_peak_window()
+    # profile_sync: launches block on completion inside this run, so
+    # the "execute" phase measures device wall instead of async
+    # dispatch (which would fold real compute into "d2h")
+    with trace.session() as tc, _device.profile_sync():
         t0 = time.perf_counter()
         with trace.span("query", plan=type(plan).__name__):
             rel = ctx.execute(plan)
             table = collect(_RootTap(rel))
         wall = time.perf_counter() - t0
+    phases = phase_breakdown(phase_before, wall)
+    hbm = {"peak_bytes": LEDGER.window_peak_bytes(),
+           "live_bytes": LEDGER.live_bytes(),
+           "buffers": LEDGER.entries} if _device.enabled() else {}
     counters = {
         k: METRICS.counts.get(k, 0) - before.get(k, 0) for k in _WATCHED
     }
@@ -231,5 +265,6 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
 
     export_spans(spans)
     return ExplainAnalyzeResult(
-        plan, rel, table, spans, tc.trace_id, wall, counters
+        plan, rel, table, spans, tc.trace_id, wall, counters,
+        phases=phases, hbm=hbm,
     )

@@ -15,18 +15,32 @@ Export targets (both stdlib-only, both optional):
 - ``write_otlp(path, spans)`` — a JSON file;
 - ``post_otlp(endpoint, spans)`` — HTTP POST of the JSON document
   (``urllib.request``; the conventional collector path is
-  ``http://host:4318/v1/traces``).
+  ``http://host:4318/v1/traces``), gzip-compressed by default
+  (``Content-Encoding: gzip`` — OTLP/HTTP collectors accept it, and
+  span JSON compresses ~10x).
 
 ``export_spans(spans)`` routes to whichever of
 ``DATAFUSION_TPU_OTLP_FILE`` / ``DATAFUSION_TPU_OTLP_ENDPOINT`` is
-set.  ``otlp_to_spans`` is the exact inverse of ``spans_to_otlp`` —
-the schema round-trip the test suite locks.
+set.  The HTTP route *batches*: each query's spans enqueue, and one
+POST ships every queued query when the batch reaches
+``DATAFUSION_TPU_OTLP_BATCH_SPANS`` spans (default 512) or the
+bounded flush interval ``DATAFUSION_TPU_OTLP_FLUSH_S`` (default 2 s,
+armed by a daemon timer at first enqueue) elapses — a serving fleet
+doing hundreds of queries per second must not do hundreds of collector
+round trips per second.  ``flush()`` forces the pending batch out
+(also registered atexit); ``DATAFUSION_TPU_OTLP_FLUSH_S=0`` restores
+one-POST-per-query.  ``DATAFUSION_TPU_OTLP_GZIP=0`` disables
+compression.  ``otlp_to_spans`` is the exact inverse of
+``spans_to_otlp`` — the schema round-trip the test suite locks.
 """
 
 from __future__ import annotations
 
+import atexit as _atexit
+import gzip as _gzip
 import json
 import os
+import threading
 from typing import Optional
 
 from datafusion_tpu.utils.metrics import METRICS
@@ -143,17 +157,37 @@ def write_otlp(path: str, span_dicts: list[dict]) -> str:
     return path
 
 
+def _gzip_enabled() -> bool:
+    return os.environ.get("DATAFUSION_TPU_OTLP_GZIP", "1") != "0"
+
+
+def _flush_interval_s() -> float:
+    return float(os.environ.get("DATAFUSION_TPU_OTLP_FLUSH_S", "2") or 2)
+
+
+def _batch_spans() -> int:
+    return int(os.environ.get("DATAFUSION_TPU_OTLP_BATCH_SPANS", "512")
+               or 512)
+
+
 def post_otlp(endpoint: str, span_dicts: list[dict],
-              timeout_s: float = 5.0) -> int:
+              timeout_s: float = 5.0,
+              compress: Optional[bool] = None) -> int:
     """POST the OTLP/JSON document to an HTTP endpoint; returns the
-    response status.  Raises on transport errors — callers on query
-    paths go through ``export_spans``, which never does."""
+    response status.  The body is gzip-compressed with
+    ``Content-Encoding: gzip`` unless ``compress`` (default: the
+    ``DATAFUSION_TPU_OTLP_GZIP`` env knob) is false.  Raises on
+    transport errors — callers on query paths go through
+    ``export_spans``, which never does."""
     import urllib.request
 
     body = json.dumps(spans_to_otlp(span_dicts)).encode("utf-8")
+    headers = {"Content-Type": "application/json"}
+    if _gzip_enabled() if compress is None else compress:
+        body = _gzip.compress(body)
+        headers["Content-Encoding"] = "gzip"
     req = urllib.request.Request(
-        endpoint, data=body, method="POST",
-        headers={"Content-Type": "application/json"},
+        endpoint, data=body, method="POST", headers=headers,
     )
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:  # noqa: S310 — operator-configured endpoint
         status = int(getattr(resp, "status", 200))
@@ -161,14 +195,86 @@ def post_otlp(endpoint: str, span_dicts: list[dict],
     return status
 
 
+# -- HTTP batching ----------------------------------------------------
+# spans queued for the endpoint, guarded by a plain lock (this is the
+# background export path, never inside a metrics callback or another
+# subsystem's critical section — DF005 does not apply here)
+_pending: list[dict] = []
+_pending_lock = threading.Lock()
+_flush_timer: Optional[threading.Timer] = None
+
+
+def pending() -> int:
+    """Spans queued for the next batched POST (tests/introspection)."""
+    return len(_pending)
+
+
+def flush() -> Optional[int]:
+    """Ship the pending batch to ``DATAFUSION_TPU_OTLP_ENDPOINT`` as
+    ONE gzip'd POST.  Returns the HTTP status, or None when nothing was
+    pending / no endpoint is configured / the POST failed (counted in
+    ``obs.otlp_errors``, never raised).  Called by the flush timer, on
+    batch overflow, and atexit."""
+    global _flush_timer
+    with _pending_lock:
+        batch = list(_pending)
+        _pending.clear()
+        if _flush_timer is not None:
+            _flush_timer.cancel()
+            _flush_timer = None
+    if not batch:
+        return None
+    endpoint = os.environ.get("DATAFUSION_TPU_OTLP_ENDPOINT")
+    if not endpoint:
+        # spans were enqueued while an endpoint was configured, but it
+        # is gone now (env mutated mid-run): the batch is lost — count
+        # it so loss is distinguishable from idle
+        METRICS.add("obs.otlp_errors")
+        return None
+    try:
+        status = post_otlp(endpoint, batch)
+    except Exception:  # noqa: BLE001 — export is best-effort by contract
+        METRICS.add("obs.otlp_errors")
+        return None
+    METRICS.add("obs.otlp_batches")
+    return status
+
+
+def _enqueue(span_dicts: list[dict]) -> int:
+    """Queue one query's spans for the batched POST; arms the bounded
+    flush timer on first enqueue, flushes inline on batch overflow.
+    Returns the number of spans now pending (0 = an overflow flush just
+    shipped them)."""
+    global _flush_timer
+    overflow = False
+    with _pending_lock:
+        _pending.extend(span_dicts)
+        n = len(_pending)
+        if n >= _batch_spans():
+            overflow = True
+        elif _flush_timer is None:
+            t = threading.Timer(_flush_interval_s(), flush)
+            t.daemon = True
+            t.start()
+            _flush_timer = t
+    if overflow:
+        flush()
+        return 0
+    return n
+
+
+_atexit.register(flush)  # trailing batch ships at interpreter exit
+
+
 def export_spans(span_dicts: list[dict]) -> Optional[str]:
     """Best-effort export to the env-configured OTLP target(s):
     ``DATAFUSION_TPU_OTLP_FILE`` appends one JSON document per line
     (a long-lived worker's successive exports stay parseable);
-    ``DATAFUSION_TPU_OTLP_ENDPOINT`` POSTs.  Returns a description of
-    where the spans went, or None when no target is configured or the
-    export failed (counted, never raised — span export must not fail
-    the query that produced the spans)."""
+    ``DATAFUSION_TPU_OTLP_ENDPOINT`` enqueues for the batched gzip'd
+    POST (or POSTs immediately when ``DATAFUSION_TPU_OTLP_FLUSH_S=0``).
+    Returns a description of where the spans went, or None when no
+    target is configured or the export failed (counted, never raised —
+    span export must not fail the query that produced the spans)."""
     if not span_dicts:
         return None
     where = []
@@ -183,8 +289,12 @@ def export_spans(span_dicts: list[dict]) -> Optional[str]:
             METRICS.add("obs.otlp_exported", len(span_dicts))
             where.append(path)
         if endpoint:
-            post_otlp(endpoint, span_dicts)
-            where.append(endpoint)
+            if _flush_interval_s() <= 0:
+                post_otlp(endpoint, span_dicts)
+                where.append(endpoint)
+            else:
+                n = _enqueue(span_dicts)
+                where.append(f"{endpoint} (batched, {n} pending)")
     except Exception:  # noqa: BLE001 — export is best-effort by contract
         METRICS.add("obs.otlp_errors")
         return None

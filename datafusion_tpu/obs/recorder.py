@@ -259,6 +259,7 @@ def capture_query_artifacts(reason: str, *, wall_s: Optional[float] = None,
                             trace_id: Optional[str] = None,
                             root=None, label: Optional[str] = None,
                             error: Optional[str] = None,
+                            phases: Optional[dict] = None,
                             node_dumps_fn: Optional[Callable[[], dict]] = None,
                             ) -> Optional[str]:
     """The single correlated artifact set for a slow or failed query:
@@ -266,9 +267,10 @@ def capture_query_artifacts(reason: str, *, wall_s: Optional[float] = None,
     (``node_dumps_fn``: addr -> event list, gathered over the wire by
     the distributed coordinator — invoked LAZILY, so a throttled
     capture never touches the network), the query's span tree as a
-    stitched OTLP/JSON trace document, and the EXPLAIN ANALYZE-style
-    operator report when the run was instrumented.  One file, one
-    query, every layer."""
+    stitched OTLP/JSON trace document, the cold-path phase breakdown
+    (``phases``: per-phase ms from obs/device.py, when the run was
+    telemetry-tagged), and the EXPLAIN ANALYZE-style operator report
+    when the run was instrumented.  One file, one query, every layer."""
 
     def _extra() -> dict:
         from datafusion_tpu.obs import trace as obs_trace
@@ -281,6 +283,8 @@ def capture_query_artifacts(reason: str, *, wall_s: Optional[float] = None,
             "trace_id": trace_id,
             "error": error,
         }}
+        if phases:
+            extra["query"]["phases"] = dict(phases)
         if spans:
             extra["otlp"] = spans_to_otlp(spans)
         if node_dumps_fn is not None:

@@ -22,12 +22,21 @@ Declaration is env-driven so fleets configure it without code:
     DATAFUSION_TPU_SLO_WARM_Q1_P99=0.5       # seconds at the quantile
     DATAFUSION_TPU_SLO_INGEST_P50=2.0
     DATAFUSION_TPU_SLO_ERROR_RATE=0.01       # allowed failure fraction
+    DATAFUSION_TPU_SLO_PRESSURE_HBM_FRAC=0.8 # allowed live-HBM fraction
     DATAFUSION_TPU_SLO_WINDOW_S=300          # sliding window (default)
     DATAFUSION_TPU_SLO_MIN_SAMPLES=20        # breach quorum (default)
 
 plus a programmatic API (``WATCHDOG.add(Objective(...))``) for
 embedded deployments.  No objectives declared = the watchdog is
 dormant: ``observe`` is one deque append, ``evaluate`` a no-op.
+
+``hbm_frac`` is a *memory-pressure* objective over the device ledger
+(obs/device.py) rather than the latency window: the burn rate is the
+measured live-HBM fraction over the allowed one, read fresh at each
+evaluation.  Device capacity comes from ``DATAFUSION_TPU_HBM_BYTES``
+or, when the backend exposes it, ``Device.memory_stats()``; with
+neither available the objective stays dormant (burn 0) instead of
+guessing.
 """
 
 from __future__ import annotations
@@ -45,14 +54,16 @@ _QUANTILES = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
 
 class Objective:
     """One declared objective.  ``kind`` is ``p50``/``p95``/``p99``
-    (``threshold`` = latency seconds at that quantile) or
-    ``error_rate`` (``threshold`` = allowed failure fraction)."""
+    (``threshold`` = latency seconds at that quantile), ``error_rate``
+    (``threshold`` = allowed failure fraction), or ``hbm_frac``
+    (``threshold`` = allowed live-HBM fraction of device capacity,
+    measured by the residency ledger)."""
 
     __slots__ = ("name", "kind", "threshold", "window_s")
 
     def __init__(self, name: str, kind: str, threshold: float,
                  window_s: Optional[float] = None):
-        if kind not in (*_QUANTILES, "error_rate"):
+        if kind not in (*_QUANTILES, "error_rate", "hbm_frac"):
             raise ValueError(f"unknown SLO kind {kind!r}")
         if threshold <= 0:
             raise ValueError(f"SLO threshold must be positive: {threshold}")
@@ -114,8 +125,35 @@ class SloWatchdog:
             self._window.popleft()
         return [s for s in self._window if s[0] >= cutoff]
 
+    def _hbm_burn(self, obj: Objective) -> dict:
+        """Memory-pressure burn: measured live-HBM fraction over the
+        allowance, read fresh from the device ledger.  Unknown device
+        capacity OR a disabled ledger = dormant (burn 0, samples 0),
+        never a guess — with DATAFUSION_TPU_DEVICE_LEDGER=0 nothing
+        registers, so live_bytes()=0 would read as a confidently
+        healthy device while HBM might be exhausted."""
+        from datafusion_tpu.obs import device as _device
+        from datafusion_tpu.obs.device import LEDGER, hbm_capacity_bytes
+
+        cap = hbm_capacity_bytes() if _device.enabled() else None
+        value = LEDGER.live_bytes() / cap if cap else 0.0
+        burn = value / obj.threshold
+        return {
+            "name": obj.name,
+            "kind": obj.kind,
+            "target": obj.threshold,
+            "samples": 1 if cap else 0,
+            "value": round(value, 6),
+            "burn_rate": round(burn, 4),
+            # a gauge objective needs no sample quorum — the reading
+            # is exact, not an estimate over a window
+            "breached": bool(cap) and burn >= 1.0,
+        }
+
     def _burn(self, obj: Objective,
               samples: list[tuple[float, float, bool]]) -> dict:
+        if obj.kind == "hbm_frac":
+            return self._hbm_burn(obj)
         n = len(samples)
         if obj.kind == "error_rate":
             bad = sum(1 for _, _, err in samples if err)
@@ -192,7 +230,8 @@ def objectives_from_env(environ=None) -> list[Objective]:
         kind = None
         name = None
         for tail, k in (("_P50", "p50"), ("_P95", "p95"), ("_P99", "p99"),
-                        ("_ERROR_RATE", "error_rate")):
+                        ("_ERROR_RATE", "error_rate"),
+                        ("_HBM_FRAC", "hbm_frac")):
             if suffix.endswith(tail):
                 kind, name = k, suffix[: -len(tail)].lower()
                 break

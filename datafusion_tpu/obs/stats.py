@@ -39,7 +39,8 @@ class OperatorStats:
     """
 
     __slots__ = ("rows_out", "batches_out", "time_s", "execute_s",
-                 "compile_s", "h2d_bytes", "d2h_bytes", "retries", "attrs")
+                 "compile_s", "h2d_bytes", "d2h_bytes", "h2d_s", "d2h_s",
+                 "retries", "attrs")
 
     def __init__(self):
         self.rows_out = 0
@@ -49,6 +50,8 @@ class OperatorStats:
         self.compile_s = 0.0
         self.h2d_bytes = 0
         self.d2h_bytes = 0
+        self.h2d_s = 0.0
+        self.d2h_s = 0.0
         self.retries = 0
         self.attrs: dict = {}
 
@@ -61,6 +64,8 @@ class OperatorStats:
             "compile_s": self.compile_s,
             "h2d_bytes": self.h2d_bytes,
             "d2h_bytes": self.d2h_bytes,
+            "h2d_s": self.h2d_s,
+            "d2h_s": self.d2h_s,
             "retries": self.retries,
         }
         if self.attrs:
@@ -86,6 +91,20 @@ def record_d2h(nbytes: int) -> None:
     st = _CUR_OP.get()
     if st is not None:
         st.d2h_bytes += nbytes
+
+
+def record_h2d_time(seconds: float) -> None:
+    """Attribute H2D transfer wall to the ambient operator (the ledger
+    seam in obs/device.py calls this beside the byte counters)."""
+    st = _CUR_OP.get()
+    if st is not None:
+        st.h2d_s += seconds
+
+
+def record_d2h_time(seconds: float) -> None:
+    st = _CUR_OP.get()
+    if st is not None:
+        st.d2h_s += seconds
 
 
 def record_retry() -> None:

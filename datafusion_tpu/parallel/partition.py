@@ -27,6 +27,7 @@ is needed until the final combine).
 
 from __future__ import annotations
 
+import time
 from typing import Iterator, Optional, Sequence
 
 import jax
@@ -196,17 +197,47 @@ class _MeshStacker:
         out[: arr.shape[0]] = arr
         return out
 
-    def put(self, shards: Sequence[np.ndarray]):
+    def put(self, shards: Sequence[np.ndarray], owner: str = "mesh.shard"):
         """One [n, cap] mesh-sharded array from n cap-length host
-        arrays (shards[i] lands on mesh device i, no reshard)."""
+        arrays (shards[i] lands on mesh device i, no reshard).  The
+        per-shard transfers profile through the device ledger; the
+        assembled global array is what stays resident, adopted under
+        ``owner`` (re-tagged to ``mesh.round_cache`` when a warm round
+        admits it)."""
+        from datafusion_tpu.obs.device import (
+            LEDGER,
+            enabled as _ledger_on,
+            profile_sync_active,
+        )
+
+        # dispatch every shard without per-transfer blocking (the n
+        # device links genuinely run in parallel), then — only under
+        # profile_sync, same contract as single-device puts — block
+        # ONCE on the batch and record one combined transfer event;
+        # per-shard profiled transfers would serialize the links they
+        # measure
+        synced = profile_sync_active()
+        t0 = time.perf_counter()
         put = [
-            jax.device_put(np.asarray(a)[None], d)
+            LEDGER.transfer(np.asarray(a)[None], d, profile=False)
             for a, d in zip(shards, self.devices)
         ]
-        return jax.make_array_from_single_device_arrays(
-            (self.n,) + np.asarray(shards[0]).shape,
-            self._sharding,
-            put,
+        if _ledger_on():
+            if synced:
+                jax.block_until_ready(put)
+            LEDGER.note_h2d(
+                sum(int(p.nbytes) for p in put),
+                time.perf_counter() - t0,
+                self.devices[0],
+                synced=synced,
+            )
+        return LEDGER.adopt(
+            jax.make_array_from_single_device_arrays(
+                (self.n,) + np.asarray(shards[0]).shape,
+                self._sharding,
+                put,
+            ),
+            owner, cached=False,
         )
 
     @staticmethod
@@ -662,8 +693,12 @@ class PartitionedAggregateRelation(AggregateRelation):
         return state
 
     def _shard_state(self, state):
+        from datafusion_tpu.obs.device import LEDGER
+
         sharding = NamedSharding(self.mesh, P(MESH_AXIS))
-        return jax.tree.map(lambda t: jax.device_put(t, sharding), state)
+        return jax.tree.map(
+            lambda t: LEDGER.put(t, sharding, owner="mesh.state"), state
+        )
 
     def _grow_stacked_state(self, state, new_capacity: int):
         counts, accs = state
@@ -749,7 +784,7 @@ class PartitionedAggregateRelation(AggregateRelation):
                     state = device_call(
                         self._stacked_jit, put_cols, put_valids, aux,
                         rows_dev, put_mask, put_ids, state, str_aux,
-                        self._params,
+                        self._params, _tag="mesh.stacked",
                     )
                 continue
             views = [
@@ -841,6 +876,15 @@ class PartitionedAggregateRelation(AggregateRelation):
                     tuple(round_batches), put_cols, put_valids, tuple(aux),
                     rows_dev, put_mask, put_ids, str_aux,
                 )
+                # the admitted round's device stacks are now pinned by
+                # the cache: re-attribute them in the HBM ledger (and
+                # take them out of the leak sweep's transient set)
+                from datafusion_tpu.obs.device import LEDGER
+
+                LEDGER.retag(
+                    (put_cols, put_valids, put_mask, put_ids),
+                    "mesh.round_cache",
+                )
                 while len(self._round_cache) > self._round_cache_max:
                     self._round_cache.popitem(last=False)
             else:
@@ -860,6 +904,7 @@ class PartitionedAggregateRelation(AggregateRelation):
                     state,
                     str_aux,
                     self._params,
+                    _tag="mesh.stacked",
                 )
 
         if state is None:
@@ -873,7 +918,8 @@ class PartitionedAggregateRelation(AggregateRelation):
         with METRICS.timer("execute.collective_combine"):
             # codes are append-only, so the final round's rank tables
             # cover every code any earlier round accumulated
-            return device_call(self._combine_jit, state, str_aux)
+            return device_call(self._combine_jit, state, str_aux,
+                               _tag="mesh.combine")
 
 
 class DeadlineBoundRelation(Relation):
@@ -995,7 +1041,10 @@ class PartitionedContext(ExecutionContext):
                 # original partition objects directly
                 self.last_fragments = []
                 parts = ds.partitions
-            children = [DataSourceRelation(p) for p in parts]
+            children = [
+                DataSourceRelation(p, table_name=scan.table_name)
+                for p in parts
+            ]
             return PartitionedAggregateRelation(
                 children,
                 agg.group_expr,
@@ -1018,7 +1067,10 @@ class PartitionedContext(ExecutionContext):
             except PlanError:
                 self.last_fragments = []
                 parts = ds.partitions
-            children = [DataSourceRelation(p) for p in parts]
+            children = [
+                DataSourceRelation(p, table_name=scan.table_name)
+                for p in parts
+            ]
             # host-fn plans never get here: _match_partitioned_pipeline
             # rejects them with the same contains_host_fn check the
             # pipeline core uses, so construction cannot PlanError

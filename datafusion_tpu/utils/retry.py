@@ -69,25 +69,53 @@ def is_transient(err: Exception) -> bool:
     return classify_transient(err) is not None
 
 
-def device_call(fn, /, *args, **kwargs):
+def device_call(fn, /, *args, _tag=None, **kwargs):
     """Invoke a (pure) device computation, replaying on transient
     runtime failures with capped exponential backoff + full jitter,
-    never sleeping past the ambient query deadline."""
+    never sleeping past the ambient query deadline.
+
+    ``_tag`` is the launch's kernel identity (``"agg.group"``,
+    ``"topk"``, ``"mesh.stacked"``, ...) — it rides the
+    ``device.launch`` flight event and a per-kernel launch counter, so
+    ``launches_per_pass`` decomposes by kernel instead of being one
+    opaque total.  The launch wall accrues to the ``device.dispatch``
+    stage timer (the "execute" slice of the cold-path phase breakdown;
+    XLA compile inside a traced first call is split back out via the
+    ``compile.xla`` listener).  Under ``obs/device.profile_sync()``
+    (EXPLAIN ANALYZE, bench cold legs) the launch blocks on completion
+    so that wall is device execution, not async dispatch; elsewhere it
+    is dispatch-only and launches stay asynchronous."""
     attempt = 0
     while True:
         try:
             faults.check("device.call", attempt=attempt)
+            from datafusion_tpu.obs.device import profile_sync_active
+
+            t0 = time.perf_counter()
             out = fn(*args, **kwargs)
+            if profile_sync_active():
+                # phase-profiled run (EXPLAIN ANALYZE, bench cold
+                # legs): block so the "execute" slice measures device
+                # wall, not async dispatch — production launches stay
+                # async (see obs/device.profile_sync)
+                import jax
+
+                jax.block_until_ready(out)
+            wall = time.perf_counter() - t0
             # every successful dispatch is one executable launch — the
             # unit the fused-pass work minimizes (launches_per_pass in
             # EXPLAIN ANALYZE / bench derives from this counter);
             # counted AFTER fn so failed attempts/retries don't inflate
             METRICS.add("device.launches")
+            METRICS.observe("device.dispatch", wall)
+            if _tag is not None:
+                METRICS.add(f"device.launches.{_tag}")
             from datafusion_tpu.obs.recorder import record as flight_record
             from datafusion_tpu.obs.stats import record_launch
 
             record_launch()
-            flight_record("device.launch", attempt=attempt)
+            flight_record("device.launch", attempt=attempt, kernel=_tag,
+                          ms=round(wall * 1e3, 3))
             return out
         except Exception as e:  # jax.errors.JaxRuntimeError and kin
             transient = classify_transient(e)

@@ -217,8 +217,16 @@ def control_plane_smoke(schema, sql, paths, env) -> None:
                        'name="fleet.fragment.latency.p99_s"',
                        'name="fleet.query.latency.p99_s"',
                        'name="fleet.result_cache_hit_rate"',
+                       # device-ledger residency summed across the
+                       # fleet (worker heartbeat piggyback, obs/device)
+                       'name="fleet.hbm.live_bytes"',
+                       'name="fleet.hbm.peak_bytes"',
                        'name="slo.smoke_p99.burn_rate"'):
             assert needle in prom, needle
+        hbm = ca.telemetry.fleet()["hbm"]
+        assert hbm.get("device.hbm.peak_bytes", 0) > 0, (
+            f"fleet HBM watermark never rose above zero: {hbm}"
+        )
         top = ca.top_text()
         worker_rows = [ln for ln in top.splitlines()
                        if ln.strip().startswith("node ")

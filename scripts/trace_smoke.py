@@ -159,7 +159,12 @@ def main() -> int:
         recorder.configure(slow_s=0.0, directory=flight_dir,
                            dump_interval_s=0.0)
         slow_ctx = make_ctx()
-        res2 = slow_ctx.sql_collect(f"EXPLAIN ANALYZE {sql}")
+        # a FRESH statement: its fragments can't serve from the worker
+        # fragment cache, so the workers do real device work under this
+        # trace id (the device.h2d/device.launch events asserted below)
+        sql_slow = ("SELECT region, SUM(v), AVG(x), MIN(x), MAX(x) "
+                    "FROM t GROUP BY region")
+        res2 = slow_ctx.sql_collect(f"EXPLAIN ANALYZE {sql_slow}")
         artifacts = [
             os.path.join(flight_dir, f) for f in os.listdir(flight_dir)
         ]
@@ -181,6 +186,21 @@ def main() -> int:
         assert any(e["kind"] == "query.dispatch" for e in doc["events"])
         assert "resourceSpans" in doc["otlp"]
         assert any("rows=" in line for line in doc["explain"])
+        # device data plane (obs/device.py): the artifact carries the
+        # query's phase breakdown, and the workers' rings show the
+        # transfer/launch events their fragment execution emitted
+        phases = doc["query"].get("phases")
+        assert phases is not None and set(phases) >= {
+            "decode", "h2d", "compile", "execute", "d2h"
+        }, phases
+        device_kinds = {e["kind"]
+                        for nd in doc["nodes"].values()
+                        for e in nd["events"]
+                        if e["kind"].startswith("device.")}
+        assert device_kinds & {"device.h2d", "device.launch"}, (
+            f"no device transfer/launch events in worker rings: "
+            f"{device_kinds}"
+        )
         recorder.configure(slow_s=10.0)  # restore
 
         # ...and the explicit OTLP export round-trips the full span set

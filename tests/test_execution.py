@@ -1335,7 +1335,7 @@ class TestAdaptivePlacement:
 def test_package_version_in_sync():
     """pyproject.toml's version must match datafusion_tpu.__version__
     (two declarations where the reference's Cargo.toml has one)."""
-    import tomllib
+    tomllib = pytest.importorskip("tomllib")  # stdlib only on Python 3.11+
 
     import datafusion_tpu
 

@@ -408,7 +408,13 @@ class TestPrometheusExport:
         # dotted engine names keep their dots in label values (the
         # sanitization fix: label values escape, not flatten)
         assert 'datafusion_tpu_events_total{name="scan.rows"}' in text
-        assert text == ctx.metrics_text()
+        # ctx.metrics_text() is the same exposition plus this process's
+        # histogram quantile gauges (query latency, per-table scans)
+        from datafusion_tpu.obs.aggregate import histogram_gauges
+
+        assert ctx.metrics_text() == prometheus_text(
+            extra_gauges=histogram_gauges()
+        )
         # exposition format sanity: every sample line is name{labels} value
         for line in text.strip().splitlines():
             if line.startswith("#"):

@@ -220,14 +220,20 @@ class TestOtlp:
         assert len(otlp.otlp_to_spans(json.loads(lines[0]))) == 2
 
     def test_export_http_post(self, monkeypatch):
+        import gzip
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         bodies = []
+        encodings = []
 
         class _H(BaseHTTPRequestHandler):
             def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler API
                 n = int(self.headers["Content-Length"])
-                bodies.append(json.loads(self.rfile.read(n)))
+                raw = self.rfile.read(n)
+                encodings.append(self.headers.get("Content-Encoding"))
+                if self.headers.get("Content-Encoding") == "gzip":
+                    raw = gzip.decompress(raw)
+                bodies.append(json.loads(raw))
                 self.send_response(200)
                 self.end_headers()
 
@@ -238,16 +244,47 @@ class TestOtlp:
         threading.Thread(target=srv.serve_forever, daemon=True).start()
         try:
             endpoint = f"http://127.0.0.1:{srv.server_address[1]}/v1/traces"
+            # direct POST gzips by default...
             status = otlp.post_otlp(endpoint, self.SPANS)
             assert status == 200
             assert bodies and "resourceSpans" in bodies[0]
-            # env-routed export swallows a dead endpoint instead of
-            # failing the query path
+            assert encodings[-1] == "gzip"
+            # ...and plain JSON on request
+            assert otlp.post_otlp(endpoint, self.SPANS,
+                                  compress=False) == 200
+            assert encodings[-1] is None
+            # env-routed export batches: two queries' spans enqueue,
+            # ONE flush POSTs them as one merged document
             monkeypatch.delenv("DATAFUSION_TPU_OTLP_FILE", raising=False)
+            monkeypatch.setenv("DATAFUSION_TPU_OTLP_ENDPOINT", endpoint)
+            otlp.flush()  # drain any prior state
+            where = otlp.export_spans(self.SPANS)
+            assert "batched" in where, where
+            assert otlp.export_spans(self.SPANS) is not None
+            assert otlp.pending() == 2 * len(self.SPANS)
+            n_posts = len(bodies)
+            assert otlp.flush() == 200
+            assert otlp.pending() == 0
+            assert len(bodies) == n_posts + 1  # one POST for both queries
+            batched = otlp.otlp_to_spans(bodies[-1])
+            assert len(batched) == 2 * len(self.SPANS)
+            # a dead endpoint is swallowed at flush, never raised into
+            # the query path
             monkeypatch.setenv("DATAFUSION_TPU_OTLP_ENDPOINT",
                                "http://127.0.0.1:9/v1/traces")
-            assert otlp.export_spans(self.SPANS) is None
+            assert otlp.export_spans(self.SPANS) is not None  # enqueued
+            assert otlp.flush() is None
             assert METRICS.counts.get("obs.otlp_errors", 0) >= 1
+            # endpoint vanishing between enqueue and flush is counted
+            # loss, not silent idle
+            monkeypatch.setenv("DATAFUSION_TPU_OTLP_ENDPOINT", endpoint)
+            assert otlp.export_spans(self.SPANS) is not None  # enqueued
+            assert otlp.pending() > 0
+            monkeypatch.delenv("DATAFUSION_TPU_OTLP_ENDPOINT")
+            errs = METRICS.counts.get("obs.otlp_errors", 0)
+            assert otlp.flush() is None
+            assert otlp.pending() == 0
+            assert METRICS.counts.get("obs.otlp_errors", 0) == errs + 1
         finally:
             srv.shutdown()
 
