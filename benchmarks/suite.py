@@ -140,6 +140,16 @@ def _phase_before() -> dict:
     return phase_snapshot()
 
 
+def _cold_profile(prof_cap) -> dict:
+    """Per-phase top host frames from a cold leg's sampling-profiler
+    capture (obs/profiler.py): `{phase: {"samples": n, "top_frames":
+    [[label, count], ...]}}` — the BENCH-round record of WHERE the
+    cold wall's host CPU went, beside `cold_phase_ms`'s how-much."""
+    if prof_cap is None:
+        return {}
+    return prof_cap.report().by_phase(3)
+
+
 def _cold_phase_ms(before: dict, total_wall_s: float, nruns: int) -> dict:
     """Per-run cold-phase milliseconds (decode/h2d/compile/execute/d2h/
     other) from the stage-timer deltas across `nruns` runs — the
@@ -203,8 +213,9 @@ def config1_csv_filter(device_kind: str):
     log(f"    cpu cold: p50 {cpu_p50*1e3:.1f} ms, {rows/cpu_p50/1e6:.2f} M rows/s")
     if device_kind == "cpu":
         dev_p50, dev_out = cpu_p50, cpu_out
-        cold_phase_ms, hbm_peak = {}, 0
+        cold_phase_ms, hbm_peak, cold_profile = {}, 0, {}
     else:
+        from datafusion_tpu.obs import profiler as _profiler
         from datafusion_tpu.obs.device import LEDGER, profile_sync
 
         METRICS.reset()
@@ -212,14 +223,16 @@ def config1_csv_filter(device_kind: str):
         LEDGER.begin_peak_window()
         t0 = time.perf_counter()
         # profile_sync: launches block so the "execute" phase measures
-        # device wall, not async dispatch (obs/device.py)
-        with profile_sync():
+        # device wall, not async dispatch (obs/device.py); the host
+        # profiler samples the same runs for per-phase top frames
+        with profile_sync(), _profiler.profile(name="bench.cold1") as pc:
             dev_p50, dev_out = _timed(lambda: cold(device_kind), COLD_RUNS, warmup=1)
         # warmup=1: the warm-up run's stage timers are in the deltas,
         # so the wall fed to the breakdown is the measured total
         cold_phase_ms = _cold_phase_ms(
             pb, time.perf_counter() - t0, COLD_RUNS + 1
         )
+        cold_profile = _cold_profile(pc)
         hbm_peak = LEDGER.window_peak_bytes()
         snap = METRICS.snapshot()
         parse = snap["timings_s"].get("scan.parse", 0.0) / (COLD_RUNS + 1)
@@ -237,6 +250,7 @@ def config1_csv_filter(device_kind: str):
         "p50_ms": round(dev_p50 * 1e3, 2),
         "vs_baseline": round(cpu_p50 / dev_p50, 3),
         "cold_phase_ms": cold_phase_ms,
+        "cold_profile": cold_profile,
         "hbm_peak_bytes": hbm_peak,
         "out_rows": dev_out.num_rows,
     }
@@ -312,16 +326,19 @@ def config3_tpch_q1(device_kind: str, sf=None):
         from datafusion_tpu.obs.device import LEDGER, profile_sync
         from datafusion_tpu.obs.device import enabled as device_ledger_enabled
 
+        from datafusion_tpu.obs import profiler as _profiler
+
         cold(device_kind)  # compile device kernels
         METRICS.reset()
         pb = _phase_before()
         LEDGER.begin_peak_window()
         t0 = time.perf_counter()
-        with profile_sync():
+        with profile_sync(), _profiler.profile(name="bench.cold3") as pc:
             dev_cold_p50, dev_out = _timed(lambda: cold(device_kind), COLD_RUNS, warmup=0)
         cold_phase_ms = _cold_phase_ms(
             pb, time.perf_counter() - t0, COLD_RUNS
         )
+        cold_profile = _cold_profile(pc)
         hbm_peak = LEDGER.window_peak_bytes()
         snap = METRICS.snapshot()
         nruns = COLD_RUNS
@@ -350,7 +367,7 @@ def config3_tpch_q1(device_kind: str, sf=None):
     else:
         dev_cold_p50 = cpu_cold_p50
         breakdown = {}
-        cold_phase_ms, hbm_peak = {}, 0
+        cold_phase_ms, hbm_peak, cold_profile = {}, 0, {}
 
     # warm: the same rows resident in memory (and after warm-up, on
     # device) — steady-state re-query throughput
@@ -382,6 +399,7 @@ def config3_tpch_q1(device_kind: str, sf=None):
         "cold_vs_baseline": round(cpu_cold_p50 / dev_cold_p50, 3),
         "cold_breakdown": breakdown,
         "cold_phase_ms": cold_phase_ms,
+        "cold_profile": cold_profile,
         "hbm_peak_bytes": hbm_peak,
         "utilization": utilization,
     }

@@ -30,6 +30,15 @@ class of bug that once cost a debugging session:
   (``obs/stats.py``): they run inside other subsystems' critical
   sections (CacheStore eviction, retry loops), where taking a lock
   would build silent lock-order edges.
+- **DF007 blocking-io-in-sampler** — no blocking IO (file/socket/HTTP
+  calls, ``time.sleep``, ``print``) inside the sampling profiler's
+  timer-thread path (``obs/profiler.py`` ``_run``/``_sample_once``/
+  ``_fold``): the sampler interrupts every thread's view of the world
+  ~100x/second, and a sampler that blocks skews every profile it
+  produces — rendering and persistence belong on the caller's thread
+  at report time.  (DF005 also covers the same functions: the fold
+  path runs beside arbitrary application code and must never take a
+  lock.)
 - **DF006 raw-device-put** — no ``jax.device_put`` reference outside
   ``obs/device.py``: every device placement goes through the HBM
   residency ledger seam (``LEDGER.put``/``transfer``/``adopt``), or
@@ -278,8 +287,14 @@ class LockInMetricsCallback(_Rule):
                   "record_h2d_time", "record_d2h_time")
     # the flight recorder's emit path carries the same contract: it is
     # called inside other subsystems' critical sections (cluster state
-    # lock, device dispatch) and must never acquire a lock
-    _RECORDER_FNS = ("record", "observe", "observe_latency")
+    # lock, device dispatch) and must never acquire a lock.  The GC
+    # pause callback (obs/aggregate.py) fires at arbitrary allocation
+    # points — same rule
+    _RECORDER_FNS = ("record", "observe", "observe_latency",
+                     "_gc_callback")
+    # the sampling profiler's timer-thread path (obs/profiler.py): the
+    # fold runs beside arbitrary application code on every tick
+    _PROFILER_FNS = ("_run", "_sample_once", "_fold")
     # the device ledger's put/adopt/release path (obs/device.py)
     # advertises the same lock-free contract in its module doc — this
     # list keeps it enforced, not just documented (weakref finalizers
@@ -292,7 +307,8 @@ class LockInMetricsCallback(_Rule):
         p = relpath.replace(os.sep, "/")
         return p.endswith(("utils/metrics.py", "obs/stats.py",
                            "obs/recorder.py", "obs/aggregate.py",
-                           "obs/slo.py", "obs/device.py"))
+                           "obs/slo.py", "obs/device.py",
+                           "obs/profiler.py"))
 
     def _scan(self, node, relpath, where):
         out = []
@@ -335,6 +351,8 @@ class LockInMetricsCallback(_Rule):
             return self._scan(tree, relpath, "utils/metrics.py")
         if p.endswith("obs/device.py"):
             wanted = self._DEVICE_FNS
+        elif p.endswith("obs/profiler.py"):
+            wanted = self._PROFILER_FNS
         elif p.endswith(("obs/recorder.py", "obs/aggregate.py",
                          "obs/slo.py")):
             wanted = self._RECORDER_FNS
@@ -380,6 +398,40 @@ class RawDevicePut(_Rule):
         return out
 
 
+class BlockingIoInSampler(_Rule):
+    """DF007: blocking IO inside the sampling profiler's timer thread."""
+
+    id = "DF007"
+
+    # calls that block (or can block) the sampler's tick: file and
+    # socket IO, HTTP, stdout, and explicit sleeps.  `Event.wait` is
+    # the tick itself and stays allowed.
+    _BLOCKING = ("open", "print", "sleep", "connect", "accept",
+                 "sendall", "send", "recv", "recvfrom", "urlopen",
+                 "write", "flush", "read", "readline", "dump")
+    _SAMPLER_FNS = ("_run", "_sample_once", "_fold")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace(os.sep, "/").endswith("obs/profiler.py")
+
+    def check(self, tree, relpath):
+        out = []
+        for fn in _functions_in(tree):
+            if fn.name not in self._SAMPLER_FNS:
+                continue
+            for call in _calls_in(fn):
+                name = _call_name(call)
+                if name in self._BLOCKING:
+                    out.append(self._finding(
+                        relpath, call,
+                        f"{name}() in sampler-thread {fn.name}(): the "
+                        "sampler must never block — it skews every "
+                        "profile it takes; render/persist on the "
+                        "caller's thread at report time",
+                    ))
+        return out
+
+
 RULES: list[_Rule] = [
     HostSyncInDispatch(),
     NondeterminismInReplayable(),
@@ -387,6 +439,7 @@ RULES: list[_Rule] = [
     SwallowedBroadExcept(),
     LockInMetricsCallback(),
     RawDevicePut(),
+    BlockingIoInSampler(),
 ]
 
 

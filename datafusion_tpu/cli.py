@@ -107,6 +107,94 @@ def run_top(workers: Optional[str], cluster: Optional[str],
             ctx.close()
 
 
+def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
+                     out_dir: Optional[str], seconds: float,
+                     out=None) -> int:
+    """`datafusion-tpu debug-bundle [--cluster host:p | --workers
+    h:debugport,...] [--out DIR] [--seconds N]`: pull one debug bundle
+    (obs/httpd.py `/debug/bundle` — config + metrics + flight ring +
+    HBM breakdown + host profile) from every live member and write
+    them under DIR.  With no target, bundles the local process
+    in-process.  Exits non-zero if any live member failed to produce a
+    bundle (a member without an advertised debug port counts as a
+    failure — the fleet is only debuggable if every node is)."""
+    import json
+    import os
+    import tempfile
+    import urllib.request
+
+    out = out if out is not None else sys.stdout
+    cluster = cluster or os.environ.get("DATAFUSION_TPU_CLUSTER")
+    targets: list[tuple[str, Optional[str]]] = []  # (member, url|None)
+    if workers:
+        for addr in workers.split(","):
+            addr = addr.strip()
+            if addr:
+                targets.append((addr, f"http://{addr}/debug/bundle"))
+    elif cluster:
+        from datafusion_tpu.cluster import connect
+
+        status = connect(cluster).status()
+        for addr, info in sorted(status.get("workers", {}).items()):
+            dport = (info or {}).get("debug_port")
+            if dport:
+                host = addr.rpartition(":")[0]
+                targets.append(
+                    (addr, f"http://{host}:{dport}/debug/bundle")
+                )
+            else:
+                targets.append((addr, None))
+    if out_dir is None:
+        out_dir = tempfile.mkdtemp(prefix="datafusion_tpu_bundles_")
+    os.makedirs(out_dir, exist_ok=True)
+
+    def _write(member: str, doc: dict) -> str:
+        path = os.path.join(
+            out_dir, f"bundle-{member.replace(':', '-').replace('/', '-')}.json"
+        )
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, default=str)
+        return path
+
+    failures = 0
+    if not targets:
+        # no cluster, no workers: bundle THIS process
+        from datafusion_tpu.obs.httpd import build_bundle
+
+        doc = build_bundle(profile_seconds=seconds)
+        path = _write("local", doc)
+        n_samples = (doc.get("profile") or {}).get("samples", 0)
+        print(f"local: {path} "
+              f"({n_samples} profile samples, "
+              f"{len(doc['flights']['events'])} flight events)", file=out)
+    for member, url in targets:
+        if url is None:
+            print(f"{member}: NO debug port advertised in its lease "
+                  "(start the worker with --http-port / "
+                  "DATAFUSION_TPU_DEBUG_PORT)", file=out)
+            failures += 1
+            continue
+        try:
+            with urllib.request.urlopen(
+                f"{url}?seconds={seconds:g}", timeout=seconds + 15
+            ) as resp:
+                doc = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            print(f"{member}: bundle pull failed: {e}", file=out)
+            failures += 1
+            continue
+        path = _write(member, doc)
+        prof = doc.get("profile") or {}
+        print(f"{member}: {path} "
+              f"({prof.get('samples', 0)} profile samples, "
+              f"{len((doc.get('flights') or {}).get('events', []))} "
+              f"flight events)", file=out)
+    print(f"bundles written to {out_dir} "
+          f"({max(len(targets), 1) - failures}/{max(len(targets), 1)} ok)",
+          file=out)
+    return 1 if failures else 0
+
+
 class Console:
     """Statement executor (reference `Console`, main.rs:113-153).
 
@@ -379,10 +467,13 @@ def main(argv=None) -> int:
         prog="tpusql", description="DataFusion-TPU SQL console"
     )
     parser.add_argument(
-        "mode", nargs="?", choices=["top"],
+        "mode", nargs="?", choices=["top", "debug-bundle"],
         help="'top': print the fleet telemetry view (latency "
              "percentiles, cache hit rates, SLO burn rates) and exit "
-             "(or repeat with --watch)",
+             "(or repeat with --watch).  'debug-bundle': pull one "
+             "debug bundle (metrics + flight ring + HBM + host "
+             "profile) from every live cluster member's debug HTTP "
+             "plane (obs/httpd.py) into --out",
     )
     parser.add_argument("--script", help="execute commands from file, then exit")
     parser.add_argument(
@@ -396,21 +487,36 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--workers", default=None,
         help="top mode: comma-separated worker addresses host:port to "
-             "aggregate directly (default: discover via --cluster)",
+             "aggregate directly (default: discover via --cluster).  "
+             "debug-bundle mode: host:port addresses of DEBUG HTTP "
+             "planes to pull from",
     )
     parser.add_argument(
         "--cluster", default=None,
-        help="top mode: cluster service address (default: env "
-             "DATAFUSION_TPU_CLUSTER)",
+        help="top / debug-bundle mode: cluster service address "
+             "(default: env DATAFUSION_TPU_CLUSTER)",
     )
     parser.add_argument(
         "--watch", type=float, default=0.0, metavar="SECONDS",
         help="top mode: refresh every N seconds until interrupted",
     )
+    parser.add_argument(
+        "--out", default=None, metavar="DIR",
+        help="debug-bundle mode: directory to write bundles into "
+             "(default: a fresh temp dir, printed)",
+    )
+    parser.add_argument(
+        "--seconds", type=float, default=0.5, metavar="N",
+        help="debug-bundle mode: on-demand profile capture length per "
+             "member (default 0.5)",
+    )
     args = parser.parse_args(argv)
 
     if args.mode == "top":
         return run_top(args.workers, args.cluster, args.watch)
+    if args.mode == "debug-bundle":
+        return run_debug_bundle(args.cluster, args.workers, args.out,
+                                args.seconds)
 
     print("DataFusion Console")
     console = Console(make_context(args.device, args.batch_size), timing=args.timing)

@@ -83,12 +83,15 @@ class WorkerClusterAgent:
         # resume the event log from the grant: events before this worker
         # held a lease concern caches it does not have
         self.last_rev = granted.get("rev", 0)
-        self.client.put(
-            f"workers/{self.addr}",
-            {"addr": self.addr, "pid": os.getpid(),
-             "batch_size": self.worker_state.batch_size},
-            lease=self.lease,
-        )
+        info = {"addr": self.addr, "pid": os.getpid(),
+                "batch_size": self.worker_state.batch_size}
+        # advertise the debug HTTP plane (obs/httpd.py) in the lease:
+        # `datafusion-tpu debug-bundle --cluster` resolves every live
+        # member's bundle endpoint from the membership view alone
+        debug_port = getattr(self.worker_state, "debug_port", None)
+        if debug_port:
+            info["debug_port"] = int(debug_port)
+        self.client.put(f"workers/{self.addr}", info, lease=self.lease)
         self._lease_refreshed = time.monotonic()
         METRICS.add("worker.cluster_registered")
 

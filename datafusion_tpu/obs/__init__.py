@@ -31,6 +31,15 @@ fragment, per worker:
   `datafusion-tpu top` view.
 - `obs.slo` — SLO watchdog: declared latency/error objectives over
   sliding windows, burn-rate gauges, flight-recorder dump on breach.
+- `obs.profiler` — host-side wall-clock sampling profiler (stdlib
+  only): collapsed stacks / speedscope output with per-phase and
+  per-trace attribution; scoped captures under EXPLAIN ANALYZE and the
+  bench cold legs, continuous mode via `DATAFUSION_TPU_PROFILE_HZ`.
+- `obs.httpd` — the unified debug HTTP plane (`/debug/metrics`,
+  `/debug/flights`, `/debug/hbm`, `/debug/top`, `/debug/profile`,
+  `/debug/bundle`) served on `DATAFUSION_TPU_DEBUG_PORT` by workers
+  and coordinators; `datafusion-tpu debug-bundle` pulls every live
+  member's bundle.
 
 Env knobs: `DATAFUSION_TPU_TRACE=1` enables span collection engine-wide;
 `DATAFUSION_TPU_TRACE_FILE=path.json` additionally writes a Chrome trace

@@ -28,10 +28,108 @@ does.
 from __future__ import annotations
 
 import math
+import os
 import time
 from typing import Optional
 
 from datafusion_tpu.utils.metrics import METRICS
+
+# -- host-resource gauges ---------------------------------------------
+# Process RSS / peak RSS / open-FD count in every scrape, and GC pause
+# time as a stage timer: the host-side complement of the device-ledger
+# HBM gauges — a node whose decode path is eating memory or leaking
+# descriptors shows it in the same scrape that shows its latency.
+# Platform-guarded: no /proc (macOS, exotic containers) simply means
+# the gauges are absent — never published as fake zeros (the same
+# "a blind node must not read as a measured-empty one" rule the
+# ledger-off path follows).
+
+_PROC_STATUS = "/proc/self/status"
+_PROC_FD = "/proc/self/fd"
+
+
+# observed RSS high-water mark: some sandboxed kernels publish VmRSS
+# but omit VmHWM — fall back to the max RSS this process has ever
+# measured (an under-estimate between scrapes, but monotone and real)
+_rss_peak_seen = 0
+
+
+def host_gauges() -> dict:
+    """Point-in-time host-resource gauges (empty off-Linux)."""
+    global _rss_peak_seen
+    out: dict = {}
+    try:
+        with open(_PROC_STATUS, "r", encoding="ascii") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    out["host.rss_bytes"] = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    out["host.rss_peak_bytes"] = int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    rss = out.get("host.rss_bytes")
+    if rss is not None:
+        _rss_peak_seen = max(_rss_peak_seen, rss,
+                             out.get("host.rss_peak_bytes", 0))
+        out.setdefault("host.rss_peak_bytes", _rss_peak_seen)
+    try:
+        out["host.open_fds"] = len(os.listdir(_PROC_FD))
+    except OSError:
+        pass
+    return out
+
+
+def refresh_host_gauges() -> dict:
+    """Fold the host-resource gauges into the METRICS registry so every
+    scrape path (worker status, /debug/metrics, heartbeat snapshot)
+    carries them; returns what was set."""
+    g = host_gauges()
+    for name, v in g.items():
+        METRICS.gauge(name, v)
+    return g
+
+
+# GC pause accounting, via gc.callbacks: the "start" callback stamps a
+# wall anchor, "stop" folds the pause into the `host.gc_pause` stage
+# timer and bumps `host.gc_collections`.  CPython runs a collection
+# inside ONE thread (whichever allocation triggered it) with no
+# interleaved collection, so a single module-level anchor is race-free.
+# The callback itself is dict-add-only (lock-free — it fires at
+# arbitrary allocation points, possibly while other subsystems hold
+# locks; DF005 covers it).
+_gc_t0: Optional[float] = None
+_gc_installed = False
+
+
+def _gc_callback(phase: str, info: dict) -> None:
+    global _gc_t0
+    if phase == "start":
+        _gc_t0 = time.perf_counter()
+    elif phase == "stop" and _gc_t0 is not None:
+        METRICS.observe("host.gc_pause", time.perf_counter() - _gc_t0)
+        METRICS.add("host.gc_collections")
+        _gc_t0 = None
+
+
+def install_gc_hook() -> None:
+    """Idempotently register the GC pause callback."""
+    global _gc_installed
+    if _gc_installed:
+        return
+    import gc
+
+    gc.callbacks.append(_gc_callback)
+    _gc_installed = True
+
+
+install_gc_hook()
+
+# gauges summed node-wise into fleet.* (like counters, these are
+# extensive quantities: total fleet residency / memory / descriptors)
+_SUMMED_GAUGES = (
+    "device.hbm.live_bytes", "device.hbm.peak_bytes",
+    "host.rss_bytes", "host.rss_peak_bytes", "host.open_fds",
+)
 
 # log2 buckets over [1us, ~137s): bucket i covers
 # [1us * 2^i, 1us * 2^(i+1)); the final slot is the +inf overflow
@@ -224,6 +322,10 @@ def node_snapshot() -> dict:
 
     if _device.enabled():
         _device.LEDGER.live_bytes()
+    # host-resource gauges (RSS, peak RSS, open FDs) refresh the same
+    # way: measured at snapshot time, absent when the platform hides
+    # them — the fleet sums only measured values
+    refresh_host_gauges()
     snap = METRICS.snapshot()
     gauges = snap["gauges"]
     if not _device.enabled():
@@ -359,7 +461,7 @@ class FleetAggregator:
         nodes = self.nodes()
         hists: dict[str, LatencyHistogram] = {}
         counts: dict[str, float] = {}
-        hbm: dict[str, float] = {}
+        sums: dict[str, float] = {}
         for snap in nodes.values():
             for name, h in (snap.get("histograms") or {}).items():
                 tgt = hists.get(name)
@@ -370,12 +472,14 @@ class FleetAggregator:
                 tgt.merge(h)
             for name, n in (snap.get("counts") or {}).items():
                 counts[name] = counts.get(name, 0) + n
-            # device-ledger residency sums across the fleet: every
-            # node's HBM live/peak gauges fold into fleet.hbm.*
+            # extensive gauges sum across the fleet: device-ledger HBM
+            # residency into fleet.hbm.*, host RSS/FDs into fleet.host.*
             g = snap.get("gauges") or {}
-            for name in ("device.hbm.live_bytes", "device.hbm.peak_bytes"):
+            for name in _SUMMED_GAUGES:
                 if name in g:
-                    hbm[name] = hbm.get(name, 0) + float(g[name])
+                    sums[name] = sums.get(name, 0) + float(g[name])
+        hbm = {k: v for k, v in sums.items() if k.startswith("device.hbm.")}
+        host = {k: v for k, v in sums.items() if k.startswith("host.")}
         derived = {
             "result_cache_hit_rate": _rate(
                 counts.get("cache.result.hits", 0),
@@ -393,7 +497,7 @@ class FleetAggregator:
         }
         return {"nodes": len(nodes), "node_names": sorted(nodes),
                 "histograms": hists, "counts": counts, "derived": derived,
-                "hbm": hbm}
+                "hbm": hbm, "host": host}
 
     def gauges(self) -> dict:
         """Fleet gauges for ``prometheus_text(extra_gauges=...)``."""
@@ -406,6 +510,10 @@ class FleetAggregator:
             out["fleet.hbm.live_bytes"] = int(f["hbm"]["device.hbm.live_bytes"])
         if "device.hbm.peak_bytes" in f["hbm"]:
             out["fleet.hbm.peak_bytes"] = int(f["hbm"]["device.hbm.peak_bytes"])
+        # fleet host-resource totals: summed RSS / peak RSS / open FDs
+        # (absent off-Linux — only measured nodes contribute)
+        for name, v in f["host"].items():
+            out[f"fleet.{name}"] = int(v)
         for name, v in f["derived"].items():
             if v is not None:
                 out[f"fleet.{name}"] = round(v, 4)
@@ -455,6 +563,14 @@ class FleetAggregator:
             lines.append(
                 f"  hbm: live={_fmt_bytes(live)} peak={_fmt_bytes(peak)} "
                 f"(device ledger, fleet sum)"
+            )
+        if f.get("host"):
+            from datafusion_tpu.obs.device import _fmt_bytes
+
+            lines.append(
+                f"  host: rss={_fmt_bytes(f['host'].get('host.rss_bytes', 0))}"
+                f" peak={_fmt_bytes(f['host'].get('host.rss_peak_bytes', 0))}"
+                f" fds={int(f['host'].get('host.open_fds', 0))} (fleet sum)"
             )
         admitted = f["counts"].get("queries_admitted", 0)
         shed = f["counts"].get("queries_shed", 0)

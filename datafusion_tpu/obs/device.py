@@ -63,6 +63,8 @@ from datafusion_tpu.obs.recorder import _env_flag
 from datafusion_tpu.obs.recorder import record as _flight_record
 from datafusion_tpu.obs.trace import _current_trace
 from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.metrics import stage_enter as _stage_enter
+from datafusion_tpu.utils.metrics import stage_exit as _stage_exit
 
 
 _ENABLED = _env_flag("DATAFUSION_TPU_DEVICE_LEDGER", True)
@@ -206,10 +208,17 @@ class DeviceLedger:
             self._register(out, owner, cached, device)
             return out
         synced = profile_sync_active()
+        # stage publication for the sampling profiler: samples taken
+        # inside the put attribute to the "h2d" phase (lock-free —
+        # obs/profiler.py; same contract as the ledger bookkeeping)
+        stage_tok = _stage_enter("h2d.dispatch")
         t0 = time.perf_counter()
-        out = jax.device_put(arr, device)
-        if synced:
-            jax.block_until_ready(out)
+        try:
+            out = jax.device_put(arr, device)
+            if synced:
+                jax.block_until_ready(out)
+        finally:
+            _stage_exit(stage_tok)
         nbytes = int(getattr(arr, "nbytes", 0) or 0)
         self.note_h2d(nbytes, time.perf_counter() - t0, device,
                       synced=synced)
@@ -233,10 +242,14 @@ class DeviceLedger:
         if not profile:
             return jax.device_put(arr, device)
         synced = profile_sync_active()
+        stage_tok = _stage_enter("h2d.dispatch")
         t0 = time.perf_counter()
-        out = jax.device_put(arr, device)
-        if synced:
-            jax.block_until_ready(out)
+        try:
+            out = jax.device_put(arr, device)
+            if synced:
+                jax.block_until_ready(out)
+        finally:
+            _stage_exit(stage_tok)
         nbytes = int(getattr(arr, "nbytes", 0) or 0)
         self.note_h2d(nbytes, time.perf_counter() - t0, device,
                       synced=synced)
@@ -524,16 +537,29 @@ def hbm_capacity_bytes() -> Optional[int]:
     try:
         import jax
 
-        total = 0
-        for d in jax.devices():
-            stats = d.memory_stats()
-            limit = (stats or {}).get("bytes_limit")
-            if not limit:
-                return None  # partial capacity would skew the fraction
-            total += int(limit)
-        return total or None
+        devices = jax.devices()
     except Exception:  # noqa: BLE001 — capacity probing is best-effort by contract
         return None
+    total = 0
+    for d in devices:
+        # per-device guard: backends EXPOSE memory_stats but vary
+        # wildly in what it returns — None, a partial dict without
+        # bytes_limit (CPU/METAL do this), a non-dict, or a raise
+        # (NotImplementedError on some plugin backends).  Any of those
+        # means the total is unknowable: go cleanly dormant rather
+        # than report a partial capacity that would skew the hbm_frac
+        # burn rate
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — an opaque backend = unknown, not an error
+            return None
+        if not isinstance(stats, dict):
+            return None
+        limit = stats.get("bytes_limit")
+        if not isinstance(limit, (int, float)) or limit <= 0:
+            return None
+        total += int(limit)
+    return total or None
 
 
 def _link_baseline_mbps() -> Optional[float]:

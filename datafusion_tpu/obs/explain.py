@@ -83,7 +83,8 @@ class ExplainAnalyzeResult:
 
     def __init__(self, plan, root, result, spans: list[dict],
                  trace_id: str, wall_s: float, counters: Optional[dict] = None,
-                 phases: Optional[dict] = None, hbm: Optional[dict] = None):
+                 phases: Optional[dict] = None, hbm: Optional[dict] = None,
+                 host_profile=None):
         self.plan = plan
         self.root = root
         self.result = result
@@ -98,6 +99,10 @@ class ExplainAnalyzeResult:
         # and the query's HBM residency watermark from the device ledger
         self.phases = phases or {}
         self.hbm = hbm or {}
+        # host-stack sampling profile of the run (obs/profiler.py):
+        # per-phase top frames — WHERE in host code each phase's wall
+        # went (None when DATAFUSION_TPU_PROFILE_EXPLAIN=0)
+        self.host_profile = host_profile
 
     def report(self) -> str:
         lines = [f"EXPLAIN ANALYZE  (trace {self.trace_id}, "
@@ -114,6 +119,19 @@ class ExplainAnalyzeResult:
                 f"(live {_fmt_bytes(self.hbm.get('live_bytes', 0))}, "
                 f"{self.hbm.get('buffers', 0)} buffer(s); device ledger)"
             )
+        prof = self.host_profile
+        if prof is not None and prof.samples:
+            # per phase, the top host frames by sample count — the
+            # attribution the phase bar can't give ("decode is 70% of
+            # the wall" becomes "and it's all in _parse_chunk")
+            lines.append(f"Host profile ({prof.summary()}):")
+            for phase, d in prof.by_phase(3).items():
+                frames = " · ".join(
+                    f"{label} ×{count}" for label, count in d["top_frames"]
+                )
+                lines.append(
+                    f"  {phase}: {d['samples']} sample(s) — {frames}"
+                )
         for depth, rel in collect_tree(self.root):
             fused_chain = getattr(rel, "_fused_chain", None)
             marker = f"  <- fused pass [{fused_chain}]" if fused_chain else ""
@@ -237,13 +255,25 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
     LEDGER.begin_peak_window()
     # profile_sync: launches block on completion inside this run, so
     # the "execute" phase measures device wall instead of async
-    # dispatch (which would fold real compute into "d2h")
-    with trace.session() as tc, _device.profile_sync():
+    # dispatch (which would fold real compute into "d2h").
+    # profiler.profile: host-stack sampling for the run — per-phase top
+    # frames in the report (the scoped sampler thread lives exactly as
+    # long as this block; DATAFUSION_TPU_PROFILE_EXPLAIN=0 opts out)
+    from datafusion_tpu.obs import profiler as _profiler
+    from datafusion_tpu.obs.recorder import _env_flag
+
+    profile_scope = _profiler.profile(
+        name="explain_analyze",
+        enabled=_env_flag("DATAFUSION_TPU_PROFILE_EXPLAIN", True),
+    )
+    with trace.session() as tc, _device.profile_sync(), \
+            profile_scope as prof_cap:
         t0 = time.perf_counter()
         with trace.span("query", plan=type(plan).__name__):
             rel = ctx.execute(plan)
             table = collect(_RootTap(rel))
         wall = time.perf_counter() - t0
+    host_profile = None if prof_cap is None else prof_cap.report()
     phases = phase_breakdown(phase_before, wall)
     hbm = {"peak_bytes": LEDGER.window_peak_bytes(),
            "live_bytes": LEDGER.live_bytes(),
@@ -266,5 +296,5 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
     export_spans(spans)
     return ExplainAnalyzeResult(
         plan, rel, table, spans, tc.trace_id, wall, counters,
-        phases=phases, hbm=hbm,
+        phases=phases, hbm=hbm, host_profile=host_profile,
     )

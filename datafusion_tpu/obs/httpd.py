@@ -1,0 +1,415 @@
+"""The unified debug HTTP plane: one opt-in server per node, every
+observability surface behind it.
+
+The reference scaffolded a distributed platform with no operator
+surface at all (its worker image EXPOSEd 8080 for a status UI that
+never shipped); PRs 2-9 grew the surfaces — Prometheus scrape, flight
+recorder, HBM ledger, fleet top, and now the sampling profiler — but
+reaching them meant five console backslash-commands and a pile of
+env-var'd file dumps.  This module puts them behind ONE HTTP port
+(``DATAFUSION_TPU_DEBUG_PORT`` / worker ``--http-port`` / coordinator
+``debug_port=``), on coordinators and workers alike:
+
+====================  =================================================
+``/debug/metrics``    Prometheus text exposition (alias ``/metrics`` —
+                      absorbs the worker's previous ad-hoc endpoint)
+``/debug/flights``    flight-recorder ring dump as JSON
+                      (``?trace_id=`` filters to one query)
+``/debug/hbm``        HBM residency ledger breakdown (per owner/device)
+``/debug/top``        the fleet ``top`` view (fleet-wide on a
+                      coordinator, local-node on a worker)
+``/debug/profile``    on-demand host profile: ``?seconds=N`` capture
+                      (``&hz=``, ``&format=speedscope|collapsed|json``)
+``/debug/bundle``     ONE JSON artifact: config + metrics + flight ring
+                      + HBM breakdown + host profile (+ SLO burn) —
+                      what ``datafusion-tpu debug-bundle`` pulls from
+                      every live cluster member
+``/status``           node status JSON (also ``/healthz``,
+                      ``/debug/status`` — probe/backcompat surface)
+====================  =================================================
+
+Default OFF: no port configured means this module is never imported by
+the serving path — zero threads, zero sockets.  All handlers are
+read-only and best-effort; a broken provider answers 500, never takes
+the node down.
+
+``build_bundle()`` / ``write_local_bundle()`` also work in-process with
+no server — the CI smoketests dump a bundle artifact on failure that
+way, and ``debug-bundle`` with no target bundles the local process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+from datafusion_tpu.utils.metrics import METRICS
+
+_BUNDLE_PROFILE_S_DEFAULT = 0.5
+_PROFILE_S_CAP = 60.0
+_BUNDLE_PROFILE_S_CAP = 10.0
+
+
+def _node_label() -> str:
+    from datafusion_tpu.obs.trace import _ROLE
+
+    return f"{_ROLE}:{os.getpid()}"
+
+
+def _local_top_text() -> str:
+    """The local-node ``top`` view (a coordinator passes its own
+    fleet-wide ``top_text`` instead)."""
+    from datafusion_tpu.obs import slo
+    from datafusion_tpu.obs.aggregate import FleetAggregator
+
+    rows = slo.WATCHDOG.evaluate() if slo.WATCHDOG.armed() else None
+    return FleetAggregator().top_text(slo_rows=rows)
+
+
+def config_snapshot() -> dict:
+    """The node's effective configuration for the bundle: every
+    ``DATAFUSION_TPU_*`` env knob (plus the JAX platform pins), the
+    process identity, and — best-effort — the device inventory."""
+    env = {
+        k: v for k, v in sorted(os.environ.items())
+        if k.startswith("DATAFUSION_TPU_") or k in ("JAX_PLATFORMS",)
+    }
+    import sys
+
+    out = {
+        "node": _node_label(),
+        "pid": os.getpid(),
+        "python": sys.version.split()[0],
+        "argv": list(sys.argv),
+        "env": env,
+    }
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+        out["devices"] = [str(d) for d in jax.devices()]
+    except Exception:  # noqa: BLE001 — config capture is best-effort by contract
+        pass
+    return out
+
+
+def build_bundle(*, label: Optional[str] = None,
+                 gauges_fn: Optional[Callable[[], dict]] = None,
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 profile_seconds: float = _BUNDLE_PROFILE_S_DEFAULT,
+                 trace_id: Optional[str] = None) -> dict:
+    """The one-stop debug artifact (see module doc).  ``profile_seconds``
+    > 0 captures a fresh on-demand profile (bounded); the continuous
+    profiler's rolling report rides along when it is running."""
+    from datafusion_tpu.obs import device as _device
+    from datafusion_tpu.obs import profiler, recorder, slo
+    from datafusion_tpu.obs.aggregate import refresh_host_gauges
+    from datafusion_tpu.obs.device import LEDGER
+    from datafusion_tpu.obs.export import prometheus_text
+
+    refresh_host_gauges()
+    gauges = {}
+    if gauges_fn is not None:
+        try:
+            gauges = dict(gauges_fn() or {})
+        except Exception:  # noqa: BLE001 — a broken provider must not block the bundle
+            METRICS.add("obs.debug_provider_errors")
+    doc: dict = {
+        "type": "debug_bundle",
+        "node": label or _node_label(),
+        "recorded_at_ns": time.time_ns(),
+        "config": config_snapshot(),
+        "metrics": prometheus_text(METRICS, extra_gauges=gauges),
+        "gauges": gauges,
+        "flights": {
+            "events_emitted": recorder.emitted(),
+            "events": recorder.events(trace_id),
+        },
+        "hbm": (
+            {"enabled": True, **LEDGER.snapshot()}
+            if _device.enabled() else {"enabled": False}
+        ),
+        "slo": slo.WATCHDOG.evaluate() if slo.WATCHDOG.armed() else [],
+    }
+    if status_fn is not None:
+        try:
+            doc["status"] = status_fn()
+        except Exception:  # noqa: BLE001 — a broken provider must not block the bundle
+            METRICS.add("obs.debug_provider_errors")
+    seconds = min(max(float(profile_seconds), 0.0), _BUNDLE_PROFILE_S_CAP)
+    if seconds > 0:
+        doc["profile"] = profiler.capture_seconds(
+            seconds, name="bundle"
+        ).to_json()
+    cont = profiler.continuous_report()
+    if cont is not None:
+        doc["profile_continuous"] = cont.to_json()
+    METRICS.add("obs.debug_bundles")
+    return doc
+
+
+def write_local_bundle(directory: str, reason: str = "manual",
+                       profile_seconds: float = _BUNDLE_PROFILE_S_DEFAULT,
+                       ) -> str:
+    """Build this process's bundle and write it under ``directory`` —
+    the CI smoketests call this on failure so the run leaves a debug
+    artifact behind.  Returns the written path."""
+    os.makedirs(directory, exist_ok=True)
+    doc = build_bundle(profile_seconds=profile_seconds)
+    doc["reason"] = reason
+    path = os.path.join(
+        directory,
+        f"bundle-{doc['node'].replace(':', '-')}-{time.time_ns()}.json",
+    )
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, default=str)
+    return path
+
+
+def run_with_ci_bundle(fn: Callable[[], int], reason: str) -> int:
+    """Run a smoketest entry point; on ANY failure, write this
+    process's debug bundle under ``$DATAFUSION_TPU_CI_BUNDLE_DIR``
+    (when set — the CI workflow uploads that directory as a failure
+    artifact) before re-raising.  The bundle never masks the original
+    failure."""
+    try:
+        return fn()
+    except BaseException:
+        ci_dir = os.environ.get("DATAFUSION_TPU_CI_BUNDLE_DIR")
+        if ci_dir:
+            try:
+                import sys
+
+                path = write_local_bundle(ci_dir, reason)
+                print(f"smoke failed; debug bundle: {path}",
+                      file=sys.stderr, flush=True)
+            except Exception:  # noqa: BLE001 — the original failure must surface
+                pass
+        raise
+
+
+_INDEX = """datafusion-tpu debug plane ({label})
+
+GET /debug/metrics            Prometheus text exposition (alias /metrics)
+GET /debug/flights[?trace_id=]  flight-recorder ring dump (JSON)
+GET /debug/hbm                HBM residency ledger breakdown (JSON)
+GET /debug/top                fleet/local top view (text)
+GET /debug/profile?seconds=N[&hz=H&format=speedscope|collapsed|json]
+GET /debug/bundle[?seconds=N&trace_id=]  one artifact: everything above
+GET /status | /healthz        node status (JSON)
+"""
+
+
+def _make_handler():
+    from http.server import BaseHTTPRequestHandler
+
+    class _DebugHandler(BaseHTTPRequestHandler):
+        server_version = "datafusion-tpu-debug"
+
+        def _send(self, code: int, body: bytes,
+                  content_type: str = "application/json") -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _json(self, obj, code: int = 200) -> None:
+            self._send(code, json.dumps(obj, default=str).encode())
+
+        def _text(self, text: str, code: int = 200) -> None:
+            self._send(code, text.encode(),
+                       "text/plain; charset=utf-8")
+
+        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
+            from urllib.parse import parse_qs, urlparse
+
+            srv = self.server  # DebugServer
+            u = urlparse(self.path)
+            q = {k: v[-1] for k, v in parse_qs(u.query).items()}
+            path = u.path.rstrip("/") or "/"
+            try:
+                self._route(srv, path, q)
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # noqa: BLE001 — one bad request must not kill the plane
+                METRICS.add("obs.debug_request_errors")
+                try:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+                except OSError:
+                    pass
+
+        def _route(self, srv, path: str, q: dict) -> None:
+            if path in ("/", "/debug"):
+                self._text(_INDEX.format(label=srv.label))
+            elif path in ("/debug/metrics", "/metrics"):
+                from datafusion_tpu.obs.aggregate import refresh_host_gauges
+                from datafusion_tpu.obs.export import prometheus_text
+
+                refresh_host_gauges()
+                self._send(
+                    200,
+                    prometheus_text(
+                        METRICS, extra_gauges=srv.gauges()
+                    ).encode(),
+                    "text/plain; version=0.0.4",
+                )
+            elif path == "/debug/flights":
+                from datafusion_tpu.obs import recorder
+
+                self._json({
+                    "node": srv.label,
+                    "events_emitted": recorder.emitted(),
+                    "events": recorder.events(q.get("trace_id") or None),
+                })
+            elif path == "/debug/hbm":
+                from datafusion_tpu.obs import device as _device
+                from datafusion_tpu.obs.device import LEDGER
+
+                if _device.enabled():
+                    self._json({"enabled": True, **LEDGER.snapshot()})
+                else:
+                    self._json({"enabled": False})
+            elif path == "/debug/top":
+                self._text(srv.top())
+            elif path == "/debug/profile":
+                from datafusion_tpu.obs import profiler
+
+                seconds = min(
+                    max(float(q.get("seconds", 1.0)), 0.0), _PROFILE_S_CAP
+                )
+                hz = float(q["hz"]) if q.get("hz") else None
+                rep = profiler.capture_seconds(
+                    seconds, hz=hz, name="/debug/profile"
+                )
+                fmt = q.get("format", "speedscope")
+                if fmt == "collapsed":
+                    self._text(rep.collapsed())
+                elif fmt == "json":
+                    self._json(rep.to_json())
+                else:
+                    self._json(rep.speedscope())
+            elif path == "/debug/bundle":
+                self._json(build_bundle(
+                    label=srv.label,
+                    gauges_fn=srv.gauges,
+                    status_fn=srv.status_fn,
+                    profile_seconds=float(
+                        q.get("seconds", _BUNDLE_PROFILE_S_DEFAULT)
+                    ),
+                    trace_id=q.get("trace_id") or None,
+                ))
+            elif path in ("/status", "/healthz", "/debug/status"):
+                self._json(srv.status())
+            else:
+                self._json({"error": f"unknown path {path}"}, 404)
+
+        def log_message(self, *args):  # quiet: one line per probe scrape
+            pass
+
+    return _DebugHandler
+
+
+class DebugServer:
+    """One node's debug plane.  Providers are injected so the same
+    server runs on a worker (worker-state status/gauges) and a
+    coordinator (fleet-aggregated gauges + fleet top):
+
+    - ``gauges_fn``: extra point-in-time gauges for the scrape;
+    - ``status_fn``: the ``/status`` JSON (defaults to a minimal
+      uptime/label document);
+    - ``top_fn``: the ``/debug/top`` text (defaults to the local-node
+      fleet view).
+    """
+
+    def __init__(self, port: int, host: str = "127.0.0.1", *,
+                 label: Optional[str] = None,
+                 gauges_fn: Optional[Callable[[], dict]] = None,
+                 status_fn: Optional[Callable[[], dict]] = None,
+                 top_fn: Optional[Callable[[], str]] = None):
+        from http.server import ThreadingHTTPServer
+
+        self.label = label or _node_label()
+        self.gauges_fn = gauges_fn
+        self.status_fn = status_fn
+        self.top_fn = top_fn
+        self.started = time.time()
+
+        outer = self
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+            # handler-facing providers (the handler sees this object
+            # as `self.server`)
+            label = outer.label
+
+            def gauges(self):
+                if outer.gauges_fn is None:
+                    return {}
+                return outer.gauges_fn() or {}
+
+            def top(self):
+                if outer.top_fn is not None:
+                    return outer.top_fn()
+                return _local_top_text()
+
+            def status(self):
+                if outer.status_fn is not None:
+                    return outer.status_fn()
+                return {
+                    "type": "status",
+                    "node": outer.label,
+                    "uptime_s": round(time.time() - outer.started, 1),
+                }
+
+            @property
+            def status_fn(self):
+                return outer.status_fn
+
+        self._http = _Server((host, int(port)), _make_handler())
+        self._thread = threading.Thread(
+            target=self._http.serve_forever,
+            name="df-tpu-debug-http", daemon=True,
+        )
+        self._thread.start()
+
+    # -- address / lifecycle ------------------------------------------
+    @property
+    def server_address(self):  # backcompat with the old HTTP status shim
+        return self._http.server_address
+
+    @property
+    def port(self) -> int:
+        return int(self._http.server_address[1])
+
+    @property
+    def url(self) -> str:
+        host, port = self._http.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def shutdown(self) -> None:  # backcompat alias
+        self._http.shutdown()
+
+    def close(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+
+
+def start_debug_server(port: Optional[int], host: str = "127.0.0.1",
+                       **providers) -> Optional[DebugServer]:
+    """Start the debug plane when ``port`` is configured (0/None =
+    off — the documented default; a NEGATIVE port binds an ephemeral
+    one, for tests and smoke harnesses that read ``.port`` back).
+    Bind failures are reported, not fatal: a node without its debug
+    port is degraded, not down."""
+    if not port:
+        return None
+    try:
+        return DebugServer(max(int(port), 0), host, **providers)
+    except OSError:
+        METRICS.add("obs.debug_server_errors")
+        return None

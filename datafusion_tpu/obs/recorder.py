@@ -285,6 +285,14 @@ def capture_query_artifacts(reason: str, *, wall_s: Optional[float] = None,
         }}
         if phases:
             extra["query"]["phases"] = dict(phases)
+        # the continuous host profiler's rolling report rides along
+        # (DATAFUSION_TPU_PROFILE_HZ): the slow query's artifact then
+        # answers "where was the host CPU" beside "what happened"
+        from datafusion_tpu.obs import profiler as _profiler
+
+        prof = _profiler.continuous_report()
+        if prof is not None and prof.samples:
+            extra["profile"] = prof.to_json()
         if spans:
             extra["otlp"] = spans_to_otlp(spans)
         if node_dumps_fn is not None:

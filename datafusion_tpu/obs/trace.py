@@ -31,7 +31,36 @@ from contextlib import contextmanager
 from typing import Any, Optional
 
 from datafusion_tpu.analysis import lockcheck
+from datafusion_tpu.utils import metrics as _metrics
 from datafusion_tpu.utils.metrics import METRICS
+
+
+def _publish_thread_trace(trace_id: Optional[str]):
+    """Project this thread's trace id into the sampling profiler's
+    cross-thread table (utils/metrics.PROFILE_TRACES) — a sampler
+    cannot read another thread's contextvars, so adoption/session entry
+    publishes the same fact there.  Returns a restore token; one
+    module-global read + None check when no capture is active."""
+    tbl = _metrics.PROFILE_TRACES
+    if tbl is None:
+        return None
+    tid = threading.get_ident()
+    prev = tbl.get(tid)
+    if trace_id is None:
+        tbl.pop(tid, None)
+    else:
+        tbl[tid] = trace_id
+    return (tbl, tid, prev)
+
+
+def _restore_thread_trace(token) -> None:
+    if token is None:
+        return
+    tbl, tid, prev = token
+    if prev is None:
+        tbl.pop(tid, None)
+    else:
+        tbl[tid] = prev
 
 _TRUTHY = ("1", "true", "on", "yes")
 _ENABLED = os.environ.get("DATAFUSION_TPU_TRACE", "").lower() in _TRUTHY
@@ -339,7 +368,7 @@ class adopt:
     on for exactly this thread's work, even when the worker process has
     tracing off.  A None/invalid wire dict is a no-op."""
 
-    __slots__ = ("_tc", "_tok_trace", "_tok_span", "_active")
+    __slots__ = ("_tc", "_tok_trace", "_tok_span", "_active", "_tok_pub")
 
     def __init__(self, wire: Optional[dict]):
         self._tc = TraceContext.from_wire(wire)
@@ -349,6 +378,7 @@ class adopt:
         if self._tc is None:
             return None
         self._active = True
+        self._tok_pub = _publish_thread_trace(self._tc.trace_id)
         self._tok_trace = _current_trace.set(self._tc)
         # synthetic (never-recorded) parent handle so children chain to
         # the remote dispatch span
@@ -365,6 +395,7 @@ class adopt:
         if self._active:
             _current_span.reset(self._tok_span)
             _current_trace.reset(self._tok_trace)
+            _restore_thread_trace(self._tok_pub)
             self._active = False
         return False
 
@@ -387,6 +418,7 @@ def session():
     _install_compile_listener()
     tc = TraceContext()
     token = _current_trace.set(tc)
+    pub = _publish_thread_trace(tc.trace_id)
     with _lock:
         _SESSION_DEPTH += 1
         prev_ambient = _ambient_trace
@@ -399,6 +431,7 @@ def session():
             if _ambient_trace is tc:
                 _ambient_trace = prev_ambient
         _current_trace.reset(token)
+        _restore_thread_trace(pub)
 
 
 def _install_compile_listener() -> None:

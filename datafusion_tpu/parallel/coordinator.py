@@ -852,6 +852,7 @@ class DistributedContext(ExecutionContext):
         query_deadline_s: Optional[float] = None,
         result_cache=None,
         cluster=None,
+        debug_port: Optional[int] = None,
     ):
         import os
 
@@ -891,6 +892,22 @@ class DistributedContext(ExecutionContext):
         from datafusion_tpu.obs.aggregate import FleetAggregator
 
         self.telemetry = FleetAggregator()
+        # debug HTTP plane (obs/httpd.py): the coordinator's /debug/top
+        # serves the FLEET view; default off (no env/kwarg = no thread,
+        # no socket), negative = ephemeral port
+        if debug_port is None:
+            env_port = os.environ.get("DATAFUSION_TPU_DEBUG_PORT")
+            debug_port = int(env_port) if env_port else None
+        self.debug_server = None
+        if debug_port:
+            from datafusion_tpu.obs.httpd import start_debug_server
+
+            self.debug_server = start_debug_server(
+                debug_port,
+                label=f"coordinator:{os.getpid()}",
+                gauges_fn=self._debug_gauges,
+                top_fn=self.top_text,
+            )
         from datafusion_tpu.analysis import lockcheck
 
         self._workers_lock = lockcheck.make_lock("coord.workers")
@@ -927,11 +944,21 @@ class DistributedContext(ExecutionContext):
         host, _, port = addr.rpartition(":")
         return host, int(port)
 
+    def _debug_gauges(self) -> dict:
+        """The debug plane's scrape gauges: fleet-aggregated telemetry
+        plus membership (the same set `metrics_text` folds in)."""
+        gauges = self.fleet_gauges()
+        if self.membership is not None:
+            gauges.update(self.membership.gauges())
+        return gauges
+
     def close(self) -> None:
         if self.heartbeat is not None:
             self.heartbeat.stop()
         if self._shared_tier is not None:
             self._shared_tier.close()
+        if self.debug_server is not None:
+            self.debug_server.close()
 
     def __enter__(self) -> "DistributedContext":
         return self

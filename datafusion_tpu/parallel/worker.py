@@ -147,6 +147,10 @@ class WorkerState:
         # cluster agent (cluster/agent.py): lease registration +
         # invalidation apply; None outside cluster mode
         self.cluster_agent = None
+        # debug HTTP plane port (obs/httpd.py), when one is serving —
+        # advertised in the cluster lease so `datafusion-tpu
+        # debug-bundle --cluster` can pull this worker's bundle
+        self.debug_port: Optional[int] = None
 
     def _gauges(self) -> dict:
         """Point-in-time gauges for the Prometheus rendering: span
@@ -481,53 +485,25 @@ class WorkerServer(socketserver.ThreadingTCPServer):
 
 
 def serve_http_status(state: WorkerState, host: str, port: int):
-    """Human-facing HTTP status endpoint: `GET /status` (also `/` and
-    `/healthz`) returns the same JSON the fragment protocol's
-    `{"type": "status"}` request does; `GET /metrics` serves the
-    Prometheus text exposition directly (counters, span-buffer depth,
-    cache gauges — one scrape covers everything).  The reference's
-    worker image EXPOSEd 8080 for a web UI that never shipped
-    (`scripts/docker/worker/Dockerfile`); this is the working minimum —
-    curl-able by an operator, scrapeable by a probe."""
-    import json
-    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    """The worker's debug HTTP plane (obs/httpd.py): `GET /status`
+    (also `/healthz`) returns the same JSON the fragment protocol's
+    `{"type": "status"}` request does, `GET /metrics` (and
+    `/debug/metrics`) serves the Prometheus text exposition, and the
+    full `/debug/*` catalog — flight-recorder dump, HBM ledger
+    breakdown, on-demand host profile, one-stop debug bundle — rides
+    the same port.  The reference's worker image EXPOSEd 8080 for a
+    web UI that never shipped (`scripts/docker/worker/Dockerfile`);
+    this is the working operator surface."""
+    import os as _os
 
-    class _StatusHandler(BaseHTTPRequestHandler):
-        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            path = self.path.split("?")[0]
-            if path == "/metrics":
-                from datafusion_tpu.obs.export import prometheus_text
-                from datafusion_tpu.utils.metrics import METRICS
+    from datafusion_tpu.obs.httpd import DebugServer
 
-                body = prometheus_text(
-                    METRICS, extra_gauges=state._gauges()
-                ).encode()
-                self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-                return
-            if path not in ("/", "/status", "/healthz"):
-                self.send_response(404)
-                self.end_headers()
-                return
-            body = json.dumps(state.status()).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-
-        def log_message(self, *args):  # quiet: one line per probe scrape
-            pass
-
-    srv = ThreadingHTTPServer((host, port), _StatusHandler)
-    threading.Thread(
-        target=srv.serve_forever, name="df-tpu-worker-http", daemon=True
-    ).start()
-    return srv
+    return DebugServer(
+        port, host,
+        label=f"worker:{_os.getpid()}",
+        gauges_fn=state._gauges,
+        status_fn=state.status,
+    )
 
 
 def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
@@ -549,10 +525,20 @@ def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
     host, _, port = bind.partition(":")
     server = WorkerServer((host, int(port or 0)), _Handler)
     server.worker_state = WorkerState(device=device, batch_size=batch_size)  # type: ignore[attr-defined]
+    server.http_server = None  # type: ignore[attr-defined]
     if http_port:
-        server.http_server = serve_http_status(  # type: ignore[attr-defined]
-            server.worker_state, host, http_port
-        )
+        # negative = ephemeral bind (smoke harnesses read the port
+        # back); a bind failure degrades the debug plane, not the node
+        try:
+            server.http_server = serve_http_status(  # type: ignore[attr-defined]
+                server.worker_state, host, max(int(http_port), 0)
+            )
+        except OSError:
+            from datafusion_tpu.utils.metrics import METRICS
+
+            METRICS.add("obs.debug_server_errors")
+        else:
+            server.worker_state.debug_port = server.http_server.port  # type: ignore[attr-defined]
     if cluster:
         from datafusion_tpu import cluster as _cluster_mod
         from datafusion_tpu.cluster.agent import WorkerClusterAgent
@@ -596,9 +582,14 @@ def main(argv=None) -> int:
     # default OFF: several workers commonly share one host (tests, the
     # compose cluster maps container-internal 8080s to distinct host
     # ports); the worker image turns it on explicitly
-    ap.add_argument("--http-port", type=int, default=0,
-                    help="HTTP GET /status port (default 0 = disabled; "
-                         "the worker image passes 8080)")
+    ap.add_argument("--http-port", type=int,
+                    default=int(os.environ.get(
+                        "DATAFUSION_TPU_DEBUG_PORT", "0") or 0),
+                    help="debug HTTP plane port (/status, /metrics, "
+                         "/debug/* — obs/httpd.py).  Default 0 = "
+                         "disabled (env DATAFUSION_TPU_DEBUG_PORT "
+                         "overrides); negative = ephemeral; the worker "
+                         "image passes 8080")
     # multi-host accelerator bring-up (jax.distributed — the etcd
     # replacement, SURVEY §5.8): workers on a TPU pod join one global
     # mesh before serving fragments
@@ -655,8 +646,8 @@ def main(argv=None) -> int:
                    advertise=args.advertise)
     host, port = server.server_address[:2]
     print(f"worker listening on {host}:{port}", flush=True)
-    if args.http_port:
-        print(f"worker status: http://{host}:{args.http_port}/status", flush=True)
+    if server.http_server is not None:
+        print(f"worker debug: {server.http_server.url}/debug", flush=True)
     if cluster:
         print(f"worker cluster: registered with {cluster}", flush=True)
     from datafusion_tpu.native import native_available

@@ -10,9 +10,56 @@ bytes H2D, jit cache activity) — queryable via
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
+
+# -- profiler publication tables (obs/profiler.py) --------------------
+# While the sampling profiler has at least one active capture, these
+# hold {thread_ident: current stage timer name} and {thread_ident:
+# current trace_id}; the sampler thread reads them to attribute each
+# stack sample to a phase and a query.  They live HERE (not in the
+# profiler) so the publishers — `Metrics.timer`, the device-put seam,
+# `obs/trace.adopt` — need no new imports and pay exactly one module-
+# global read + None check when profiling is off.  All accesses are
+# plain dict ops (lock-free per the DF005 contract: publication runs
+# inside other subsystems' critical sections).  A table swapped out
+# mid-scope means a stale restore writes into an orphaned dict — a
+# benign race the profiler tolerates (the next timer entry republishes).
+PROFILE_STAGES = None  # type: ignore[var-annotated]
+PROFILE_TRACES = None  # type: ignore[var-annotated]
+
+
+def set_profile_tables(stages, traces) -> None:
+    """Install (or clear, with None/None) the publication tables —
+    called by the profiler on first-capture start / last-capture end."""
+    global PROFILE_STAGES, PROFILE_TRACES
+    PROFILE_STAGES = stages
+    PROFILE_TRACES = traces
+
+
+def stage_enter(name: str):
+    """Publish `name` as this thread's active stage for the sampling
+    profiler.  Returns a restore token for `stage_exit` (None when no
+    profiler is capturing — the disabled cost is one global read)."""
+    tbl = PROFILE_STAGES
+    if tbl is None:
+        return None
+    tid = threading.get_ident()
+    prev = tbl.get(tid)
+    tbl[tid] = name
+    return (tbl, tid, prev)
+
+
+def stage_exit(token) -> None:
+    if token is None:
+        return
+    tbl, tid, prev = token
+    if prev is None:
+        tbl.pop(tid, None)
+    else:
+        tbl[tid] = prev
 
 
 class Metrics:
@@ -31,11 +78,13 @@ class Metrics:
 
     @contextmanager
     def timer(self, name: str):
+        tok = stage_enter(name)
         t0 = time.perf_counter()
         try:
             yield
         finally:
             self.timings[name] += time.perf_counter() - t0
+            stage_exit(tok)
 
     def add(self, name: str, n: int = 1):
         self.counts[name] += n
@@ -61,6 +110,7 @@ class Metrics:
         """Wrap a generator so time spent *producing* items (host parse,
         encode) accrues to `name`, while consumer time doesn't."""
         while True:
+            tok = stage_enter(name)
             t0 = time.perf_counter()
             try:
                 item = next(it)
@@ -68,6 +118,7 @@ class Metrics:
                 return
             finally:
                 self.timings[name] += time.perf_counter() - t0
+                stage_exit(tok)
             yield item
 
     def gauge(self, name: str, value: float) -> None:

@@ -90,17 +90,25 @@ def device_call(fn, /, *args, _tag=None, **kwargs):
         try:
             faults.check("device.call", attempt=attempt)
             from datafusion_tpu.obs.device import profile_sync_active
+            from datafusion_tpu.utils.metrics import stage_enter, stage_exit
 
+            # published as this thread's active stage so the sampling
+            # profiler (obs/profiler.py) attributes samples taken here
+            # to the "execute" phase — same name as the stage timer
+            stage_tok = stage_enter("device.dispatch")
             t0 = time.perf_counter()
-            out = fn(*args, **kwargs)
-            if profile_sync_active():
-                # phase-profiled run (EXPLAIN ANALYZE, bench cold
-                # legs): block so the "execute" slice measures device
-                # wall, not async dispatch — production launches stay
-                # async (see obs/device.profile_sync)
-                import jax
+            try:
+                out = fn(*args, **kwargs)
+                if profile_sync_active():
+                    # phase-profiled run (EXPLAIN ANALYZE, bench cold
+                    # legs): block so the "execute" slice measures device
+                    # wall, not async dispatch — production launches stay
+                    # async (see obs/device.profile_sync)
+                    import jax
 
-                jax.block_until_ready(out)
+                    jax.block_until_ready(out)
+            finally:
+                stage_exit(stage_tok)
             wall = time.perf_counter() - t0
             # every successful dispatch is one executable launch — the
             # unit the fused-pass work minimizes (launches_per_pass in

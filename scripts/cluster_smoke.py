@@ -561,4 +561,8 @@ if __name__ == "__main__":
         for spec in sys.argv[1:]:
             host, port = spec.rsplit(":", 1)
             addrs.append((host, int(port)))
-    sys.exit(main(addrs))
+    from datafusion_tpu.obs.httpd import run_with_ci_bundle
+
+    sys.exit(run_with_ci_bundle(
+        lambda: main(addrs), "cluster_smoke_failure"
+    ))

@@ -1,0 +1,271 @@
+#!/usr/bin/env python
+"""Debug-plane smoketest: the host profiler + unified debug HTTP plane
+end to end.
+
+1. **EXPLAIN ANALYZE host profile**: a cold CSV aggregate runs under
+   the scoped sampling profiler; the report must carry, per phase, the
+   top host stack frames by sample count (<= 3 each), and the rendered
+   report shows the "Host profile" block.
+2. **Cluster debug plane**: cluster state service + 2 workers started
+   with debug HTTP ports; their leases must advertise `debug_port`.
+3. **debug-bundle CLI**: after a distributed query,
+   `python -m datafusion_tpu.cli debug-bundle --cluster host:p` must
+   return ONE bundle per live member, each containing the Prometheus
+   metrics text, the flight ring, the HBM breakdown, and a NON-EMPTY
+   host profile.
+4. **Worker endpoints**: `/debug/flights` (with `?trace_id=` filter)
+   and `/debug/bundle` on a live worker parse and carry real events.
+5. **Coordinator debug plane**: a coordinator started with
+   `debug_port` serves the FLEET top view over HTTP.
+
+Exit non-zero on any violation.  `scripts/smoketest.sh` runs this after
+the trace smoke.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def _write_csv(tmpdir: str, rows: int = 200_000) -> str:
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    path = os.path.join(tmpdir, "events.csv")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("k,v,x\n")
+        for i in range(rows):
+            f.write(f"k{i % 29},{rng.integers(-999, 999)},"
+                    f"{rng.uniform(-5, 5):.6f}\n")
+    return path
+
+
+def _write_partitions(tmpdir: str, n_parts: int = 3, rows_per: int = 800):
+    import numpy as np
+
+    rng = np.random.default_rng(5)
+    paths = []
+    for p in range(n_parts):
+        path = os.path.join(tmpdir, f"part{p}.csv")
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("region,v\n")
+            for _ in range(rows_per):
+                f.write(f"r{rng.integers(0, 4)},"
+                        f"{rng.integers(-1000, 1000)}\n")
+        paths.append(path)
+    return paths
+
+
+def _spawn(env, module, args):
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module, "--bind", "127.0.0.1:0", *args],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on" in line, f"{module} failed to start: {line!r}"
+    host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+    return proc, (host, int(port))
+
+
+def _spawn_worker_with_debug(env):
+    """Worker + ephemeral debug HTTP port; returns (proc, addr, debug_url)."""
+    proc, addr = _spawn(env, "datafusion_tpu.worker",
+                        ["--device", "cpu", "--http-port", "-1"])
+    debug_url = None
+    deadline = time.monotonic() + 30
+    while debug_url is None and time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "worker debug:" in line:
+            debug_url = line.split("worker debug:", 1)[1].strip()
+            debug_url = debug_url.rsplit("/debug", 1)[0]
+    assert debug_url, "worker never printed its debug URL"
+    return proc, addr, debug_url
+
+
+def _get_json(url: str, timeout: float = 30):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        assert resp.status == 200, (url, resp.status)
+        return json.loads(resp.read())
+
+
+def phase_explain_profile(tmpdir: str) -> None:
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+
+    path = _write_csv(tmpdir)
+    ctx = ExecutionContext(device="cpu")
+    schema = Schema([
+        Field("k", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+        Field("x", DataType.FLOAT64, False),
+    ])
+    ctx.register_csv("events", path, schema, has_header=True)
+    res = ctx.sql_collect(
+        "EXPLAIN ANALYZE SELECT k, SUM(v), AVG(x), COUNT(1) "
+        "FROM events GROUP BY k"
+    )
+    prof = res.host_profile
+    assert prof is not None and prof.samples > 0, "no host profile"
+    by_phase = prof.by_phase(3)
+    assert by_phase, "no phases attributed"
+    for phase, d in by_phase.items():
+        assert 1 <= len(d["top_frames"]) <= 3, (phase, d)
+        for label, count in d["top_frames"]:
+            assert isinstance(label, str) and count >= 1, (phase, d)
+    report = res.report()
+    assert "Host profile" in report, report[:400]
+    # a cold CSV scan spends real wall in decode: the profile must
+    # name frames for it (the attribution the phase bar cannot give)
+    assert "decode" in by_phase, sorted(by_phase)
+    print(f"explain profile: {prof.summary()}; phases "
+          f"{ {p: d['samples'] for p, d in by_phase.items()} }",
+          flush=True)
+
+
+def main() -> int:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("DATAFUSION_TPU_DEBUG_PORT", None)
+    procs = []
+    tmpdir = tempfile.mkdtemp(prefix="df_tpu_debug_smoke_")
+    try:
+        # 1. EXPLAIN ANALYZE per-phase host frames (single process)
+        phase_explain_profile(tmpdir)
+
+        # 2. cluster service + 2 debug-enabled workers
+        svc_proc, svc_addr = _spawn(env, "datafusion_tpu.cluster", [])
+        procs.append(svc_proc)
+        svc = f"{svc_addr[0]}:{svc_addr[1]}"
+        wenv = dict(env)
+        wenv["DATAFUSION_TPU_CLUSTER"] = svc
+        worker_urls = {}
+        for _ in range(2):
+            proc, addr, debug_url = _spawn_worker_with_debug(wenv)
+            procs.append(proc)
+            worker_urls[f"{addr[0]}:{addr[1]}"] = debug_url
+
+        from datafusion_tpu.cluster import connect
+
+        client = connect(svc)
+        deadline = time.monotonic() + 120
+        while len(client.membership()["workers"]) < 2:
+            assert time.monotonic() < deadline, client.membership()
+            time.sleep(0.5)
+        members = client.membership()["workers"]
+        for addr, info in members.items():
+            assert info.get("debug_port"), (
+                f"worker {addr} lease lacks debug_port: {info}"
+            )
+        print(f"cluster up: {svc}, members advertise debug ports "
+              f"{ {a: i['debug_port'] for a, i in members.items()} }",
+              flush=True)
+
+        # 3. a real distributed query, then debug-bundle --cluster
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.datasource import CsvDataSource
+        from datafusion_tpu.parallel.coordinator import DistributedContext
+        from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+        schema = Schema([
+            Field("region", DataType.UTF8, False),
+            Field("v", DataType.INT64, False),
+        ])
+        paths = _write_partitions(tmpdir)
+        dctx = DistributedContext(cluster=svc, debug_port=-1)
+        dctx.register_datasource(
+            "t",
+            PartitionedDataSource(
+                [CsvDataSource(p, schema, True, 131072) for p in paths]
+            ),
+        )
+        rows = dctx.sql_collect(
+            "SELECT region, SUM(v), COUNT(1) FROM t GROUP BY region"
+        ).to_rows()
+        assert len(rows) == 4, rows
+
+        from datafusion_tpu.cli import main as cli_main
+
+        bundle_dir = os.path.join(tmpdir, "bundles")
+        rc = cli_main(["debug-bundle", "--cluster", svc,
+                       "--out", bundle_dir, "--seconds", "0.3"])
+        assert rc == 0, f"debug-bundle exited {rc}"
+        bundles = sorted(os.listdir(bundle_dir))
+        assert len(bundles) == 2, (
+            f"expected one bundle per member, got {bundles}"
+        )
+        for name in bundles:
+            with open(os.path.join(bundle_dir, name), encoding="utf-8") as f:
+                doc = json.load(f)
+            assert doc["type"] == "debug_bundle", name
+            assert "datafusion_tpu_events_total" in doc["metrics"], name
+            assert isinstance(doc["flights"]["events"], list), name
+            assert doc["flights"]["events"], f"{name}: empty flight ring"
+            assert doc["hbm"].get("enabled") is not None, name
+            assert doc["profile"]["samples"] > 0, (
+                f"{name}: empty host profile"
+            )
+            assert doc["config"]["env"], name
+        print(f"debug-bundle --cluster: {len(bundles)} bundles, each "
+              "with metrics + flights + hbm + non-empty profile",
+              flush=True)
+
+        # 4. live-worker endpoints: /debug/flights (+trace filter) and
+        # /debug/bundle parse and carry the query's events
+        wurl = next(iter(worker_urls.values()))
+        flights = _get_json(f"{wurl}/debug/flights")
+        kinds = {e["kind"] for e in flights["events"]}
+        assert kinds & {"fragment.serve", "cache.hit", "query.admit"}, kinds
+        traced = [e for e in flights["events"] if e.get("trace_id")]
+        if traced:
+            tid = traced[0]["trace_id"]
+            filtered = _get_json(f"{wurl}/debug/flights?trace_id={tid}")
+            assert filtered["events"], "trace filter dropped everything"
+            assert all(e.get("trace_id") == tid
+                       for e in filtered["events"])
+        wbundle = _get_json(f"{wurl}/debug/bundle?seconds=0.2")
+        assert wbundle["profile"]["samples"] > 0
+        assert wbundle["status"]["type"] == "status"
+        print(f"worker endpoints: {len(flights['events'])} flight "
+              "events, bundle parses", flush=True)
+
+        # 5. coordinator debug plane: fleet top over HTTP
+        assert dctx.debug_server is not None, "coordinator debug off"
+        with urllib.request.urlopen(
+            f"{dctx.debug_server.url}/debug/top", timeout=30
+        ) as resp:
+            top = resp.read().decode()
+        assert top.startswith("fleet:"), top[:100]
+        for addr in worker_urls:
+            assert addr in top, f"{addr} missing from fleet top:\n{top}"
+        dctx.close()
+        print("coordinator /debug/top serves the fleet view", flush=True)
+
+        print("\nDEBUG SMOKE PASSED")
+        return 0
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait(timeout=10)
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    from datafusion_tpu.obs.httpd import run_with_ci_bundle
+
+    sys.exit(run_with_ci_bundle(main, "debug_smoke_failure"))

@@ -33,6 +33,9 @@ python scripts/chaos_smoke.py
 echo "== trace smoke (EXPLAIN ANALYZE + merged worker trace + flight-recorder artifact + OTLP export) =="
 python scripts/trace_smoke.py
 
+echo "== debug smoke (host profiler per-phase frames + debug HTTP plane + debug-bundle CLI on a 2-worker cluster) =="
+python scripts/debug_smoke.py
+
 echo "== cache smoke (result + fragment caches, invalidation, off-switch) =="
 python scripts/cache_smoke.py
 

@@ -62,27 +62,34 @@ def _write_partitions(tmpdir: str, n_parts: int = 3, rows_per: int = 500):
 def _spawn_worker(env):
     proc = subprocess.Popen(
         [sys.executable, "-m", "datafusion_tpu.worker",
-         "--bind", "127.0.0.1:0", "--device", "cpu"],
+         "--bind", "127.0.0.1:0", "--device", "cpu",
+         "--http-port", "-1"],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
     )
     line = proc.stdout.readline()
     assert "listening on" in line, f"worker failed to start: {line!r}"
     host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
-    return proc, (host, int(port))
+    # the debug HTTP plane's base URL (obs/httpd.py) prints next
+    debug_line = proc.stdout.readline()
+    assert "worker debug:" in debug_line, debug_line
+    debug_url = debug_line.split("worker debug:", 1)[1].strip()
+    debug_url = debug_url.rsplit("/debug", 1)[0]
+    return proc, (host, int(port)), debug_url
 
 
 def main() -> int:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)
-    procs, addrs = [], []
+    procs, addrs, debug_urls = [], [], []
     tmpdir = tempfile.mkdtemp(prefix="df_tpu_trace_smoke_")
     try:
         for _ in range(2):
-            proc, addr = _spawn_worker(env)
+            proc, addr, debug_url = _spawn_worker(env)
             procs.append(proc)
             addrs.append(addr)
+            debug_urls.append(debug_url)
 
         from datafusion_tpu.exec.datasource import CsvDataSource
         from datafusion_tpu.datatypes import DataType, Field, Schema
@@ -228,12 +235,41 @@ def main() -> int:
         for addr in worker_addrs:
             assert addr in top, top
 
+        # 7. debug HTTP plane (obs/httpd.py): a live worker's
+        # /debug/flights carries the query's ring (trace-filterable)
+        # and /debug/bundle returns one parseable artifact with a
+        # non-empty host profile
+        import urllib.request
+
+        wurl = debug_urls[0]
+        with urllib.request.urlopen(
+            f"{wurl}/debug/flights", timeout=30
+        ) as resp:
+            flights = json.loads(resp.read())
+        kinds = {e["kind"] for e in flights["events"]}
+        assert kinds & {"fragment.serve", "cache.hit"}, kinds
+        with urllib.request.urlopen(
+            f"{wurl}/debug/flights?trace_id={res2.trace_id}", timeout=30
+        ) as resp:
+            filtered = json.loads(resp.read())
+        assert all(e.get("trace_id") == res2.trace_id
+                   for e in filtered["events"]), filtered["events"][:3]
+        with urllib.request.urlopen(
+            f"{wurl}/debug/bundle?seconds=0.2", timeout=60
+        ) as resp:
+            bundle = json.loads(resp.read())
+        assert bundle["type"] == "debug_bundle"
+        assert "datafusion_tpu_events_total" in bundle["metrics"]
+        assert bundle["profile"]["samples"] > 0, "empty bundle profile"
+        assert bundle["flights"]["events"], "empty bundle flight ring"
+
         print(res.report())
         print(f"\nTRACE SMOKE PASSED ({len(res.spans)} spans, "
               f"{len(frags)} worker fragments, {len(procs_in_trace)} "
               f"processes in the Chrome trace; flight artifact covers "
               f"{1 + len(doc['nodes'])} nodes, OTLP round-trips "
-              f"{len(rt)} spans)")
+              f"{len(rt)} spans; worker debug bundle has "
+              f"{bundle['profile']['samples']} profile samples)")
         return 0
     finally:
         for p in procs:
@@ -246,4 +282,6 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    from datafusion_tpu.obs.httpd import run_with_ci_bundle
+
+    sys.exit(run_with_ci_bundle(main, "trace_smoke_failure"))

@@ -1,0 +1,682 @@
+"""Host-side sampling profiler (obs/profiler.py) + unified debug HTTP
+plane (obs/httpd.py): sampler lifecycle, collapsed/speedscope output,
+phase and trace attribution, the DF005/DF007 lint contract on the
+sample path, host-resource gauges, the hardened HBM capacity probe,
+the debug endpoints against an in-process server, and the
+`debug-bundle` CLI."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from datafusion_tpu.obs import profiler
+from datafusion_tpu.utils import metrics as umetrics
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def _busy_under_timer(stage: str, stop: threading.Event):
+    with METRICS.timer(stage):
+        x = 0
+        while not stop.is_set():
+            x += 1
+        return x
+
+
+def _capture_busy(stage: str = "scan.parse", seconds: float = 0.4,
+                  hz: float = 250.0):
+    """Run a busy thread inside `with METRICS.timer(stage)` under a
+    scoped capture; returns the report."""
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_busy_under_timer, args=(stage, stop),
+        name=f"busy-{stage}", daemon=True,
+    )
+    with profiler.profile(hz=hz, name="test") as cap:
+        t.start()
+        time.sleep(seconds)
+        stop.set()
+        t.join()
+    return cap.report()
+
+
+class TestSamplerLifecycle:
+    def test_no_thread_when_idle(self):
+        assert not profiler.PROFILER.running()
+        assert profiler.PROFILER.active_captures() == 0
+        # the publication tables are torn down too: disabled-mode
+        # Metrics.timer pays one global read, publishes nothing
+        assert umetrics.PROFILE_STAGES is None
+        assert umetrics.PROFILE_TRACES is None
+
+    def test_start_stop_tears_down_thread_and_tables(self):
+        cap = profiler.PROFILER.start_capture(hz=200)
+        try:
+            assert profiler.PROFILER.running()
+            assert umetrics.PROFILE_STAGES is not None
+        finally:
+            rep = profiler.PROFILER.stop_capture(cap)
+        assert not profiler.PROFILER.running()
+        assert umetrics.PROFILE_STAGES is None
+        assert rep.duration_s >= 0
+
+    def test_overlapping_captures_share_one_thread(self):
+        a = profiler.PROFILER.start_capture(hz=100)
+        b = profiler.PROFILER.start_capture(hz=100)
+        try:
+            assert profiler.PROFILER.active_captures() == 2
+            threads = [
+                t for t in threading.enumerate()
+                if t.name == "df-tpu-profiler"
+            ]
+            assert len(threads) == 1
+        finally:
+            profiler.PROFILER.stop_capture(a)
+            assert profiler.PROFILER.running()  # b still sampling
+            profiler.PROFILER.stop_capture(b)
+        assert not profiler.PROFILER.running()
+
+    def test_continuous_default_off_and_idempotent(self):
+        # default env (unset) = no continuous capture, no thread
+        assert not profiler.continuous_running()
+        assert profiler.maybe_start_continuous() is False
+        assert profiler.continuous_report() is None
+
+    def test_disabled_scope_is_noop(self):
+        with profiler.profile(enabled=False) as cap:
+            assert cap is None
+        assert not profiler.PROFILER.running()
+
+    def test_samples_accumulate(self):
+        rep = _capture_busy(seconds=0.3)
+        assert rep.samples > 5
+        assert rep.hz == 250.0
+
+
+class TestAttribution:
+    def test_phase_attribution_via_stage_timer(self):
+        # a thread busy inside `with METRICS.timer("scan.parse")` must
+        # attribute to the "decode" phase (obs/device._PHASE_TIMERS)
+        rep = _capture_busy("scan.parse", seconds=0.4)
+        phases = rep.phase_samples()
+        assert phases.get("decode", 0) > 3, phases
+        # and the busy function itself is a top decode frame
+        tops = [label for label, _n in rep.top_frames(5, "decode")]
+        assert any("_busy_under_timer" in t or "is_set" in t
+                   for t in tops), tops
+
+    def test_phase_attribution_execute(self):
+        rep = _capture_busy("device.dispatch", seconds=0.3)
+        assert rep.phase_samples().get("execute", 0) > 3
+
+    def test_unknown_stage_maps_to_other(self):
+        rep = _capture_busy("parse", seconds=0.3)  # not a phase timer
+        phases = rep.phase_samples()
+        assert phases.get("other", 0) > 3
+        assert "decode" not in phases or phases["decode"] < phases["other"]
+
+    def test_trace_correlation_via_session(self):
+        from datafusion_tpu.obs import trace as obs_trace
+
+        stop = threading.Event()
+        tid_trace = {}
+
+        def traced_busy():
+            with obs_trace.session() as tc:
+                tid_trace["trace_id"] = tc.trace_id
+                x = 0
+                while not stop.is_set():
+                    x += 1
+
+        t = threading.Thread(target=traced_busy, daemon=True)
+        with profiler.profile(hz=250) as cap:
+            t.start()
+            time.sleep(0.4)
+            stop.set()
+            t.join()
+        rep = cap.report()
+        assert rep.trace_counts.get(tid_trace["trace_id"], 0) > 3, (
+            rep.trace_counts
+        )
+        # table restored after the session ended (inside the capture
+        # the thread unpublished on session exit)
+        assert umetrics.PROFILE_TRACES is None
+
+    def test_trace_correlation_via_adopt(self):
+        from datafusion_tpu.obs import trace as obs_trace
+
+        with profiler.profile(hz=100):
+            with obs_trace.adopt({"trace_id": "feedbeef00000000"}):
+                tbl = umetrics.PROFILE_TRACES
+                assert tbl[threading.get_ident()] == "feedbeef00000000"
+            assert threading.get_ident() not in umetrics.PROFILE_TRACES
+
+
+class TestOutputFormats:
+    def test_collapsed_round_trips_counts(self):
+        rep = _capture_busy(seconds=0.3)
+        text = rep.collapsed()
+        assert text
+        total = 0
+        for line in text.splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit(), line
+            assert ";" in stack  # thread prefix + >=1 frame
+            total += int(count)
+        assert total == rep.samples
+
+    def test_speedscope_schema_and_round_trip(self):
+        rep = _capture_busy(seconds=0.3)
+        doc = rep.speedscope()
+        # schema essentials speedscope.app requires
+        assert doc["$schema"].endswith("file-format-schema.json")
+        assert doc["shared"]["frames"] and doc["profiles"]
+        json.dumps(doc)  # serializable
+        # round-trip: frames table + samples/weights reconstruct the
+        # exact per-stack sample counts
+        rebuilt: dict = {}
+        for prof in doc["profiles"]:
+            assert prof["type"] == "sampled"
+            assert len(prof["samples"]) == len(prof["weights"])
+            assert prof["endValue"] == sum(prof["weights"])
+            for stack, w in zip(prof["samples"], prof["weights"]):
+                frames = tuple(
+                    doc["shared"]["frames"][i]["name"] for i in stack
+                )
+                rebuilt[frames] = rebuilt.get(frames, 0) + w
+        want: dict = {}
+        for (_tid, _phase, frames), n in rep.stacks.items():
+            want[frames] = want.get(frames, 0) + n
+        assert rebuilt == want
+
+    def test_to_json_is_bounded_and_complete(self):
+        rep = _capture_busy(seconds=0.3)
+        doc = rep.to_json(max_lines=2)
+        assert doc["samples"] == rep.samples
+        assert doc["phases"]
+        assert len(doc["collapsed"].splitlines()) <= 2
+        json.dumps(doc)
+
+    def test_stack_cap_folds_into_truncated(self):
+        cap = profiler.ProfileCapture(hz=10)
+        saved = profiler._MAX_STACKS
+        profiler.configure(max_stacks=2)
+        try:
+            cap._fold(1, "other", ("a",), None)
+            cap._fold(1, "other", ("b",), None)
+            cap._fold(1, "other", ("c",), None)  # over the cap
+            cap._fold(1, "other", ("d",), None)
+        finally:
+            profiler.configure(max_stacks=saved)
+        assert cap.samples == 4
+        assert cap.truncated == 2
+        key = (1, "other", ("(truncated)",))
+        assert cap.stacks[key] == 2
+
+
+class TestLintContract:
+    """DF005 (no locks) and DF007 (no blocking IO) cover the sampler
+    path — both the real module staying clean and the rules actually
+    firing on synthetic violations."""
+
+    def _lint(self, src: str, relpath: str = "datafusion_tpu/obs/profiler.py"):
+        from datafusion_tpu.analysis import lint
+
+        return lint.lint_source(src, relpath)
+
+    def test_real_module_is_clean(self):
+        import datafusion_tpu.obs.profiler as mod
+
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            findings = self._lint(f.read())
+        assert findings == [], findings
+
+    def test_df005_catches_lock_in_fold(self):
+        src = (
+            "import threading\n"
+            "class C:\n"
+            "    def _fold(self, k):\n"
+            "        with self._lock:\n"
+            "            self.d[k] = 1\n"
+        )
+        rules = {f.rule for f in self._lint(src)}
+        assert "DF005" in rules
+
+    def test_df007_catches_blocking_io_in_sampler(self):
+        src = (
+            "class P:\n"
+            "    def _sample_once(self, me):\n"
+            "        with open('/tmp/x', 'w') as f:\n"
+            "            f.write('x')\n"
+            "    def _run(self):\n"
+            "        import time\n"
+            "        time.sleep(1)\n"
+        )
+        findings = self._lint(src)
+        df007 = [f for f in findings if f.rule == "DF007"]
+        names = " ".join(f.message for f in df007)
+        assert "open()" in names and "sleep()" in names
+
+    def test_df007_ignores_non_sampler_functions(self):
+        src = (
+            "def report():\n"
+            "    with open('/tmp/x', 'w') as f:\n"
+            "        f.write('x')\n"
+        )
+        assert [f for f in self._lint(src) if f.rule == "DF007"] == []
+
+    def test_metrics_stage_helpers_stay_lock_free(self):
+        import datafusion_tpu.utils.metrics as mod
+
+        with open(mod.__file__, "r", encoding="utf-8") as f:
+            findings = self._lint(
+                f.read(), "datafusion_tpu/utils/metrics.py"
+            )
+        assert findings == [], findings
+
+
+class TestHostGauges:
+    def test_refresh_sets_rss_and_fds(self):
+        from datafusion_tpu.obs.aggregate import refresh_host_gauges
+
+        g = refresh_host_gauges()
+        # Linux CI: /proc exists; the gauges are real and positive
+        assert g.get("host.rss_bytes", 0) > 0
+        assert g.get("host.rss_peak_bytes", 0) >= g["host.rss_bytes"] // 2
+        assert g.get("host.open_fds", 0) > 0
+        assert METRICS.gauges["host.rss_bytes"] == g["host.rss_bytes"]
+
+    def test_node_snapshot_carries_host_gauges(self):
+        from datafusion_tpu.obs.aggregate import node_snapshot
+
+        snap = node_snapshot()
+        assert snap["gauges"].get("host.rss_bytes", 0) > 0
+
+    def test_fleet_sums_host_gauges(self):
+        from datafusion_tpu.obs.aggregate import FleetAggregator
+
+        agg = FleetAggregator(include_local=False)
+        for i, rss in enumerate((100, 250)):
+            agg.ingest(f"w{i}", {
+                "ts": time.time(), "histograms": {}, "counts": {},
+                "gauges": {"host.rss_bytes": rss, "host.open_fds": 10},
+            })
+        g = agg.gauges()
+        assert g["fleet.host.rss_bytes"] == 350
+        assert g["fleet.host.open_fds"] == 20
+
+    def test_gc_pause_accrues(self):
+        import gc
+
+        from datafusion_tpu.obs import aggregate as agg
+
+        assert agg._gc_callback in gc.callbacks  # installed at import
+        before = METRICS.counts.get("host.gc_collections", 0)
+        gc.collect()
+        assert METRICS.counts.get("host.gc_collections", 0) > before
+        assert METRICS.timings.get("host.gc_pause", 0) >= 0
+
+
+class TestCapacityProbe:
+    """memory_stats() hardening: partial/raising/non-dict backends go
+    cleanly dormant (None) instead of risking a KeyError path."""
+
+    @pytest.fixture(autouse=True)
+    def _no_env(self, monkeypatch):
+        monkeypatch.delenv("DATAFUSION_TPU_HBM_BYTES", raising=False)
+
+    def _with_devices(self, monkeypatch, devices):
+        import jax
+
+        from datafusion_tpu.obs import device as obs_device
+
+        monkeypatch.setattr(jax, "devices", lambda: devices)
+        return obs_device.hbm_capacity_bytes()
+
+    def test_full_stats_sum(self, monkeypatch):
+        class _Dev:
+            def memory_stats(self):
+                return {"bytes_limit": 1 << 30, "bytes_in_use": 5}
+
+        assert self._with_devices(monkeypatch, [_Dev(), _Dev()]) \
+            == 2 * (1 << 30)
+
+    def test_partial_dict_without_limit_is_dormant(self, monkeypatch):
+        class _Partial:
+            def memory_stats(self):
+                # the real-world shape: the call EXISTS, the dict is
+                # populated, bytes_limit just isn't in it
+                return {"bytes_in_use": 123, "peak_bytes_in_use": 456}
+
+        assert self._with_devices(monkeypatch, [_Partial()]) is None
+
+    def test_raising_backend_is_dormant(self, monkeypatch):
+        class _Raises:
+            def memory_stats(self):
+                raise NotImplementedError("plugin backend")
+
+        assert self._with_devices(monkeypatch, [_Raises()]) is None
+
+    def test_non_dict_stats_is_dormant(self, monkeypatch):
+        class _Weird:
+            def memory_stats(self):
+                return "1GiB"
+
+        assert self._with_devices(monkeypatch, [_Weird()]) is None
+
+    def test_zero_or_bogus_limit_is_dormant(self, monkeypatch):
+        class _Zero:
+            def memory_stats(self):
+                return {"bytes_limit": 0}
+
+        class _Str:
+            def memory_stats(self):
+                return {"bytes_limit": "big"}
+
+        assert self._with_devices(monkeypatch, [_Zero()]) is None
+        assert self._with_devices(monkeypatch, [_Str()]) is None
+
+    def test_env_override_wins(self, monkeypatch):
+        from datafusion_tpu.obs import device as obs_device
+
+        monkeypatch.setenv("DATAFUSION_TPU_HBM_BYTES", "1e9")
+        assert obs_device.hbm_capacity_bytes() == int(1e9)
+
+
+@pytest.fixture(scope="class")
+def debug_server():
+    from datafusion_tpu.obs.httpd import start_debug_server
+
+    srv = start_debug_server(-1, label="test:1")
+    assert srv is not None
+    yield srv
+    srv.close()
+
+
+def _get(srv, path, timeout=30):
+    with urllib.request.urlopen(srv.url + path, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+
+
+class TestDebugHttpPlane:
+    def test_port_off_by_default(self):
+        from datafusion_tpu.obs.httpd import start_debug_server
+
+        assert start_debug_server(0) is None
+        assert start_debug_server(None) is None
+
+    def test_index(self, debug_server):
+        status, ctype, body = _get(debug_server, "/")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert b"/debug/bundle" in body
+
+    def test_metrics(self, debug_server):
+        status, ctype, body = _get(debug_server, "/debug/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        assert b"datafusion_tpu_events_total" in body
+        assert b'name="host.rss_bytes"' in body
+        # the absorbed legacy path serves the same exposition
+        status2, _ct, body2 = _get(debug_server, "/metrics")
+        assert status2 == 200
+        assert b"datafusion_tpu_events_total" in body2
+
+    def test_flights_and_trace_filter(self, debug_server):
+        from datafusion_tpu.obs import recorder, trace as obs_trace
+
+        recorder.record("test.noise", k=1)
+        with obs_trace.session() as tc:
+            recorder.record("test.signal", k=2)
+        status, ctype, body = _get(debug_server, "/debug/flights")
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        kinds = {e["kind"] for e in doc["events"]}
+        assert {"test.noise", "test.signal"} <= kinds
+        # ?trace_id= narrows to the one query
+        status, _ct, body = _get(
+            debug_server, f"/debug/flights?trace_id={tc.trace_id}"
+        )
+        doc = json.loads(body)
+        assert {e["kind"] for e in doc["events"]} == {"test.signal"}
+
+    def test_hbm(self, debug_server):
+        status, ctype, body = _get(debug_server, "/debug/hbm")
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["enabled"] is True
+        assert "live_bytes" in doc and "owners" in doc
+
+    def test_top(self, debug_server):
+        status, ctype, body = _get(debug_server, "/debug/top")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert body.decode().startswith("fleet:")
+
+    def test_profile_formats(self, debug_server):
+        status, ctype, body = _get(
+            debug_server, "/debug/profile?seconds=0.2"
+        )
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["profiles"]  # speedscope by default
+        status, ctype, body = _get(
+            debug_server, "/debug/profile?seconds=0.2&format=collapsed"
+        )
+        assert status == 200 and ctype.startswith("text/plain")
+        assert body.strip()
+        status, _ct, body = _get(
+            debug_server, "/debug/profile?seconds=0.2&format=json&hz=200"
+        )
+        doc = json.loads(body)
+        assert doc["samples"] > 0 and doc["hz"] == 200.0
+
+    def test_bundle_completeness(self, debug_server):
+        status, ctype, body = _get(debug_server, "/debug/bundle?seconds=0.2")
+        assert status == 200 and ctype.startswith("application/json")
+        doc = json.loads(body)
+        assert doc["type"] == "debug_bundle"
+        for key in ("config", "metrics", "gauges", "flights", "hbm",
+                    "profile", "slo"):
+            assert key in doc, key
+        assert doc["profile"]["samples"] > 0
+        assert "datafusion_tpu_events_total" in doc["metrics"]
+        assert isinstance(doc["flights"]["events"], list)
+        assert "env" in doc["config"] and "pid" in doc["config"]
+
+    def test_404(self, debug_server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(debug_server, "/debug/nope")
+        assert ei.value.code == 404
+
+    def test_status_and_healthz(self, debug_server):
+        for path in ("/status", "/healthz", "/debug/status"):
+            status, _ct, body = _get(debug_server, path)
+            assert status == 200
+            assert json.loads(body)["type"] == "status"
+
+    def test_no_sampler_thread_left_behind(self, debug_server):
+        _get(debug_server, "/debug/profile?seconds=0.1")
+        deadline = time.monotonic() + 5
+        while profiler.PROFILER.running() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not profiler.PROFILER.running()
+
+
+class TestWorkerDebugPlane:
+    def test_worker_http_serves_debug_catalog(self):
+        from datafusion_tpu.parallel.worker import serve
+
+        server = serve("127.0.0.1:0", device="cpu", http_port=-1)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        http = server.http_server
+        try:
+            assert http is not None
+            assert server.worker_state.debug_port == http.port
+            status, _ct, body = _get(http, "/status")
+            assert json.loads(body)["type"] == "status"
+            status, _ct, body = _get(http, "/debug/metrics")
+            assert b"datafusion_tpu_events_total" in body
+            status, _ct, body = _get(http, "/debug/bundle?seconds=0.1")
+            doc = json.loads(body)
+            assert doc["profile"]["samples"] > 0
+            # the worker's own status rides the bundle
+            assert doc["status"]["type"] == "status"
+        finally:
+            http.close()
+            server.shutdown()
+            server.server_close()
+
+    def test_agent_advertises_debug_port_in_lease(self):
+        from datafusion_tpu.cluster.agent import WorkerClusterAgent
+        from datafusion_tpu.cluster.client import LocalClusterClient
+        from datafusion_tpu.cluster.service import ClusterState
+
+        class _State:
+            batch_size = 1024
+            fragment_cache = None
+            debug_port = 18422
+
+        state = ClusterState()
+        agent = WorkerClusterAgent(
+            LocalClusterClient(state), "10.0.0.9:7", _State()
+        )
+        agent.poll_once()
+        info = state.membership()["workers"]["10.0.0.9:7"]
+        assert info["debug_port"] == 18422
+
+        class _NoDebug:
+            batch_size = 1024
+            fragment_cache = None
+
+        agent2 = WorkerClusterAgent(
+            LocalClusterClient(state), "10.0.0.10:7", _NoDebug()
+        )
+        agent2.poll_once()
+        info2 = state.membership()["workers"]["10.0.0.10:7"]
+        assert "debug_port" not in info2
+
+
+class TestDebugBundleCli:
+    def test_local_bundle(self, tmp_path, capsys):
+        from datafusion_tpu.cli import main
+
+        out = tmp_path / "bundles"
+        rc = main(["debug-bundle", "--out", str(out), "--seconds", "0.1"])
+        assert rc == 0
+        files = list(out.glob("bundle-*.json"))
+        assert len(files) == 1
+        doc = json.loads(files[0].read_text())
+        assert doc["type"] == "debug_bundle"
+        assert doc["profile"]["samples"] > 0
+
+    def test_workers_mode_pulls_each_member(self, tmp_path):
+        from datafusion_tpu.cli import run_debug_bundle
+        from datafusion_tpu.obs.httpd import start_debug_server
+
+        a = start_debug_server(-1, label="a:1")
+        b = start_debug_server(-1, label="b:2")
+        try:
+            workers = (f"127.0.0.1:{a.port},127.0.0.1:{b.port}")
+            import io
+
+            buf = io.StringIO()
+            rc = run_debug_bundle(None, workers, str(tmp_path), 0.1,
+                                  out=buf)
+            assert rc == 0, buf.getvalue()
+            files = sorted(tmp_path.glob("bundle-*.json"))
+            assert len(files) == 2
+            for f in files:
+                doc = json.loads(f.read_text())
+                assert doc["profile"]["samples"] > 0
+                assert "metrics" in doc and "hbm" in doc
+        finally:
+            a.close()
+            b.close()
+
+    def test_member_without_debug_port_fails(self, tmp_path):
+        import io
+
+        from datafusion_tpu.cli import run_debug_bundle
+        from datafusion_tpu.cluster.client import LocalClusterClient
+        from datafusion_tpu.cluster.service import ClusterState
+
+        state = ClusterState()
+        c = LocalClusterClient(state)
+        lease = c.lease_grant(30.0)["lease"]
+        c.put("workers/1.2.3.4:9", {"addr": "1.2.3.4:9"}, lease=lease)
+        import datafusion_tpu.cluster as cluster_mod
+
+        saved = cluster_mod.connect
+        cluster_mod.connect = lambda _t: c
+        try:
+            buf = io.StringIO()
+            rc = run_debug_bundle("fake:1", None, str(tmp_path), 0.1,
+                                  out=buf)
+        finally:
+            cluster_mod.connect = saved
+        assert rc == 1
+        assert "NO debug port" in buf.getvalue()
+
+    def test_write_local_bundle_for_ci(self, tmp_path):
+        from datafusion_tpu.obs.httpd import write_local_bundle
+
+        path = write_local_bundle(str(tmp_path), reason="smoke_failure",
+                                  profile_seconds=0.1)
+        doc = json.loads(open(path).read())
+        assert doc["reason"] == "smoke_failure"
+        assert doc["profile"]["samples"] > 0
+
+
+class TestExplainAnalyzeProfile:
+    def test_per_phase_top_frames(self, tmp_path):
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.context import ExecutionContext
+
+        path = tmp_path / "t.csv"
+        rng = np.random.default_rng(7)
+        with open(path, "w") as f:
+            f.write("k,v\n")
+            for i in range(30000):
+                f.write(f"k{i % 13},{rng.integers(0, 1000)}\n")
+        ctx = ExecutionContext(device="cpu")
+        schema = Schema([Field("k", DataType.UTF8, False),
+                         Field("v", DataType.INT64, False)])
+        ctx.register_csv("t", str(path), schema, has_header=True)
+        res = ctx.sql_collect(
+            "EXPLAIN ANALYZE SELECT k, SUM(v) FROM t GROUP BY k"
+        )
+        assert res.host_profile is not None
+        assert res.host_profile.samples > 0
+        by_phase = res.host_profile.by_phase(3)
+        assert by_phase, "no phases sampled"
+        for _phase, d in by_phase.items():
+            assert 1 <= len(d["top_frames"]) <= 3
+            for label, count in d["top_frames"]:
+                assert isinstance(label, str) and count >= 1
+        assert "Host profile" in res.report()
+        # sampler tore down with the scope
+        assert not profiler.PROFILER.running()
+
+    def test_opt_out_env(self, tmp_path, monkeypatch):
+        import numpy as np
+
+        from datafusion_tpu.datatypes import DataType, Field, Schema
+        from datafusion_tpu.exec.context import ExecutionContext
+
+        monkeypatch.setenv("DATAFUSION_TPU_PROFILE_EXPLAIN", "0")
+        path = tmp_path / "t.csv"
+        with open(path, "w") as f:
+            f.write("v\n1\n2\n3\n")
+        ctx = ExecutionContext(device="cpu")
+        schema = Schema([Field("v", DataType.INT64, False)])
+        ctx.register_csv("t", str(path), schema, has_header=True)
+        res = ctx.sql_collect("EXPLAIN ANALYZE SELECT v FROM t")
+        assert res.host_profile is None
+        assert "Host profile" not in res.report()
