@@ -174,10 +174,17 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
                   "DATAFUSION_TPU_DEBUG_PORT)", file=out)
             failures += 1
             continue
+        # the debug plane may be token-guarded (obs/httpd.py hardening):
+        # forward the operator's bearer token on every pull
+        headers = {}
+        token = os.environ.get("DATAFUSION_TPU_DEBUG_TOKEN")
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         try:
-            with urllib.request.urlopen(
-                f"{url}?seconds={seconds:g}", timeout=seconds + 15
-            ) as resp:
+            req = urllib.request.Request(
+                f"{url}?seconds={seconds:g}", headers=headers
+            )
+            with urllib.request.urlopen(req, timeout=seconds + 15) as resp:
                 doc = json.loads(resp.read())
         except (OSError, ValueError) as e:
             print(f"{member}: bundle pull failed: {e}", file=out)
@@ -307,6 +314,15 @@ class Console:
                 + (f", standby of {status['standby_of']}"
                    if status.get("standby_of") else "")
             )
+            if status.get("replica_set_size", 1) > 1 \
+                    or status.get("write_quorum", 1) > 1:
+                self._print(
+                    f"Replica set: {status.get('replica_set_size', 1)} "
+                    f"node(s), write quorum "
+                    f"{status.get('write_quorum', 1)}, succession rank "
+                    f"{status.get('rank', 0)}, "
+                    f"{status.get('parked_watchers', 0)} parked watch(es)"
+                )
         for addr, info in sorted(status["workers"].items()):
             self._print(
                 f"  worker {addr}: lease age {info.get('lease_age_s')}s"

@@ -55,9 +55,19 @@ sockets; existing single-coordinator paths are byte-identical):
                                       coordinators AND workers
     DATAFUSION_TPU_CLUSTER_TTL_S      worker lease TTL (default 10)
     DATAFUSION_TPU_CLUSTER_ELECTION_S standby promotes after this much
-                                      primary silence (default TTL/2)
+                                      primary silence (default TTL/2;
+                                      rank-staggered in replica sets)
+    DATAFUSION_TPU_CLUSTER_QUORUM     write quorum W: a mutation is
+                                      acknowledged only once W replicas
+                                      (primary included) hold it
+                                      (default 1 = async replication;
+                                      a 3-replica set wants 2)
     DATAFUSION_TPU_CLUSTER_CACHE_BYTES  shared result tier byte budget
                                       (default 256 MiB)
+    DATAFUSION_TPU_SERVER_THREADS     event-loop executor width per
+                                      server (bounded compute pool; the
+                                      selector parks any number of
+                                      connections/watches threadless)
 
 Fault sites (`testing/faults.py`): ``cluster.request`` (service
 partition), ``cluster.lease.refresh`` (lease expiry), ``cluster.watch``
@@ -95,6 +105,18 @@ def cluster_address() -> Optional[str]:
 def lease_ttl_s() -> float:
     env = os.environ.get("DATAFUSION_TPU_CLUSTER_TTL_S", "")
     return float(env) if env else DEFAULT_LEASE_TTL_S
+
+
+def write_quorum() -> int:
+    """Replicas (primary included) that must hold a mutation before it
+    is acknowledged.  1 (the default) keeps the PR-5 async-replication
+    behavior: acks never wait on a replica, and the loss window is
+    whatever `cluster.replication_lag_revisions` measures.  W > 1
+    closes that window: a SIGKILL'd primary cannot lose a write any
+    client saw acknowledged, because W-1 other replicas already held
+    it — and the election reaches at least one of them."""
+    env = os.environ.get("DATAFUSION_TPU_CLUSTER_QUORUM", "")
+    return max(1, int(env)) if env else 1
 
 
 def election_timeout_s() -> float:

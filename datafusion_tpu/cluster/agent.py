@@ -18,10 +18,18 @@ because it may have missed invalidation events while deregistered
 
 HA: the client underneath handles primary failover (multi-endpoint
 sweep + redirect-on-``not_primary``), and a promoted standby re-arms
-every replicated lease with a fresh TTL on takeover — so a primary
-SIGKILL costs at most one errored heartbeat cycle, never the lease.
-The agent tracks the leadership ``term`` it last observed
+every replicated lease with its SHIPPED remaining deadline on takeover
+— so a primary SIGKILL costs at most one errored heartbeat cycle,
+never a live lease, and never masks an already-dead worker behind a
+fresh TTL.  The agent tracks the leadership ``term`` it last observed
 (`cluster.term` gauge): a bump is the visible trace of a failover.
+
+Storm control: consecutive heartbeat failures back the loop off with
+capped full jitter (never past one TTL), and a re-registration from
+the background loop staggers a bounded random delay first — a mass
+lease lapse across a failover reaches the new primary as a spread-out
+trickle, not one synchronized re-register burst
+(``DATAFUSION_TPU_CLUSTER_REREG_JITTER_S`` caps the stagger).
 """
 
 from __future__ import annotations
@@ -61,6 +69,20 @@ class WorkerClusterAgent:
         self.events_applied = 0
         self.reregistrations = 0
         self._lease_refreshed: Optional[float] = None
+        # consecutive heartbeat failures: drives the capped full-jitter
+        # backoff below so a fleet whose leases lapsed together (mass
+        # expiry across a failover) re-registers SPREAD over a window
+        # instead of stampeding the new primary in one synchronized
+        # burst.  Capped at one TTL: a worker never sits out longer
+        # than the liveness signal it is trying to maintain.
+        self._failures = 0
+        self._backoff_cap_s = max(self.ttl_s, self.refresh_s)
+        env = os.environ.get("DATAFUSION_TPU_CLUSTER_REREG_JITTER_S", "")
+        # re-register stagger ceiling (loop path only; poll_once stays
+        # deterministic for tests): uniform [0, min(this, refresh))
+        self.reregister_jitter_s = (
+            float(env) if env else min(1.0, self.refresh_s)
+        )
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -95,10 +117,14 @@ class WorkerClusterAgent:
         self._lease_refreshed = time.monotonic()
         METRICS.add("worker.cluster_registered")
 
-    def poll_once(self) -> None:
+    def poll_once(self, stagger: bool = False) -> None:
         """One heartbeat: refresh the lease, apply any broadcast events
         that arrived since the last one.  Raises on a partitioned
-        service (the loop counts and retries next cycle)."""
+        service (the loop counts and retries next cycle).  `stagger`
+        (the background loop's setting) sleeps a bounded random delay
+        before any RE-registration so a mass lease lapse doesn't
+        produce a synchronized re-register storm; direct test drivers
+        keep the default deterministic path."""
         faults.check("cluster.lease.refresh", addr=self.addr)
         if self.lease is None:
             self.register()
@@ -113,6 +139,10 @@ class WorkerClusterAgent:
             cache = self.worker_state.fragment_cache
             if cache is not None:
                 cache.clear()
+            if stagger and self.reregister_jitter_s > 0:
+                # every worker in the fleet noticed the lapse within
+                # one refresh interval of each other; spread the herd
+                self._stop.wait(self._register_stagger_s())
             self.register()
             resp = self.client.lease_refresh(self.lease, since=self.last_rev,
                                              telemetry=self._telemetry())
@@ -159,14 +189,39 @@ class WorkerClusterAgent:
         if dropped:
             METRICS.add("worker.cluster_invalidations_applied", dropped)
 
+    def _register_stagger_s(self) -> float:
+        """Uniform random re-register stagger in
+        [0, min(reregister_jitter_s, refresh_s))."""
+        import random
+
+        cap = min(self.reregister_jitter_s, self.refresh_s)
+        return random.uniform(0.0, max(0.0, cap))
+
+    def _retry_delay_s(self) -> float:
+        """The wait before the next heartbeat cycle: the plain refresh
+        interval when healthy; after consecutive failures, capped
+        full-jitter backoff (never past one TTL, never a sub-50ms hot
+        loop) — the re-register storm killer for service outages."""
+        from datafusion_tpu.utils.retry import backoff_s
+
+        if not self._failures:
+            return self.refresh_s
+        delay = backoff_s(min(self._failures, 6),
+                          base=self.refresh_s / 2.0,
+                          cap=self._backoff_cap_s)
+        return min(max(0.05, delay), self._backoff_cap_s)
+
     # -- lifecycle --
     def _loop(self) -> None:
-        while not self._stop.wait(self.refresh_s):
+        while not self._stop.wait(self._retry_delay_s()):
             try:
-                self.poll_once()
+                self.poll_once(stagger=True)
+                self._failures = 0
             except (ConnectionError, OSError, ExecutionError):
+                self._failures += 1
                 METRICS.add("worker.cluster_refresh_errors")
             except Exception:  # noqa: BLE001 — the heartbeat must outlive surprises
+                self._failures += 1
                 METRICS.add("worker.cluster_refresh_errors")
 
     def start(self) -> "WorkerClusterAgent":

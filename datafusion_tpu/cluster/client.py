@@ -33,11 +33,13 @@ one-raise-one-failure determinism).  Per-endpoint chaos uses
 from __future__ import annotations
 
 import socket
+import threading
 import time
 from typing import Any, Optional
 
 from datafusion_tpu.errors import (
     ClusterNotPrimaryError,
+    ClusterQuorumError,
     ExecutionError,
     StaleTermError,
 )
@@ -53,13 +55,19 @@ _FAILOVER_SWEEPS = 3
 
 def _raise_error_reply(out: dict) -> dict:
     """Map an error reply onto the typed taxonomy (`not_primary` ->
-    transient redirect, `stale_term` -> permanent fence)."""
+    transient redirect, `quorum_unavailable` -> transient retry-in-
+    place, `stale_term` -> permanent fence)."""
     if out.get("type") == "error":
         code = out.get("code")
         if code == "not_primary":
             raise ClusterNotPrimaryError(
                 f"cluster service: {out.get('message')}",
                 primary=out.get("primary"),
+            )
+        if code == "quorum_unavailable":
+            raise ClusterQuorumError(
+                f"cluster service: {out.get('message')}",
+                acks=out.get("acks", 0), quorum=out.get("quorum", 0),
             )
         if code == "stale_term":
             raise StaleTermError(f"cluster service: {out.get('message')}")
@@ -101,6 +109,20 @@ class _ClientApi:
             faults.check("cluster.request", op=msg.get("type"), endpoint=idx)
             try:
                 return self._request_endpoint(idx, msg, timeout, bw, sent_box)
+            except ClusterQuorumError as e:
+                # the PRIMARY answered but could not gather its write
+                # quorum: rotating endpoints would only bounce off
+                # standbys' redirects — retry in place after a backoff
+                # and give the replica set (or the election) a moment
+                last = e
+                METRICS.add("cluster.client_quorum_retries")
+                attempts += 1
+                if attempts >= max_attempts:
+                    raise last
+                time.sleep(backoff_s(
+                    max(1, attempts), base=0.05, cap=0.5
+                ))
+                continue
             except ClusterNotPrimaryError as e:
                 last = e
                 hinted = self._endpoint_index_for(e.primary)
@@ -193,18 +215,49 @@ class _ClientApi:
         return self.request({"type": "result_get", "key": key})
 
     def result_publish(self, key: str, entry, nbytes: int,
-                       tables: tuple = ()) -> int:
+                       tables: tuple = (), digests=None) -> int:
         """Publish a `CachedResult` snapshot; returns the bytes that
         actually crossed the transport (the in-process client moves
-        references, not bytes)."""
+        references, not bytes).  `digests` (per-column, from
+        `shared_cache.column_digests`) ride the stored value so later
+        delta republishes can reuse unchanged columns."""
         from datafusion_tpu.cluster.shared_cache import result_raw
 
+        value = {"snapshot": result_raw(entry), "tables": list(tables)}
+        if digests is not None:
+            value["digests"] = list(digests)
         self.request({
-            "type": "result_put", "key": key,
-            "value": {"snapshot": result_raw(entry), "tables": list(tables)},
+            "type": "result_put", "key": key, "value": value,
             "nbytes": nbytes, "tables": list(tables),
         })
         return 0  # in-process: nothing serialized
+
+    def result_publish_delta(self, key: str, entry, nbytes: int,
+                             tables: tuple, digests: list,
+                             prev_digests: list) -> Optional[int]:
+        """Delta republish: ship only the columns whose digest moved
+        since `prev_digests` (this publisher's last publication of
+        `key`).  Returns the bytes sent, or None when the service
+        demanded a full snapshot (no previous entry, or its digests
+        disagree) — the caller falls back to `result_publish`."""
+        from datafusion_tpu.cluster.shared_cache import result_raw
+
+        raw = result_raw(entry)
+        changed = [
+            i for i, d in enumerate(digests)
+            if i >= len(prev_digests) or prev_digests[i] != d
+        ]
+        out = self.request({
+            "type": "result_put_delta", "key": key, "nbytes": nbytes,
+            "tables": list(tables), "digests": list(digests),
+            "segments": {str(i): raw["columns"][i] for i in changed},
+            "validity": raw["validity"],
+            "dict_values": raw["dict_values"],
+            "num_rows": raw["num_rows"],
+        })
+        if not out.get("stored"):
+            return None
+        return 0  # in-process: references moved, nothing serialized
 
     def result_fetch(self, key: str):
         """Fetch a published snapshot: (CachedResult, tables) or None."""
@@ -223,6 +276,10 @@ class _ClientApi:
 
     def status(self) -> dict:
         return self.request({"type": "status"})
+
+    def close(self) -> None:
+        """Release persistent transport resources (watch channels);
+        the in-process client holds none."""
 
 
 class LocalClusterClient(_ClientApi):
@@ -301,9 +358,80 @@ class ClusterClient(_ClientApi):
         self.endpoints = endpoints
         self.request_timeout = request_timeout
         self._active = 0
+        # persistent watch channel: long-poll watches re-arm on ONE
+        # kept-alive socket (the selector service parks it threadless),
+        # so a watcher costs the fleet a connect per failover, not a
+        # connect per poll interval
+        self._watch_sock: Optional[socket.socket] = None
+        self._watch_lock = threading.Lock()
+        self._watch_closed = False
 
     def __repr__(self):
         return f"ClusterClient({self.address})"
+
+    def close(self) -> None:
+        """Deliberately does NOT take the watch lock: a watcher thread
+        may be parked in a long poll (or mid-failover-sweep) holding
+        it, and close must not wait that out.  Closing the socket out
+        from under the parked recv surfaces as OSError in the watcher,
+        which drops the channel; the closed flag stops it re-pinning."""
+        self._watch_closed = True
+        self._drop_watch_sock()
+
+    def _drop_watch_sock(self) -> None:
+        sock, self._watch_sock = self._watch_sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _watch_channel_request(self, msg: dict, timeout_s: float) -> dict:
+        # watch lock held; raises on any transport/reply problem — the
+        # caller drops the channel and falls back to the failover sweep
+        from datafusion_tpu.parallel.wire import (
+            CRC_ENABLED,
+            WIRE_VERSION,
+            recv_msg,
+            send_msg,
+        )
+
+        if CRC_ENABLED and "wire_version" not in msg:
+            msg = {**msg, "wire_version": WIRE_VERSION}
+        s = self._watch_sock
+        # widened past the park interval: the park itself must never
+        # read as a dead service
+        s.settimeout(timeout_s + 10.0)
+        send_msg(s, msg, crc=CRC_ENABLED)
+        out = recv_msg(s)
+        if out is None:
+            raise ConnectionError("cluster service closed the watch channel")
+        return _raise_error_reply(out)
+
+    def watch(self, since: int, timeout_s: float = 10.0) -> dict:
+        msg = {"type": "watch", "since": since, "timeout_s": timeout_s}
+        with self._watch_lock:
+            if self._watch_sock is not None:
+                try:
+                    return self._watch_channel_request(dict(msg), timeout_s)
+                except (ConnectionError, OSError, ExecutionError):
+                    # channel died (failover, idle reset): sweep below
+                    self._drop_watch_sock()
+                    METRICS.add("cluster.watch_channel_drops")
+            # failover sweep (follows not_primary redirects), then pin
+            # a fresh channel on whatever endpoint the sweep settled on
+            out = self.request(msg, timeout=timeout_s + 10.0)
+            if self._watch_closed:
+                return out  # closed mid-sweep: don't re-pin a channel
+            try:
+                self._watch_sock = socket.create_connection(
+                    self.endpoints[self._active % len(self.endpoints)],
+                    timeout=5.0,
+                )
+                METRICS.add("cluster.watch_channel_connects")
+            except OSError:
+                self._watch_sock = None
+            return out
 
     @property
     def host(self) -> str:
@@ -360,7 +488,7 @@ class ClusterClient(_ClientApi):
         return _raise_error_reply(out)
 
     def result_publish(self, key: str, entry, nbytes: int,
-                       tables: tuple = ()) -> int:
+                       tables: tuple = (), digests=None) -> int:
         """Publish with the snapshot columns as RAW binary wire
         segments (CRC'd like any fragment payload) instead of inline
         base64 JSON — for large results this is the difference between
@@ -370,10 +498,48 @@ class ClusterClient(_ClientApi):
 
         bw = BinWriter()
         wire_snap = raw_to_wire(result_raw(entry), bw)
+        value = {"snapshot": wire_snap, "tables": list(tables)}
+        if digests is not None:
+            value["digests"] = list(digests)
         sent_box = [0]
         self.request({
-            "type": "result_put", "key": key,
-            "value": {"snapshot": wire_snap, "tables": list(tables)},
+            "type": "result_put", "key": key, "value": value,
             "nbytes": nbytes, "tables": list(tables),
         }, bw=bw, sent_box=sent_box)
+        return sent_box[0]
+
+    def result_publish_delta(self, key: str, entry, nbytes: int,
+                             tables: tuple, digests: list,
+                             prev_digests: list) -> Optional[int]:
+        """Delta republish over TCP: only the changed columns ship as
+        RAW binary segments; unchanged ones ship as 16-char digests.
+        On a warm republish this cuts `coord.shared_cache_publish_bytes`
+        from the full snapshot to roughly the changed fraction."""
+        from datafusion_tpu.cluster.shared_cache import _as_array, result_raw
+        from datafusion_tpu.parallel.wire import BinWriter, enc_array
+
+        raw = result_raw(entry)
+        changed = [
+            i for i, d in enumerate(digests)
+            if i >= len(prev_digests) or prev_digests[i] != d
+        ]
+        bw = BinWriter()
+        segments = {
+            str(i): enc_array(_as_array(raw["columns"][i]), bw)
+            for i in changed
+        }
+        validity = [
+            None if v is None else enc_array(_as_array(v), bw)
+            for v in raw["validity"]
+        ]
+        sent_box = [0]
+        out = self.request({
+            "type": "result_put_delta", "key": key, "nbytes": nbytes,
+            "tables": list(tables), "digests": list(digests),
+            "segments": segments, "validity": validity,
+            "dict_values": raw["dict_values"],
+            "num_rows": raw["num_rows"],
+        }, bw=bw, sent_box=sent_box)
+        if not out.get("stored"):
+            return None
         return sent_box[0]

@@ -56,6 +56,7 @@ class MembershipView:
         self._lock = lockcheck.make_lock("cluster.membership_view")
         self._last_refresh: Optional[float] = None
         self.refresh_errors = 0
+        self.rev_regressions = 0
         self._callbacks: list[Callable[["MembershipView"], None]] = []
 
     def subscribe(self, fn: Callable[["MembershipView"], None]) -> None:
@@ -71,8 +72,18 @@ class MembershipView:
             changed = out["epoch"] != self.epoch
             if changed:
                 METRICS.add("coord.membership_epoch_changes")
+            new_rev = out.get("rev", self.rev)
+            if new_rev < self.rev and out.get("term", self.term) >= self.term:
+                # the service's revision went BACKWARDS under a same-or-
+                # newer term: a failover landed on a replica missing
+                # events this view already consumed.  With quorum-acked
+                # writes this gauge stays zero — it is the coordinator-
+                # side proof the async loss window is closed (the
+                # worker-agent twin is worker.cluster_rev_regressions)
+                self.rev_regressions += 1
+                METRICS.add("coord.membership_rev_regressions")
             self.epoch = out["epoch"]
-            self.rev = out.get("rev", self.rev)
+            self.rev = new_rev
             self.term = out.get("term", self.term)
             self.workers = out.get("workers", {})
             self._last_refresh = time.monotonic()
@@ -147,6 +158,7 @@ class MembershipView:
                 "cluster.workers_live": len(self.workers),
                 "cluster.watch_lag_s": round(lag, 3) if lag is not None else -1,
                 "cluster.watch_errors": self.refresh_errors,
+                "cluster.rev_regressions": self.rev_regressions,
             }
 
     def __repr__(self):

@@ -50,7 +50,6 @@ from __future__ import annotations
 
 import math
 import os
-import socketserver
 import threading
 import time
 import uuid
@@ -59,6 +58,7 @@ from typing import Any, Optional
 from datafusion_tpu.analysis import lockcheck
 from datafusion_tpu.cache.store import CacheStore
 from datafusion_tpu.obs import recorder
+from datafusion_tpu.utils.eventloop import LoopServer
 from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.metrics import METRICS
 
@@ -102,6 +102,13 @@ class ClusterState:
 
             result_cache_bytes = int(env) if env else DEFAULT_CACHE_BYTES
         self._lock = lockcheck.make_lock("cluster.state")
+        # serializes REPLICATION applies (apply_event/apply_snapshot)
+        # end to end, result-tier side effects included: a quorum push
+        # and the pull loop may race the same tail, and the rev guard
+        # alone cannot order the side effects (a stalled result_put
+        # replaying after a later invalidate would resurrect the
+        # invalidated entry).  Client-facing reads/writes never take it.
+        self._apply_lock = lockcheck.make_lock("cluster.apply")
         # watchers park here; notified on every appended event (the
         # Condition runs through the tracked lock's acquire/release, so
         # lockcheck's held-stack stays coherent across parked waits)
@@ -116,6 +123,23 @@ class ClusterState:
         # revision of the newest client-visible event — watchers'
         # wakeup predicate is one comparison, not a log scan
         self._last_client_rev = 0
+        # event-loop watch waiters: token -> (since, notify).  A parked
+        # long-poll costs one dict entry here (plus its fd in the
+        # selector) instead of a thread; `notify` fires under the state
+        # lock, so it must be cheap and non-blocking (the event
+        # server's is one call_soon)
+        self._async_waiters: dict[int, tuple[int, Any]] = {}
+        self._waiter_seq = iter(range(1, 1 << 62)).__next__
+        # lease deadlines shipped by the upstream primary (standby
+        # side): lease_id -> remaining seconds under the PRIMARY's
+        # clock at ship time.  `promote()` re-arms each lease with
+        # min(shipped remaining, ttl) — never a fresh full TTL, so a
+        # worker that was already half-dead before the failover stays
+        # half-dead instead of being masked for another whole TTL.
+        # The outage window between the last ship and the promotion is
+        # deliberately NOT subtracted: holders could not have refreshed
+        # through a dead primary, so the lease clock pauses with it.
+        self._shipped_deadlines: dict[str, float] = {}
         self.started = time.time()
         # latest telemetry snapshot per worker (obs/aggregate.py node
         # snapshots piggybacked on lease refreshes).  Deliberately
@@ -165,7 +189,20 @@ class ClusterState:
             # nothing (standbys pull — they never park here)
             self._last_client_rev = rev
             self._watch_cond.notify_all()
+            self._fire_async_waiters(rev)
         return rev
+
+    def _fire_async_waiters(self, rev: int) -> None:
+        # lock held; notify callbacks are cheap by contract (call_soon)
+        if not self._async_waiters:
+            return
+        fired = [t for t, (s, _fn) in self._async_waiters.items() if rev > s]
+        for token in fired:
+            _, fn = self._async_waiters.pop(token)
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a dead watcher must not block the append
+                METRICS.add("cluster.watch_notify_errors")
 
     def _is_member_key(self, key: str) -> bool:
         return key.startswith("workers/")
@@ -405,6 +442,53 @@ class ClusterState:
             out["fired"] = bool(fired or out["events"])
             return out
 
+    # -- event-loop watches (no parked thread) --
+    def _watch_answer(self, since: int, now: float) -> dict:
+        # lock held: the same tail+membership payload `watch` builds
+        out = self._events_since(since, CLIENT_EVENT_KINDS)
+        out.update(self._membership(now))
+        out["fired"] = bool(out["events"])
+        return out
+
+    def watch_async(self, since: int, notify,
+                    now: Optional[float] = None):
+        """The selector server's watch half: answer immediately when a
+        client-visible event past `since` (or a truncation) is already
+        pending — returns ``(response, None)`` — else park by
+        registering `notify` and return ``(None, token)``.  `notify`
+        fires at most once, under the state lock, when such an event
+        lands; the CALLER owns the timeout (fire `watch_answer` on
+        expiry and `cancel_watch(token)`).  This is what lets thousands
+        of parked long-polls cost a file descriptor each instead of a
+        thread each."""
+        now = time.monotonic() if now is None else now
+        since = int(since)
+        with self._lock:
+            self._expire(now)
+            if (since and since + 1 < self._events_floor) \
+                    or self._last_client_rev > since:
+                return self._watch_answer(since, now), None
+            token = self._waiter_seq()
+            self._async_waiters[token] = (since, notify)
+            return None, token
+
+    def watch_answer(self, since: int, now: Optional[float] = None) -> dict:
+        """The parked watch's answer (event fired or timeout lapsed)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            return self._watch_answer(int(since), now)
+
+    def cancel_watch(self, token) -> None:
+        if token is None:
+            return
+        with self._lock:
+            self._async_waiters.pop(token, None)
+
+    def parked_watchers(self) -> int:
+        with self._lock:
+            return len(self._async_waiters)
+
     def invalidate(self, table: str, now: Optional[float] = None) -> dict:
         """Coordinator-driven cache invalidation: drop shared-tier
         results that scanned `table` and broadcast a
@@ -434,17 +518,66 @@ class ClusterState:
     def result_get(self, fingerprint: str) -> Optional[dict]:
         return self.results.get(f"cache/result/{fingerprint}")
 
+    def result_put_delta(self, fingerprint: str, digests: list,
+                         segments: dict, meta: dict, nbytes: int,
+                         tables: tuple = ()) -> dict:
+        """Delta republish: the publisher ships per-column digests plus
+        ONLY the changed columns' bytes (`segments`: index -> array);
+        unchanged columns are reused from the stored entry when its
+        digest matches.  Any miss (no previous entry, digest mismatch
+        on an unshipped column, shape drift) answers ``need_full`` and
+        the publisher falls back to a full snapshot — correctness never
+        rides the delta path.  The assembled entry stores and
+        replicates exactly like a full ``result_put``."""
+        prev = self.results.peek(f"cache/result/{fingerprint}")
+        prev_snap = prev.get("snapshot") if isinstance(prev, dict) else None
+        prev_digs = prev.get("digests") if isinstance(prev, dict) else None
+        digests = [str(d) for d in digests]
+        columns = []
+        for i, dig in enumerate(digests):
+            seg = segments.get(i, segments.get(str(i)))
+            if seg is not None:
+                columns.append(seg)
+            elif (isinstance(prev_snap, dict) and isinstance(prev_digs, list)
+                    and i < len(prev_digs) and prev_digs[i] == dig
+                    and i < len(prev_snap.get("columns", []))):
+                columns.append(prev_snap["columns"][i])
+            else:
+                METRICS.add("cluster.result_delta_misses")
+                return {"stored": False, "need_full": True}
+        snapshot = {**meta, "columns": columns}
+        value = {"snapshot": snapshot, "tables": list(tables),
+                 "digests": digests}
+        METRICS.add("cluster.result_delta_puts")
+        return {"stored": self.result_put(fingerprint, value, nbytes,
+                                          tables)}
+
     # -- replication (log shipping + snapshots) --
     def apply_event(self, ev: dict, value: Any = None,
-                    now: Optional[float] = None) -> None:
+                    now: Optional[float] = None) -> bool:
         """Apply one replicated event verbatim: state transitions mirror
         the primary's, the event lands in OUR log under ITS revision
         (so post-promotion consumers resume seamlessly), and leases get
         an infinite local expiry — the primary decides lease life; a
         standby never expires one on its own clock (`promote()` re-arms
         them all when this replica takes over).  `value` carries the
-        out-of-band payload for ``result_put`` events."""
+        out-of-band payload for ``result_put`` events.
+
+        Idempotent by revision AND serialized (`_apply_lock`): a
+        synchronous quorum push and the pull loop may race the same
+        tail, and a replay must never double-apply, duplicate the log,
+        or re-order the result-tier side effects around a later
+        invalidation."""
+        with self._apply_lock:
+            return self._apply_event_locked(ev, value, now)
+
+    def _apply_event_locked(self, ev: dict, value: Any,
+                            now: Optional[float]) -> bool:
+        # _apply_lock held
         now = time.monotonic() if now is None else now
+        with self._lock:
+            if int(ev["rev"]) <= self._rev:
+                return False
         kind = ev.get("kind")
         if kind == "invalidate":
             self.results.invalidate_tag(str(ev.get("table", "")))
@@ -454,6 +587,8 @@ class ClusterState:
                 tags=tuple(ev.get("tables") or ()),
             )
         with self._lock:
+            if int(ev["rev"]) <= self._rev:
+                return False  # a racing push/pull applied it first
             if kind == "lease_grant":
                 lease = _Lease(ev["lease"], float(ev.get("ttl_s", 10.0)), now)
                 lease.expires = math.inf
@@ -504,6 +639,8 @@ class ClusterState:
                     self._last_client_rev, int(ev["rev"])
                 )
                 self._watch_cond.notify_all()
+                self._fire_async_waiters(self._last_client_rev)
+        return True
 
     def snapshot_state(self) -> dict:
         """Full-state snapshot for standby catch-up past the retained
@@ -535,7 +672,15 @@ class ClusterState:
     def apply_snapshot(self, snap: dict, now: Optional[float] = None) -> None:
         """Replace this replica's entire state with a primary snapshot
         (leases arrive with infinite local expiry, exactly like
-        event-applied ones)."""
+        event-applied ones).  Serialized with `apply_event` so an
+        in-flight tail apply cannot interleave its side effects with
+        the wholesale replacement."""
+        with self._apply_lock:
+            self._apply_snapshot_locked(snap, now)
+
+    def _apply_snapshot_locked(self, snap: dict,
+                               now: Optional[float]) -> None:
+        # _apply_lock held
         now = time.monotonic() if now is None else now
         with self._lock:
             self._kv.clear()
@@ -568,21 +713,64 @@ class ClusterState:
                 tags=tuple(spec.get("tables") or ()),
             )
 
+    def lease_deadlines(self, now: Optional[float] = None) -> dict:
+        """Primary side of deadline shipping: remaining seconds per
+        live lease under THIS clock.  Rides every replication pull
+        response and quorum push so a promoting standby re-arms each
+        lease with its true remaining budget instead of a fresh TTL.
+        Leases at infinite local expiry (a standby's replicas of
+        upstream leases) are omitted — this node knows nothing about
+        their real deadlines."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            return {
+                l.lease_id: round(max(0.0, l.expires - now), 3)
+                for l in self._leases.values()
+                if l.expires != math.inf
+            }
+
+    def note_lease_deadlines(self, deadlines) -> None:
+        """Standby side: remember the primary's latest shipped
+        remaining deadlines (consulted once, at promotion)."""
+        if not isinstance(deadlines, dict):
+            return
+        clean = {}
+        for k, v in deadlines.items():
+            try:
+                clean[str(k)] = max(0.0, float(v))
+            except (TypeError, ValueError):
+                continue
+        with self._lock:
+            self._shipped_deadlines = clean
+
     def promote(self, new_term: int, now: Optional[float] = None) -> None:
         """This replica takes over as primary: adopt the new term,
-        re-arm every replicated lease with a fresh full TTL (holders
-        refresh within TTL/3, so nothing live is lost; genuinely dead
-        holders expire one TTL after the takeover), and log the term
-        change so it ships to any remaining standbys."""
+        re-arm every replicated lease with its SHIPPED remaining
+        deadline (capped at the TTL; the outage window is not charged
+        to holders — they could not have refreshed through a dead
+        primary), and log the term change so it ships to any remaining
+        standbys.  A lease whose deadline was never shipped (legacy
+        upstream) falls back to the full-TTL re-arm; a lease whose
+        shipped remaining already reached zero expires on the next
+        sweep instead of being silently revived — a worker that was
+        already dead before the failover must not be masked for
+        another whole TTL."""
         now = time.monotonic() if now is None else now
         with self._lock:
             self.term = max(self.term + 1, int(new_term))
+            shipped = self._shipped_deadlines
             for lease in self._leases.values():
-                lease.expires = now + lease.ttl_s
+                remaining = shipped.get(lease.lease_id)
+                if remaining is None:
+                    remaining = lease.ttl_s
+                lease.expires = now + min(max(0.0, float(remaining)),
+                                          lease.ttl_s)
                 for key in lease.keys:
                     entry = self._kv.get(key)
                     if entry is not None:
                         entry.refreshed = now
+            self._shipped_deadlines = {}
             self._append_event("promoted", term=self.term)
 
     # -- introspection --
@@ -597,6 +785,7 @@ class ClusterState:
                     1 for k in self._kv if self._is_member_key(k)
                 ),
                 "cluster.telemetry_nodes": len(self._telemetry),
+                "cluster.watch_parked": len(self._async_waiters),
             }
         out.update(self.results.gauges())
         return out
@@ -625,7 +814,7 @@ class ClusterState:
 
 _MUTATING_REQUESTS = frozenset((
     "lease_grant", "lease_refresh", "lease_revoke", "kv_put", "kv_delete",
-    "invalidate", "result_put",
+    "invalidate", "result_put", "result_put_delta",
 ))
 
 
@@ -693,6 +882,27 @@ def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
             int(msg["nbytes"]), tuple(msg.get("tables") or ()),
         )
         return {"type": "ok", "stored": stored}
+    if kind == "result_put_delta":
+        from datafusion_tpu.cluster.shared_cache import _as_array
+
+        segments = {
+            int(i): _as_array(seg)
+            for i, seg in (msg.get("segments") or {}).items()
+        }
+        meta = {
+            "validity": [
+                None if v is None else _as_array(v)
+                for v in (msg.get("validity") or [])
+            ],
+            "dict_values": msg.get("dict_values") or [],
+            "num_rows": int(msg.get("num_rows", 0)),
+            "nbytes": int(msg.get("nbytes", 0)),
+        }
+        out = state.result_put_delta(
+            msg["key"], msg.get("digests") or [], segments, meta,
+            int(msg["nbytes"]), tuple(msg.get("tables") or ()),
+        )
+        return {"type": "ok", **out}
     if kind == "result_get":
         value = state.result_get(msg["key"])
         out = {"type": "kv", "found": value is not None}
@@ -705,6 +915,49 @@ def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
     if kind == "status":
         return state.status()
     return {"type": "error", "message": f"unknown request {kind!r}"}
+
+
+class _ReplicaLink:
+    """The primary's push channel to one replica: last acked revision
+    plus a lock serializing pushes (concurrent mutations must not
+    interleave their tails on one link)."""
+
+    __slots__ = ("target", "acked_rev", "errors", "last_error_at",
+                 "lock", "_client")
+
+    def __init__(self, target):
+        self.target = target  # addr string or ClusterNode
+        self.acked_rev = 0
+        self.errors = 0
+        self.last_error_at: Optional[float] = None
+        self.lock = threading.Lock()
+        self._client = None
+
+    @property
+    def name(self) -> str:
+        return getattr(self.target, "addr", None) or str(self.target)
+
+    def cooling(self, now: float, cooldown_s: float) -> bool:
+        """Recently-failed links sit out quorum rounds for a cooldown
+        (they are only dialed when the healthy links cannot reach
+        quorum alone) so one dead replica costs each write at most one
+        fast skip, not a connect timeout — the pull loop re-syncs it
+        when it returns, and the first post-cooldown push re-probes."""
+        return (self.last_error_at is not None
+                and now - self.last_error_at < cooldown_s)
+
+    def client(self):
+        if self._client is None:
+            from datafusion_tpu import cluster as _cluster
+
+            self._client = _cluster.connect(self.target)
+        return self._client
+
+    def request_once(self, msg: dict, bw=None, timeout: float = 2.5) -> dict:
+        """ONE attempt against the replica — no failover sweep, no
+        backoff sleeps: a dead replica must cost the quorum commit one
+        fast failure, not a retry loop on the write path."""
+        return self.client()._request_endpoint(0, msg, timeout, bw)
 
 
 class ClusterNode:
@@ -726,6 +979,21 @@ class ClusterNode:
     exchange with a higher-term node, and any write carrying an
     explicitly stale term is rejected outright.
 
+    **Replica sets** (3+ nodes): configure every node with the full
+    `peers` list, a succession `rank` (0 = first in line; each rank
+    waits half an election timeout longer, so successors don't race),
+    and a `write_quorum` W.  With W > 1 the primary *synchronously
+    pushes* every mutation's log tail to its peers and acknowledges the
+    client only after W replicas (itself included) hold the events —
+    an acked write can no longer die with a SIGKILL'd primary.  A
+    candidate's election first polls its peers: it needs
+    ``N - W + 1`` reachable nodes (quorum intersection — some reachable
+    node holds every acked write), aborts on any higher term or live
+    primary, and catches up from the highest-revision responder BEFORE
+    promoting, so the promoted log contains every acknowledged
+    revision.  The pull loop stays on as catch-up for replicas that
+    miss pushes, with snapshot resync past the log window.
+
     Every method takes an injectable `now` so failover tests run
     without sleeping; `partitioned` simulates an unreachable node for
     in-process chaos (the local client raises the same
@@ -735,7 +1003,9 @@ class ClusterNode:
                  addr: Optional[str] = None,
                  standby_of=None, peers=(),
                  election_timeout_s: Optional[float] = None,
-                 replicate_interval_s: Optional[float] = None):
+                 replicate_interval_s: Optional[float] = None,
+                 replicas=(), write_quorum: Optional[int] = None,
+                 rank: int = 0):
         from datafusion_tpu import cluster as _cluster
 
         self.state = state or ClusterState()
@@ -749,14 +1019,25 @@ class ClusterNode:
         if replicate_interval_s is None:
             replicate_interval_s = max(0.05, self.election_timeout_s / 5.0)
         self.replicate_interval_s = float(replicate_interval_s)
+        # replica set: push targets (addr strings or ClusterNodes).
+        # Empty + write_quorum > 1 derives them from `peers` at push
+        # time, so a freshly promoted node starts pushing with zero
+        # reconfiguration.
+        self.replicas = [r for r in replicas if r is not None]
+        if write_quorum is None:
+            write_quorum = _cluster.write_quorum()
+        self.write_quorum = max(1, int(write_quorum))
+        self.rank = max(0, int(rank))
         self.partitioned = False
         self.promotions = 0
         self.step_downs = 0
+        self.elections_deferred = 0
         self.snapshots_applied = 0
         self.primary_rev = self.state._rev  # last rev observed upstream
         self.last_primary_contact = time.monotonic()
         self._force_snapshot = False
         self._upstream_client = None
+        self._links: dict = {}  # push-target identity -> _ReplicaLink
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -775,6 +1056,8 @@ class ClusterNode:
             return self._serve_peer_status(msg)
         if kind == "replicate_pull":
             return self._serve_pull(msg, bw)
+        if kind == "replicate_push":
+            return self._serve_push(msg)
         if kind == "ping":
             return {"type": "pong", "role": self.role, "term": self.term,
                     "epoch": self.state.membership()["epoch"]}
@@ -791,7 +1074,31 @@ class ClusterNode:
                 "message": f"write fenced: term {claimed} is stale "
                            f"(current term {self.term})",
             }
-        return apply_request(self.state, msg, bw)
+        rev_before = self.state._rev
+        out = apply_request(self.state, msg, bw)
+        if (self.write_quorum > 1 and kind in _MUTATING_REQUESTS
+                and out.get("type") != "error"
+                and self.state._rev > rev_before):
+            # the mutation appended events: it is acknowledged only
+            # once a write-quorum of replicas holds them.  Reads and
+            # no-op mutations (lease refreshes) skip the round trip.
+            acks = self._quorum_commit(self.state._rev)
+            if acks < self.write_quorum:
+                METRICS.add("cluster.quorum_write_failures")
+                return {
+                    "type": "error", "code": "quorum_unavailable",
+                    "term": self.term, "acks": acks,
+                    "quorum": self.write_quorum,
+                    "message": (
+                        f"write applied locally but reached only "
+                        f"{acks}/{self.write_quorum} replicas — not "
+                        f"acknowledged; retry when the replica set "
+                        f"recovers"
+                    ),
+                }
+            METRICS.add("cluster.quorum_writes_acked")
+            out = {**out, "quorum_acks": acks}
+        return out
 
     def _primary_hint(self) -> Optional[str]:
         up = self.standby_of
@@ -824,6 +1131,204 @@ class ClusterNode:
         if role == "primary" and source is not None \
                 and self._primary_hint() != source:
             self.retarget(source)
+
+    # -- replication (primary push path / quorum commit) --
+    def _replica_links(self) -> list:
+        """Push targets as persistent links.  Explicit `replicas` win;
+        otherwise (write_quorum > 1) they derive from `peers` minus
+        self — so a promoted standby starts pushing without any
+        reconfiguration."""
+        targets = self.replicas
+        if not targets and self.write_quorum > 1:
+            targets = [p for p in self.peers
+                       if p is not self and p != self.addr]
+        links = []
+        for t in targets:
+            if t is self or (isinstance(t, str) and t == self.addr):
+                continue
+            key = id(t) if not isinstance(t, str) else t
+            link = self._links.get(key)
+            if link is None:
+                link = self._links[key] = _ReplicaLink(t)
+            links.append(link)
+        return links
+
+    def cluster_size(self) -> int:
+        """Nodes in the replica set (self + distinct peers/replicas)."""
+        names = set()
+        for t in list(self.peers) + list(self.replicas):
+            if t is self:
+                continue
+            name = getattr(t, "addr", None) or (
+                t if isinstance(t, str) else None
+            )
+            if name is None:
+                name = f"node-{id(t)}"
+            if name != self.addr:
+                names.add(name)
+        return 1 + len(names)
+
+    @property
+    def election_quorum(self) -> int:
+        """Reachable nodes (self included) an election needs: with
+        write quorum W over N nodes, N - W + 1 responders guarantee the
+        candidate can reach SOME holder of every acked write."""
+        return max(1, self.cluster_size() - self.write_quorum + 1)
+
+    def _push_payload(self, since: int, bw=None,
+                      force_snapshot: bool = False) -> dict:
+        state = self.state
+        msg: dict = {
+            "type": "replicate_push", "term": self.term, "addr": self.addr,
+            "rev": state._rev,
+            # deadline shipping rides every push too: a standby that
+            # promotes between pulls still holds fresh remainders
+            "lease_deadlines": state.lease_deadlines(),
+        }
+        tail = state.events_since(since, kinds=None)
+        if force_snapshot or tail.get("truncated") or \
+                (since == 0 and state._rev > 0 and state._events_floor > 1):
+            faults.check("cluster.snapshot", addr=self.addr)
+            snap = state.snapshot_state()
+            if bw is not None:
+                for spec in snap["results"]:
+                    spec["value"] = _encode_result_value(spec["value"], bw)
+            METRICS.add("cluster.snapshots_served")
+            msg["snapshot"] = snap
+            return msg
+        values = {}
+        for ev in tail["events"]:
+            if ev.get("kind") != "result_put":
+                continue
+            value = state.results.peek(f"cache/result/{ev['key']}")
+            if value is None:
+                continue  # evicted since; the replica just misses it
+            values[ev["key"]] = _encode_result_value(value, bw) \
+                if bw is not None else value
+        msg["events"] = tail["events"]
+        msg["result_values"] = values
+        return msg
+
+    def _push_to(self, link: _ReplicaLink, needed_rev: int) -> bool:
+        """One synchronous push round against one replica; True when it
+        acked at least `needed_rev`.  Raises on an unreachable replica
+        (the quorum commit counts, never retries inline)."""
+        from datafusion_tpu.parallel.wire import BinWriter
+
+        with link.lock:
+            faults.check("cluster.replicate", addr=self.addr,
+                         peer=link.name, push=True)
+            tcp = isinstance(link.target, str)
+            bw = BinWriter() if tcp else None
+            resp = link.request_once(
+                self._push_payload(link.acked_rev, bw), bw
+            )
+            if resp.get("need_snapshot"):
+                # the replica's log has a gap this tail cannot fill
+                # (it lagged past the retained window): resync it with
+                # one full snapshot, inline
+                bw = BinWriter() if tcp else None
+                resp = link.request_once(
+                    self._push_payload(link.acked_rev, bw,
+                                       force_snapshot=True), bw,
+                )
+            link.acked_rev = int(resp.get("rev", link.acked_rev))
+            return link.acked_rev >= needed_rev
+
+    def _quorum_commit(self, needed_rev: int) -> int:
+        """Push the pending tail to the replicas; returns how many
+        (self included) hold revision `needed_rev`.  Healthy links go
+        first; links inside their failure cooldown are dialed only if
+        the healthy ones cannot reach quorum alone — a dead replica
+        must not tax every write with its connect timeout.  A replica
+        that rejects with a stale term triggers a peer probe — the
+        usual fencing path then deposes this node."""
+        from datafusion_tpu.errors import ExecutionError, StaleTermError
+
+        now = time.monotonic()
+        cooldown_s = max(0.5, self.replicate_interval_s)
+        links = self._replica_links()
+        ordered = [l for l in links if not l.cooling(now, cooldown_s)] + \
+                  [l for l in links if l.cooling(now, cooldown_s)]
+        acks = 1  # this node's own log
+        for link in ordered:
+            if acks >= self.write_quorum and link.cooling(now, cooldown_s):
+                continue  # quorum met: let the cooling replica pull-sync
+            try:
+                if self._push_to(link, needed_rev):
+                    acks += 1
+                link.last_error_at = None
+            except StaleTermError:
+                link.errors += 1
+                link.last_error_at = now
+                METRICS.add("cluster.replicate_push_errors")
+                # a replica fenced our term: discover the real primary
+                try:
+                    self.peer_probe_once()
+                except Exception:  # noqa: BLE001 — probe is best-effort here
+                    pass
+            except (ConnectionError, OSError, ExecutionError):
+                link.errors += 1
+                link.last_error_at = now
+                METRICS.add("cluster.replicate_push_errors")
+        return acks
+
+    def _serve_push(self, msg: dict) -> dict:
+        """Replica side of the synchronous push: apply the shipped tail
+        (idempotently — the pull loop may race), record primary
+        contact, ack with our revision."""
+        term = int(msg.get("term", 0))
+        if term < self.term:
+            METRICS.add("cluster.stale_term_writes_rejected")
+            return {
+                "type": "error", "code": "stale_term", "term": self.term,
+                "message": f"replication push fenced: term {term} is "
+                           f"stale (current term {self.term})",
+            }
+        self._observe_term(term, "primary", msg.get("addr"))
+        if self.role == "primary":
+            # an equal-term peer pushing at a primary: the probe sorts
+            # out who is who; we must not apply a foreign log meanwhile
+            return self._not_primary_reply("replication push")
+        state = self.state
+        now = time.monotonic()
+        applied = 0
+        snap = msg.get("snapshot")
+        if snap is not None:
+            faults.check("cluster.snapshot", addr=self.addr)
+            for spec in snap.get("results", []):
+                spec["value"] = _decode_result_value(spec.get("value"))
+            state.apply_snapshot(snap)
+            self.snapshots_applied += 1
+            self._force_snapshot = False
+            METRICS.add("cluster.snapshots_applied")
+            applied = -1
+        else:
+            events = msg.get("events") or []
+            if events and int(events[0]["rev"]) > state._rev + 1:
+                # a gap this push cannot fill: ask for a snapshot
+                # instead of silently applying a holed log
+                self._force_snapshot = True
+                return {"type": "replicate_ack", "rev": state._rev,
+                        "term": self.term, "need_snapshot": True}
+            values = msg.get("result_values") or {}
+            for ev in events:
+                if state.apply_event(
+                    ev,
+                    value=_decode_result_value(values.get(ev.get("key"))),
+                ):
+                    applied += 1
+            if applied:
+                METRICS.add("cluster.replicated_events", applied)
+        state.note_lease_deadlines(msg.get("lease_deadlines"))
+        self.last_primary_contact = now  # a push IS primary contact
+        self.primary_rev = max(self.primary_rev, int(msg.get("rev", 0)))
+        src = msg.get("addr")
+        if src and self._primary_hint() != src:
+            # the pusher is the (possibly new) primary: chase it
+            self.retarget(src)
+        return {"type": "replicate_ack", "rev": state._rev,
+                "term": self.term, "applied": applied}
 
     # -- replication (standby side) --
     def _upstream(self):
@@ -864,7 +1369,15 @@ class ClusterNode:
             raise
         now = time.monotonic() if now is None else now
         self.last_primary_contact = now
-        self.primary_rev = int(resp.get("rev", self.primary_rev))
+        return self._apply_pull_response(resp)
+
+    def _apply_pull_response(self, resp: dict,
+                             note_deadlines: bool = True) -> int:
+        """Fold one replication-pull response into this replica;
+        returns events applied (-1 for a full snapshot).  Shared by the
+        pull loop and the election catch-up pull."""
+        self.primary_rev = max(self.primary_rev,
+                               int(resp.get("rev", self.primary_rev)))
         if resp.get("term", 0) > self.term:
             self.state.term = int(resp["term"])
         snap = resp.get("snapshot")
@@ -876,28 +1389,143 @@ class ClusterNode:
             self.snapshots_applied += 1
             self._force_snapshot = False
             METRICS.add("cluster.snapshots_applied")
+            if note_deadlines:
+                self.state.note_lease_deadlines(
+                    resp.get("lease_deadlines")
+                )
             return -1
+        if int(resp.get("rev", 0)) < self.state._rev:
+            # our log runs PAST the upstream's: we hold orphaned
+            # revisions no primary acknowledges (writes we applied
+            # during a split, or an upstream that itself lost a race).
+            # One primary's history wins — resync via snapshot
+            self._force_snapshot = True
+            METRICS.add("cluster.replica_divergences")
+            return 0
         values = resp.get("result_values") or {}
-        events = resp.get("events") or []
-        for ev in events:
-            self.state.apply_event(
+        applied = 0
+        for ev in resp.get("events") or ():
+            if self.state.apply_event(
                 ev, value=_decode_result_value(values.get(ev.get("key"))),
+            ):
+                applied += 1
+        if applied:
+            METRICS.add("cluster.replicated_events", applied)
+        if note_deadlines:
+            self.state.note_lease_deadlines(resp.get("lease_deadlines"))
+        return applied
+
+    @property
+    def effective_election_timeout_s(self) -> float:
+        """Rank-staggered: each succession rank tolerates half an
+        election timeout more silence, so the ranked successor wins
+        uncontested and the others observe its new term instead of
+        racing it."""
+        return self.election_timeout_s * (1.0 + 0.5 * self.rank)
+
+    def _election_poll(self, now: float):
+        """Pre-promotion peer poll: term-exchange with every peer.
+        Returns ``(reachable, best_rev, best_peer)``, or None when the
+        election must abort (a higher term or a live primary exists —
+        the exchange already adopted/retargeted)."""
+        from datafusion_tpu import cluster as _cluster
+        from datafusion_tpu.errors import ExecutionError
+
+        reachable = 1
+        best_rev, best_peer = self.state._rev, None
+        # poll the same population election_quorum counts: peers AND
+        # explicitly configured replicas (a node wired with replicas=
+        # but no peers must still be able to win an election)
+        candidates, seen = [], set()
+        for peer in list(self.peers) + list(self.replicas):
+            if peer is self or peer == self.addr:
+                continue
+            key = getattr(peer, "addr", None) or (
+                peer if isinstance(peer, str) else id(peer)
             )
-        if events:
-            METRICS.add("cluster.replicated_events", len(events))
-        return len(events)
+            if key in seen:
+                continue
+            seen.add(key)
+            candidates.append(peer)
+        for peer in candidates:
+            try:
+                resp = _cluster.connect(peer).request({
+                    "type": "peer_status", "term": self.term,
+                    "role": self.role, "addr": self.addr,
+                })
+            except (ConnectionError, OSError, ExecutionError):
+                continue
+            pterm = int(resp.get("term", 0))
+            if pterm > self.term or (resp.get("role") == "primary"
+                                     and pterm >= self.term):
+                # a newer term, or a primary that is demonstrably alive
+                # (it just answered us): abort, adopt, chase
+                self._observe_term(pterm, resp.get("role"),
+                                   resp.get("primary") or peer)
+                self.last_primary_contact = now
+                return None
+            reachable += 1
+            prev = int(resp.get("rev", 0))
+            if prev > best_rev:
+                best_rev, best_peer = prev, peer
+        return reachable, best_rev, best_peer
+
+    def _catchup_from(self, peer) -> None:
+        """Adopt a higher-revision peer's log before promoting (the
+        election's acked-write guarantee).  The `election` flag lets a
+        fellow standby serve the pull."""
+        from datafusion_tpu import cluster as _cluster
+
+        resp = _cluster.connect(peer).request({
+            "type": "replicate_pull", "since": self.state._rev,
+            "term": self.term, "addr": self.addr, "election": True,
+        })
+        applied = self._apply_pull_response(resp, note_deadlines=False)
+        if self._force_snapshot and applied == 0:
+            # diverged from the best responder: take its snapshot now
+            resp = _cluster.connect(peer).request({
+                "type": "replicate_pull", "since": self.state._rev,
+                "term": self.term, "addr": self.addr, "election": True,
+                "snapshot": True,
+            })
+            self._apply_pull_response(resp, note_deadlines=False)
+        METRICS.add("cluster.election_catchups")
 
     def maybe_promote(self, now: Optional[float] = None) -> bool:
         """The election: promote when the primary has been silent past
-        the election timeout.  Lease-based — every successful pull
-        renews the primary's leadership lease; silence lets it lapse."""
+        the (rank-staggered) election timeout.  Lease-based — every
+        successful pull or inbound push renews the primary's leadership
+        lease; silence lets it lapse.  In a quorum replica set the
+        candidate first polls its peers: it defers unless
+        ``N - W + 1`` nodes are reachable, aborts on any higher term or
+        live primary, and catches up from the highest-revision
+        responder — the promoted node's log then contains every
+        acknowledged revision."""
         if self.role == "primary":
             return False
         now = time.monotonic() if now is None else now
-        if now - self.last_primary_contact < self.election_timeout_s:
+        if now - self.last_primary_contact < self.effective_election_timeout_s:
             return False
         faults.check("cluster.election", addr=self.addr, term=self.term)
-        self.state.promote(self.term + 1)
+        if self.write_quorum > 1:
+            poll = self._election_poll(now)
+            if poll is None:
+                return False  # fenced: a better claimant exists
+            reachable, best_rev, best_peer = poll
+            if reachable < self.election_quorum:
+                self.elections_deferred += 1
+                METRICS.add("cluster.elections_deferred")
+                return False  # cannot guarantee acked-write coverage
+            if best_rev > self.state._rev and best_peer is not None:
+                from datafusion_tpu.errors import ExecutionError
+
+                try:
+                    self._catchup_from(best_peer)
+                except (ConnectionError, OSError, ExecutionError):
+                    self.elections_deferred += 1
+                    METRICS.add("cluster.elections_deferred")
+                    return False  # retry next cycle with a fresh poll
+        self.state.promote(self.term + 1, now=now)
         self.role = "primary"
         self.standby_of = None
         self._upstream_client = None
@@ -933,16 +1561,21 @@ class ClusterNode:
         # the puller was promoted past us? if we still think we are
         # primary, we are the revived old primary — step down NOW
         self._observe_term(int(msg.get("term", 0)), None, msg.get("addr"))
-        if self.role != "primary":
+        if self.role != "primary" and not msg.get("election"):
             # a demoted (or never-primary) node must not feed the log:
             # the puller follows the hint to the real primary, and a
             # standby that kept "succeeding" against a deposed upstream
-            # would otherwise defer its own election forever
+            # would otherwise defer its own election forever.  The ONE
+            # exception is an election catch-up pull: a candidate that
+            # polled us as the highest-revision survivor adopts our log
+            # BEFORE promoting — that is how an acked write outlives
+            # the primary that acked it.
             return self._not_primary_reply("replication")
         since = int(msg.get("since", 0))
         state = self.state
         base = {"type": "replicate", "term": self.term, "role": self.role,
-                "epoch": state.membership()["epoch"], "rev": state._rev}
+                "epoch": state.membership()["epoch"], "rev": state._rev,
+                "lease_deadlines": state.lease_deadlines()}
         out = state.events_since(since, kinds=None)
         if msg.get("snapshot") or out.get("truncated") or \
                 (since == 0 and state._rev > 0 and
@@ -1067,6 +1700,9 @@ class ClusterNode:
             "cluster.term": self.term,
             "cluster.role": 1 if self.role == "primary" else 0,
             "cluster.replication_lag_revisions": self.replication_lag_revisions,
+            "cluster.write_quorum": self.write_quorum,
+            "cluster.replica_set_size": self.cluster_size(),
+            "cluster.succession_rank": self.rank,
         }
 
     def status(self) -> dict:
@@ -1078,6 +1714,15 @@ class ClusterNode:
             "replication_lag_revisions": self.replication_lag_revisions,
             "promotions": self.promotions,
             "step_downs": self.step_downs,
+            "write_quorum": self.write_quorum,
+            "replica_set_size": self.cluster_size(),
+            "rank": self.rank,
+            "elections_deferred": self.elections_deferred,
+            "parked_watchers": self.state.parked_watchers(),
+            # the scale smoke's thread-count assertion reads this: an
+            # event-driven node's thread count is O(pool), independent
+            # of how many watches/scrapes are parked on it
+            "threads": threading.active_count(),
         })
         return out
 
@@ -1092,45 +1737,74 @@ def handle_request(target, msg: dict, bw=None) -> dict:
     return apply_request(target, msg, bw)
 
 
-class _Handler(socketserver.BaseRequestHandler):
-    def handle(self):
-        from datafusion_tpu.errors import ExecutionError
-        from datafusion_tpu.parallel.wire import (
-            BinWriter,
-            crc_for_peer,
-            recv_msg,
-            send_msg,
-        )
+def _park_watch(node: ClusterNode, loop, conn, msg: dict) -> None:
+    """Event-loop watch: park the request as a waiter + timer instead
+    of a thread.  Exactly-once answer: whichever of {event notify,
+    timeout} fires first replies; the other is a no-op."""
+    state = node.state
+    since = int(msg.get("since", 0))
+    timeout_s = max(0.0, min(float(msg.get("timeout_s", 10.0)),
+                             _WATCH_TIMEOUT_CAP_S))
+    done = {"sent": False}
+    holder: dict = {"token": None, "timer": None}
 
-        node: ClusterNode = self.server.cluster_node  # type: ignore[attr-defined]
-        while True:
-            try:
-                msg = recv_msg(self.request)
-            except (ConnectionError, OSError, ExecutionError):
-                return
-            if msg is None:
-                return
-            bw = BinWriter()
-            try:
-                if msg.get("type") == "shutdown":
-                    send_msg(self.request, {"type": "bye"})
-                    threading.Thread(
-                        target=self.server.shutdown, daemon=True
-                    ).start()
-                    return
-                out = node.handle_request(msg, bw)
-            except Exception as e:  # noqa: BLE001 — the service must not die on a bad request
-                out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
-                bw = BinWriter()  # a failed build may hold partial segments
-            try:
-                send_msg(self.request, out, bw, crc=crc_for_peer(msg))
-            except (ConnectionError, OSError):
-                return
+    def finish():
+        if done["sent"]:
+            return
+        done["sent"] = True
+        timer = holder["timer"]
+        if timer is not None:
+            timer.cancel()
+        state.cancel_watch(holder["token"])
+        if conn.closed:
+            return  # the watcher hung up while parked
+        conn.reply(msg, {"type": "watch", **state.watch_answer(since)})
+
+    resp, token = state.watch_async(
+        since, notify=lambda: loop.call_soon(finish)
+    )
+    if resp is not None:
+        conn.reply(msg, {"type": "watch", **resp})
+        return
+    holder["token"] = token
+    holder["timer"] = loop.call_later(timeout_s, finish)
+    METRICS.add("cluster.watches_parked")
 
 
-class ClusterStateService(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
-    daemon_threads = True
+def _service_on_message(node: ClusterNode, loop, conn, msg: dict) -> None:
+    """The event server's per-frame dispatch (loop thread, must not
+    block): watches park; everything else — including quorum commits,
+    which block on replica round trips — runs on the bounded executor."""
+    from datafusion_tpu.parallel.wire import BinWriter
+
+    kind = msg.get("type")
+    if kind == "shutdown":
+        conn.reply(msg, {"type": "bye"})
+        loop.call_later(0.05, loop.stop)  # after the bye flushes
+        return
+    if kind == "watch" and node.role == "primary":
+        _park_watch(node, loop, conn, msg)
+        return
+
+    def work():
+        bw = BinWriter()
+        try:
+            out = node.handle_request(msg, bw)
+        except Exception as e:  # noqa: BLE001 — the service must not die on a bad request
+            out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
+            bw = BinWriter()  # a failed build may hold partial segments
+        return out, bw
+
+    conn.defer_reply(msg, work)
+
+
+class ClusterStateService(LoopServer):
+    """The cluster service on the selector event loop: parked watches
+    and idle client connections cost file descriptors, not threads
+    (socketserver-compatible facade — see `utils/eventloop.py`)."""
+
+    cluster_node: ClusterNode
+    cluster_state: ClusterState
 
 
 def serve(bind: str = "127.0.0.1:0",
@@ -1139,25 +1813,46 @@ def serve(bind: str = "127.0.0.1:0",
           standby_of: Optional[str] = None,
           peers=(),
           election_timeout_s: Optional[float] = None,
-          advertise: Optional[str] = None) -> ClusterStateService:
+          advertise: Optional[str] = None,
+          write_quorum: Optional[int] = None,
+          rank: int = 0) -> ClusterStateService:
     """Run the service on `bind`; returns the server (embed it, or call
     `serve_forever` via ``python -m datafusion_tpu.cluster``).
     `standby_of` starts this instance as a replicating standby of an
     existing primary; `peers` (addresses, self included or not) arms
-    the term-exchange probe that fences a revived old primary."""
+    the term-exchange probe that fences a revived old primary AND names
+    the replica set for quorum pushes + elections; `write_quorum` > 1
+    turns on synchronous quorum-acked writes; `rank` staggers the
+    succession order."""
+    from datafusion_tpu.utils.eventloop import ServerLoop, WireConnection
+
     host, _, port = bind.partition(":")
-    server = ClusterStateService((host, int(port or 0)), _Handler)
-    bound_host, bound_port = server.server_address[:2]
+    loop = ServerLoop(name="df-tpu-cluster-svc")
+    node_cell: list = []  # filled below; no frame arrives before run()
+
+    def conn_factory(lp, sock, a):
+        return WireConnection(
+            lp, sock, a,
+            lambda conn, msg: _service_on_message(
+                node_cell[0], lp, conn, msg
+            ),
+        )
+
+    lsock = loop.listen(host, int(port or 0), conn_factory)
+    bound_host, bound_port = lsock.getsockname()[:2]
     addr = advertise or f"{bound_host}:{bound_port}"
     if node is None:
         node = ClusterNode(
             state=state, addr=addr, standby_of=standby_of, peers=peers,
             election_timeout_s=election_timeout_s,
+            write_quorum=write_quorum, rank=rank,
         )
         if standby_of or node.peers:
             node.start()
-    server.cluster_node = node  # type: ignore[attr-defined]
-    server.cluster_state = node.state  # type: ignore[attr-defined]
+    node_cell.append(node)
+    server = ClusterStateService(loop, lsock)
+    server.cluster_node = node
+    server.cluster_state = node.state
     return server
 
 
@@ -1185,18 +1880,29 @@ def main(argv=None) -> int:
     ap.add_argument("--election-timeout-s", type=float, default=None,
                     help="promote after this much primary silence "
                          "(default: env DATAFUSION_TPU_CLUSTER_ELECTION_S "
-                         "or half the lease TTL)")
+                         "or half the lease TTL; rank-staggered: each "
+                         "succession rank waits half a timeout longer)")
+    ap.add_argument("--write-quorum", type=int, default=None,
+                    help="replicas (this node included) that must hold a "
+                         "mutation before it is acknowledged (default: env "
+                         "DATAFUSION_TPU_CLUSTER_QUORUM or 1 = async "
+                         "replication; a 3-replica set wants 2)")
+    ap.add_argument("--rank", type=int, default=0,
+                    help="succession rank for elections (0 = first in "
+                         "line; higher ranks wait longer before claiming)")
     args = ap.parse_args(argv)
     peers = [p.strip() for p in (args.peers or "").split(",") if p.strip()]
     server = serve(args.bind, standby_of=args.standby_of, peers=peers,
                    election_timeout_s=args.election_timeout_s,
-                   advertise=args.advertise)
+                   advertise=args.advertise,
+                   write_quorum=args.write_quorum, rank=args.rank)
     host, port = server.server_address[:2]
     node: ClusterNode = server.cluster_node  # type: ignore[attr-defined]
     # NB: smoke harnesses parse this line for the address — keep the
     # role/term detail on its own line
     print(f"cluster service listening on {host}:{port}", flush=True)
-    print(f"cluster service role={node.role} term={node.term}"
+    print(f"cluster service role={node.role} term={node.term} "
+          f"quorum={node.write_quorum} rank={node.rank}"
           + (f" standby_of={args.standby_of}" if args.standby_of else ""),
           flush=True)
     try:

@@ -111,6 +111,24 @@ def wire_to_raw(obj: dict) -> dict:
     }
 
 
+def column_digests(raw: dict) -> list[str]:
+    """Per-column content digests of a raw snapshot (dtype + shape +
+    bytes, 16 hex chars).  The delta-publish protocol's identity: a
+    column whose digest matches the service's stored copy is not
+    re-shipped on republish."""
+    import hashlib
+
+    digs = []
+    for c in raw["columns"]:
+        a = np.ascontiguousarray(_as_array(c))
+        h = hashlib.sha256()
+        h.update(a.dtype.str.encode("ascii"))
+        h.update(str(a.shape).encode("ascii"))
+        h.update(memoryview(a).cast("B"))
+        digs.append(h.hexdigest()[:16])
+    return digs
+
+
 def encode_result(entry: CachedResult, bw=None) -> dict:
     """Wire-encode a `CachedResult` snapshot (binary segments when a
     `BinWriter` is given, inline base64 otherwise)."""
@@ -139,12 +157,19 @@ class SharedResultTier:
       store(key, value, nbytes, tags) -> None  (must not block)
     """
 
+    _PUBLISHED_KEYS_MAX = 512
+
     def __init__(self, client, queue_depth: int = 64):
         self.client = client
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = lockcheck.make_lock("cluster.shared_tier")
+        # key -> column digests of this publisher's last publication;
+        # armed, a republish ships a DELTA (changed columns only, with
+        # a full-snapshot fallback when the service disagrees).
+        # Publisher-thread-only, bounded.
+        self._published: dict[str, list[str]] = {}
 
     # -- read-through --
     def load(self, key: str):
@@ -198,10 +223,7 @@ class SharedResultTier:
                 continue
             key, value, nbytes, tags = item
             try:
-                with obs_trace.span("cluster.shared_cache", op="put"):
-                    sent = self.client.result_publish(
-                        key, value, nbytes, tables=tags
-                    )
+                sent = self._publish_one(key, value, nbytes, tags)
                 METRICS.add("coord.shared_cache_published")
                 if sent:
                     # actual wire cost of the publication (binary
@@ -214,6 +236,35 @@ class SharedResultTier:
                 METRICS.add("coord.shared_cache_errors")
             finally:
                 self._q.task_done()
+
+    def _publish_one(self, key: str, value, nbytes: int, tags: tuple) -> int:
+        """One publication: delta when this publisher has published
+        `key` before (only changed columns cross the wire; the service
+        answers ``need_full`` on any digest disagreement and we fall
+        back), full snapshot otherwise.  Returns the bytes sent."""
+        digests = column_digests(result_raw(value))
+        prev = self._published.get(key)
+        sent: Optional[int] = None
+        with obs_trace.span("cluster.shared_cache", op="put",
+                            delta=prev is not None):
+            if prev is not None:
+                sent = self.client.result_publish_delta(
+                    key, value, nbytes, tags, digests, prev
+                )
+                if sent is not None:
+                    METRICS.add("coord.shared_cache_delta_published")
+            if sent is None:
+                sent = self.client.result_publish(
+                    key, value, nbytes, tables=tags, digests=digests
+                )
+        if key not in self._published \
+                and len(self._published) >= self._PUBLISHED_KEYS_MAX:
+            # evict only when a NEW key would grow the map — a warm
+            # republish (the delta path's whole reason) must not bump
+            # another hot key back to full-snapshot publishing
+            self._published.pop(next(iter(self._published)))
+        self._published[key] = digests
+        return int(sent or 0)
 
     def flush(self, timeout_s: float = 10.0) -> bool:
         """Block until the publish queue drains (tests, smoke scripts —

@@ -91,6 +91,21 @@ class ClusterNotPrimaryError(TransientError, ExecutionError):
         self.primary = primary
 
 
+class ClusterQuorumError(TransientError, ExecutionError):
+    """The primary applied a mutation but could not collect the
+    configured write-quorum of replica acknowledgements, so the write
+    is NOT acknowledged durable.  Transient by construction: replicas
+    rejoin (or an election resolves), and the client's failover sweep
+    retries — the mutation is idempotent against the log (replays land
+    on the already-applied revision).  `acks` / `quorum` carry the
+    observed count and the bar it missed."""
+
+    def __init__(self, message: str, acks: int = 0, quorum: int = 0):
+        super().__init__(message)
+        self.acks = int(acks)
+        self.quorum = int(quorum)
+
+
 class StaleTermError(ExecutionError):
     """A write carried a leadership term older than the service's
     current term — the writer is a deposed primary and must not mutate

@@ -200,203 +200,217 @@ GET /debug/top                fleet/local top view (text)
 GET /debug/profile?seconds=N[&hz=H&format=speedscope|collapsed|json]
 GET /debug/bundle[?seconds=N&trace_id=]  one artifact: everything above
 GET /status | /healthz        node status (JSON)
+
+Auth: when DATAFUSION_TPU_DEBUG_TOKEN is set, every /debug/* and
+/metrics request needs "Authorization: Bearer <token>" (constant-time
+compared); /status and /healthz stay open for probes.
 """
 
 
-def _make_handler():
-    from http.server import BaseHTTPRequestHandler
+def debug_bind_host(requested: Optional[str] = None) -> str:
+    """Where the debug plane binds: LOOPBACK unless the operator opts
+    out (``DATAFUSION_TPU_DEBUG_BIND``, e.g. ``0.0.0.0`` inside a
+    container whose port mapping is the boundary).  A worker bound to a
+    routable interface must NOT drag its diagnostics port onto it by
+    default — the plane serves profiles, env vars, and flight rings."""
+    env = os.environ.get("DATAFUSION_TPU_DEBUG_BIND", "").strip()
+    if env:
+        return env
+    if requested in (None, "", "localhost", "127.0.0.1", "::1"):
+        return requested or "127.0.0.1"
+    return "127.0.0.1"
 
-    class _DebugHandler(BaseHTTPRequestHandler):
-        server_version = "datafusion-tpu-debug"
 
-        def _send(self, code: int, body: bytes,
-                  content_type: str = "application/json") -> None:
-            self.send_response(code)
-            self.send_header("Content-Type", content_type)
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+def debug_token() -> Optional[str]:
+    """The bearer token guarding /debug/* (None = auth off — fine on
+    loopback, mandatory hygiene anywhere else)."""
+    return os.environ.get("DATAFUSION_TPU_DEBUG_TOKEN") or None
 
-        def _json(self, obj, code: int = 200) -> None:
-            self._send(code, json.dumps(obj, default=str).encode())
 
-        def _text(self, text: str, code: int = 200) -> None:
-            self._send(code, text.encode(),
-                       "text/plain; charset=utf-8")
+def _authorized(headers: dict, token: Optional[str]) -> bool:
+    """Constant-time bearer check (`hmac.compare_digest` — a scrape
+    must not be able to binary-search the token by response timing)."""
+    if token is None:
+        return True
+    import hmac
 
-        def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler API
-            from urllib.parse import parse_qs, urlparse
+    supplied = headers.get("authorization", "")
+    if supplied.lower().startswith("bearer "):
+        supplied = supplied[7:].strip()
+    return hmac.compare_digest(supplied.encode("utf-8"),
+                               token.encode("utf-8"))
 
-            srv = self.server  # DebugServer
-            u = urlparse(self.path)
-            q = {k: v[-1] for k, v in parse_qs(u.query).items()}
-            path = u.path.rstrip("/") or "/"
-            try:
-                self._route(srv, path, q)
-            except BrokenPipeError:
-                pass
-            except Exception as e:  # noqa: BLE001 — one bad request must not kill the plane
-                METRICS.add("obs.debug_request_errors")
-                try:
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
-                except OSError:
-                    pass
 
-        def _route(self, srv, path: str, q: dict) -> None:
-            if path in ("/", "/debug"):
-                self._text(_INDEX.format(label=srv.label))
-            elif path in ("/debug/metrics", "/metrics"):
-                from datafusion_tpu.obs.aggregate import refresh_host_gauges
-                from datafusion_tpu.obs.export import prometheus_text
+# paths every probe may hit without a token, even when auth is armed
+_OPEN_PATHS = frozenset(("/status", "/healthz"))
 
-                refresh_host_gauges()
-                self._send(
-                    200,
-                    prometheus_text(
-                        METRICS, extra_gauges=srv.gauges()
-                    ).encode(),
-                    "text/plain; version=0.0.4",
-                )
-            elif path == "/debug/flights":
-                from datafusion_tpu.obs import recorder
 
-                self._json({
-                    "node": srv.label,
-                    "events_emitted": recorder.emitted(),
-                    "events": recorder.events(q.get("trace_id") or None),
-                })
-            elif path == "/debug/hbm":
-                from datafusion_tpu.obs import device as _device
-                from datafusion_tpu.obs.device import LEDGER
+def _json_body(obj, code: int = 200):
+    return code, "application/json", json.dumps(obj, default=str).encode()
 
-                if _device.enabled():
-                    self._json({"enabled": True, **LEDGER.snapshot()})
-                else:
-                    self._json({"enabled": False})
-            elif path == "/debug/top":
-                self._text(srv.top())
-            elif path == "/debug/profile":
-                from datafusion_tpu.obs import profiler
 
-                seconds = min(
-                    max(float(q.get("seconds", 1.0)), 0.0), _PROFILE_S_CAP
-                )
-                hz = float(q["hz"]) if q.get("hz") else None
-                rep = profiler.capture_seconds(
-                    seconds, hz=hz, name="/debug/profile"
-                )
-                fmt = q.get("format", "speedscope")
-                if fmt == "collapsed":
-                    self._text(rep.collapsed())
-                elif fmt == "json":
-                    self._json(rep.to_json())
-                else:
-                    self._json(rep.speedscope())
-            elif path == "/debug/bundle":
-                self._json(build_bundle(
-                    label=srv.label,
-                    gauges_fn=srv.gauges,
-                    status_fn=srv.status_fn,
-                    profile_seconds=float(
-                        q.get("seconds", _BUNDLE_PROFILE_S_DEFAULT)
-                    ),
-                    trace_id=q.get("trace_id") or None,
-                ))
-            elif path in ("/status", "/healthz", "/debug/status"):
-                self._json(srv.status())
-            else:
-                self._json({"error": f"unknown path {path}"}, 404)
+def _text_body(text: str, code: int = 200):
+    return code, "text/plain; charset=utf-8", text.encode()
 
-        def log_message(self, *args):  # quiet: one line per probe scrape
-            pass
 
-    return _DebugHandler
+def _route_request(srv: "DebugServer", path: str, q: dict):
+    """One debug route -> ``(code, content_type, body)``; transport-
+    independent so tests can drive it in-process."""
+    if path in ("/", "/debug"):
+        return _text_body(_INDEX.format(label=srv.label))
+    if path in ("/debug/metrics", "/metrics"):
+        from datafusion_tpu.obs.aggregate import refresh_host_gauges
+        from datafusion_tpu.obs.export import prometheus_text
+
+        refresh_host_gauges()
+        return (200, "text/plain; version=0.0.4",
+                prometheus_text(METRICS, extra_gauges=srv.gauges()).encode())
+    if path == "/debug/flights":
+        from datafusion_tpu.obs import recorder
+
+        return _json_body({
+            "node": srv.label,
+            "events_emitted": recorder.emitted(),
+            "events": recorder.events(q.get("trace_id") or None),
+        })
+    if path == "/debug/hbm":
+        from datafusion_tpu.obs import device as _device
+        from datafusion_tpu.obs.device import LEDGER
+
+        if _device.enabled():
+            return _json_body({"enabled": True, **LEDGER.snapshot()})
+        return _json_body({"enabled": False})
+    if path == "/debug/top":
+        return _text_body(srv.top())
+    if path == "/debug/profile":
+        from datafusion_tpu.obs import profiler
+
+        seconds = min(max(float(q.get("seconds", 1.0)), 0.0), _PROFILE_S_CAP)
+        hz = float(q["hz"]) if q.get("hz") else None
+        # the capture sleeps on the EXECUTOR thread — the selector keeps
+        # serving scrapes and parked connections meanwhile
+        rep = profiler.capture_seconds(seconds, hz=hz, name="/debug/profile")
+        fmt = q.get("format", "speedscope")
+        if fmt == "collapsed":
+            return _text_body(rep.collapsed())
+        if fmt == "json":
+            return _json_body(rep.to_json())
+        return _json_body(rep.speedscope())
+    if path == "/debug/bundle":
+        return _json_body(build_bundle(
+            label=srv.label,
+            gauges_fn=srv.gauges,
+            status_fn=srv.status_fn,
+            profile_seconds=float(q.get("seconds", _BUNDLE_PROFILE_S_DEFAULT)),
+            trace_id=q.get("trace_id") or None,
+        ))
+    if path in ("/status", "/healthz", "/debug/status"):
+        return _json_body(srv.status())
+    return _json_body({"error": f"unknown path {path}"}, 404)
 
 
 class DebugServer:
-    """One node's debug plane.  Providers are injected so the same
-    server runs on a worker (worker-state status/gauges) and a
-    coordinator (fleet-aggregated gauges + fleet top):
+    """One node's debug plane, on its own selector event loop: idle
+    scrape keep-alives and slow readers cost file descriptors, not
+    threads (only route handlers occupy the small executor pool, and
+    only while computing).  Providers are injected so the same server
+    runs on a worker (worker-state status/gauges) and a coordinator
+    (fleet-aggregated gauges + fleet top):
 
     - ``gauges_fn``: extra point-in-time gauges for the scrape;
     - ``status_fn``: the ``/status`` JSON (defaults to a minimal
       uptime/label document);
     - ``top_fn``: the ``/debug/top`` text (defaults to the local-node
       fleet view).
-    """
+
+    Hardening: binds loopback by default (`debug_bind_host`), and when
+    ``DATAFUSION_TPU_DEBUG_TOKEN`` is set every ``/debug/*`` and
+    ``/metrics`` request must carry the bearer token
+    (constant-time-compared; ``/status``/``/healthz`` stay open for
+    liveness probes)."""
 
     def __init__(self, port: int, host: str = "127.0.0.1", *,
                  label: Optional[str] = None,
                  gauges_fn: Optional[Callable[[], dict]] = None,
                  status_fn: Optional[Callable[[], dict]] = None,
                  top_fn: Optional[Callable[[], str]] = None):
-        from http.server import ThreadingHTTPServer
+        from datafusion_tpu.utils.eventloop import (
+            HttpConnection,
+            ServerLoop,
+        )
 
         self.label = label or _node_label()
         self.gauges_fn = gauges_fn
         self.status_fn = status_fn
         self.top_fn = top_fn
         self.started = time.time()
-
-        outer = self
-
-        class _Server(ThreadingHTTPServer):
-            daemon_threads = True
-            allow_reuse_address = True
-            # handler-facing providers (the handler sees this object
-            # as `self.server`)
-            label = outer.label
-
-            def gauges(self):
-                if outer.gauges_fn is None:
-                    return {}
-                return outer.gauges_fn() or {}
-
-            def top(self):
-                if outer.top_fn is not None:
-                    return outer.top_fn()
-                return _local_top_text()
-
-            def status(self):
-                if outer.status_fn is not None:
-                    return outer.status_fn()
-                return {
-                    "type": "status",
-                    "node": outer.label,
-                    "uptime_s": round(time.time() - outer.started, 1),
-                }
-
-            @property
-            def status_fn(self):
-                return outer.status_fn
-
-        self._http = _Server((host, int(port)), _make_handler())
+        self._token = debug_token()
+        self._loop = ServerLoop(name="df-tpu-debug")
+        self._lsock = self._loop.listen(
+            host, int(port),
+            lambda lp, sock, a: HttpConnection(lp, sock, a, self._handle),
+        )
         self._thread = threading.Thread(
-            target=self._http.serve_forever,
-            name="df-tpu-debug-http", daemon=True,
+            target=self._loop.run, name="df-tpu-debug-http", daemon=True,
         )
         self._thread.start()
+
+    # -- providers (handler-facing) -----------------------------------
+    def gauges(self) -> dict:
+        if self.gauges_fn is None:
+            return {}
+        return self.gauges_fn() or {}
+
+    def top(self) -> str:
+        if self.top_fn is not None:
+            return self.top_fn()
+        return _local_top_text()
+
+    def status(self) -> dict:
+        if self.status_fn is not None:
+            return self.status_fn()
+        return {
+            "type": "status",
+            "node": self.label,
+            "uptime_s": round(time.time() - self.started, 1),
+        }
+
+    def _handle(self, method: str, path: str, q: dict, headers: dict):
+        # executor thread; HttpConnection turns an escape into a 500
+        if path not in _OPEN_PATHS and not _authorized(headers, self._token):
+            METRICS.add("obs.debug_auth_rejections")
+            return _json_body(
+                {"error": "missing or invalid bearer token "
+                          "(DATAFUSION_TPU_DEBUG_TOKEN is set)"},
+                401,
+            )
+        try:
+            return _route_request(self, path, q)
+        except Exception as e:  # noqa: BLE001 — one bad request must not kill the plane
+            METRICS.add("obs.debug_request_errors")
+            return _json_body({"error": f"{type(e).__name__}: {e}"}, 500)
 
     # -- address / lifecycle ------------------------------------------
     @property
     def server_address(self):  # backcompat with the old HTTP status shim
-        return self._http.server_address
+        return self._lsock.getsockname()
 
     @property
     def port(self) -> int:
-        return int(self._http.server_address[1])
+        return int(self.server_address[1])
 
     @property
     def url(self) -> str:
-        host, port = self._http.server_address[:2]
+        host, port = self.server_address[:2]
         return f"http://{host}:{port}"
 
     def shutdown(self) -> None:  # backcompat alias
-        self._http.shutdown()
+        self._loop.stop()
+        self._loop.wait_stopped()
 
     def close(self) -> None:
-        self._http.shutdown()
-        self._http.server_close()
+        self.shutdown()
+        self._loop.close()
 
 
 def start_debug_server(port: Optional[int], host: str = "127.0.0.1",
@@ -409,7 +423,8 @@ def start_debug_server(port: Optional[int], host: str = "127.0.0.1",
     if not port:
         return None
     try:
-        return DebugServer(max(int(port), 0), host, **providers)
+        return DebugServer(max(int(port), 0), debug_bind_host(host),
+                           **providers)
     except OSError:
         METRICS.add("obs.debug_server_errors")
         return None

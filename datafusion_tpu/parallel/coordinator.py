@@ -314,6 +314,7 @@ class HeartbeatMonitor:
             # interval.  A clean timeout refreshes the view too; an
             # unreachable service keeps the stale view and backs off a
             # full interval so a dead control plane can't spin us.
+            watch_failures = 0
             while not self._stop.is_set():
                 try:
                     ok = self.membership.watch(timeout_s=self.interval)
@@ -321,9 +322,21 @@ class HeartbeatMonitor:
                 except Exception:  # noqa: BLE001 — the monitor must outlive the service
                     METRICS.add("coord.heartbeat_errors")
                     ok = False
-                self._stop.wait(
-                    0.02 if ok else self.interval * random.uniform(0.8, 1.2)
-                )
+                if ok:
+                    watch_failures = 0
+                    self._stop.wait(0.02)
+                else:
+                    # capped full-jitter backoff instead of a flat
+                    # interval: during a control-plane election the
+                    # promoted primary is typically reachable within a
+                    # second — a coordinator that slept a whole probe
+                    # interval would serve that second's queries off a
+                    # stale view
+                    watch_failures += 1
+                    self._stop.wait(backoff_s(
+                        min(watch_failures, 6),
+                        base=0.1, cap=self.interval * 1.2,
+                    ))
             return
         while not self._stop.wait(self.interval * random.uniform(0.8, 1.2)):
             try:
@@ -959,6 +972,10 @@ class DistributedContext(ExecutionContext):
             self._shared_tier.close()
         if self.debug_server is not None:
             self.debug_server.close()
+        if self.cluster is not None:
+            close = getattr(self.cluster, "close", None)
+            if close is not None:
+                close()  # release the persistent watch channel
 
     def __enter__(self) -> "DistributedContext":
         return self

@@ -90,14 +90,14 @@ class BinWriter:
         self.chunks: list = []  # buffer-protocol objects
 
 
-def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
-             crc: bool = False) -> int:
-    """Send one frame; returns the total bytes written (callers like
-    the shared-tier publisher account wire cost from this)."""
-    faults.check("wire.send", type=obj.get("type"))
-    # a sender holding an engine lock would stall its contenders for a
-    # full network write — lockcheck records any lock held across this
-    lockcheck.note_blocking("wire.send")
+def encode_frame(obj: dict, bw: Optional[BinWriter] = None,
+                 crc: bool = False) -> list:
+    """One message -> the ordered wire chunks of one frame (length
+    prefix first, then header+JSON, then the raw segments streaming
+    straight from their source arrays — no intermediate frame buffer).
+    Shared by the blocking `send_msg` and the selector event servers,
+    whose non-blocking writers queue the chunks instead of sendall'ing
+    them."""
     if bw is not None and bw.chunks:
         sizes = [memoryview(c).nbytes for c in bw.chunks]
         obj = dict(obj)
@@ -106,17 +106,30 @@ def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
             obj["_crc32"] = [zlib.crc32(c) & 0xFFFFFFFF for c in bw.chunks]
         data = json.dumps(obj).encode("utf-8")
         frame_len = 1 + _U32.size + len(data) + sum(sizes)
-        sock.sendall(
-            _LEN.pack(frame_len) + bytes([_TAG_BIN]) + _U32.pack(len(data)) + data
-        )
-        # segments stream straight from the source arrays — no
-        # intermediate frame buffer, no per-array tobytes copy
-        for c in bw.chunks:
-            sock.sendall(c)
-        return _LEN.size + frame_len
+        head = (_LEN.pack(frame_len) + bytes([_TAG_BIN])
+                + _U32.pack(len(data)) + data)
+        return [head, *bw.chunks]
     data = json.dumps(obj).encode("utf-8")
-    sock.sendall(_LEN.pack(len(data)) + data)
-    return _LEN.size + len(data)
+    return [_LEN.pack(len(data)) + data]
+
+
+def frame_nbytes(chunks: list) -> int:
+    """Total wire bytes of an `encode_frame` result."""
+    return sum(memoryview(c).nbytes for c in chunks)
+
+
+def send_msg(sock: socket.socket, obj: dict, bw: Optional[BinWriter] = None,
+             crc: bool = False) -> int:
+    """Send one frame; returns the total bytes written (callers like
+    the shared-tier publisher account wire cost from this)."""
+    faults.check("wire.send", type=obj.get("type"))
+    # a sender holding an engine lock would stall its contenders for a
+    # full network write — lockcheck records any lock held across this
+    lockcheck.note_blocking("wire.send")
+    chunks = encode_frame(obj, bw, crc)
+    for c in chunks:
+        sock.sendall(c)
+    return frame_nbytes(chunks)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytearray]:
@@ -162,6 +175,15 @@ def recv_msg(sock: socket.socket) -> Optional[dict]:
         # handler keys on ConnectionError/OSError
         raise ConnectionError("connection closed mid-frame")
     data = faults.corrupt("wire.recv.payload", data)
+    return parse_frame(data)
+
+
+def parse_frame(data) -> dict:
+    """Decode one complete frame payload (everything AFTER the 8-byte
+    length prefix) into a message dict, attaching binary segments as
+    zero-copy views.  Pure — the caller owns socket reads and fault
+    injection, so the selector event servers share the exact decode
+    (CRC verification included) the blocking path runs."""
     try:
         if data[:1] == bytes([_TAG_BIN]):
             (json_len,) = _U32.unpack(data[1 : 1 + _U32.size])

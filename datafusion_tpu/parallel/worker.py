@@ -24,8 +24,6 @@ from __future__ import annotations
 
 import os
 import socket
-import socketserver
-import threading
 from typing import Optional
 
 import numpy as np
@@ -39,16 +37,11 @@ from datafusion_tpu.exec.context import ExecutionContext
 from datafusion_tpu.exec.materialize import collect_columns
 from datafusion_tpu.obs import trace as obs_trace
 from datafusion_tpu.parallel.physical import PlanFragment
-from datafusion_tpu.parallel.wire import (
-    BinWriter,
-    crc_for_peer,
-    enc_array,
-    recv_msg,
-    send_msg,
-)
+from datafusion_tpu.parallel.wire import BinWriter, enc_array
 from datafusion_tpu.plan.logical import TableScan
 from datafusion_tpu.testing import faults
 from datafusion_tpu.utils.deadline import Deadline, deadline_scope
+from datafusion_tpu.utils.eventloop import LoopServer
 
 
 def _find_scan(plan) -> TableScan:
@@ -396,92 +389,79 @@ class WorkerState:
         }
 
 
-class _Handler(socketserver.BaseRequestHandler):
-    def handle(self):
-        state: WorkerState = self.server.worker_state  # type: ignore[attr-defined]
-        while True:
-            try:
-                msg = recv_msg(self.request)
-            except (ConnectionError, OSError, ExecutionError):
-                return
-            if msg is None:
-                return
-            bw = BinWriter()
-            # trace adoption: the request's {trace_id, parent_span_id}
-            # makes this handler's spans chain under the coordinator's
-            # dispatch span; finished spans ship back in the response
-            adoption = obs_trace.adopt(msg.get("trace"))
-            try:
-                kind = msg.get("type")
-                # the coordinator ships the REMAINING per-query budget in
-                # seconds (absolute times don't transfer between hosts);
-                # re-anchor it here so device retries under this fragment
-                # never sleep past the caller's deadline
-                budget = msg.get("deadline_s")
-                deadline = None if budget is None else Deadline.after(float(budget))
-                if kind == "ping":
-                    out = {"type": "pong", "queries": state.queries}
-                elif kind == "status":
-                    out = state.status()
-                elif kind == "telemetry":
-                    # the non-cluster fleet-aggregation pull: one
-                    # round trip returns the node snapshot alone
-                    out = {"type": "telemetry",
-                           "snapshot": state.telemetry_snapshot()}
-                elif kind == "flight_dump":
-                    # the ring, on demand — trace-filtered when the
-                    # coordinator is assembling one query's artifact
-                    # set across every involved node
-                    from datafusion_tpu.obs import recorder
+def _serve_worker_request(state: WorkerState, msg: dict):
+    """One decoded request -> ``(response, BinWriter)``.  Runs on the
+    event loop's bounded executor — compute concurrency is the pool's
+    width, while any number of idle coordinator connections, heartbeat
+    probes, and parked pulls cost only file descriptors.  Raises
+    `InjectedConnectionAbort` to sever the connection (simulated worker
+    death: the peer sees a mid-query EOF, exactly like a killed
+    process)."""
+    bw = BinWriter()
+    # trace adoption: the request's {trace_id, parent_span_id} makes
+    # this request's spans chain under the coordinator's dispatch span;
+    # finished spans ship back in the response
+    adoption = obs_trace.adopt(msg.get("trace"))
+    try:
+        kind = msg.get("type")
+        # the coordinator ships the REMAINING per-query budget in
+        # seconds (absolute times don't transfer between hosts);
+        # re-anchor it here so device retries under this fragment
+        # never sleep past the caller's deadline
+        budget = msg.get("deadline_s")
+        deadline = None if budget is None else Deadline.after(float(budget))
+        if kind == "ping":
+            out = {"type": "pong", "queries": state.queries}
+        elif kind == "status":
+            out = state.status()
+        elif kind == "telemetry":
+            # the non-cluster fleet-aggregation pull: one round trip
+            # returns the node snapshot alone
+            out = {"type": "telemetry",
+                   "snapshot": state.telemetry_snapshot()}
+        elif kind == "flight_dump":
+            # the ring, on demand — trace-filtered when the
+            # coordinator is assembling one query's artifact set
+            # across every involved node
+            from datafusion_tpu.obs import recorder
 
-                    out = {
-                        "type": "flight_dump",
-                        "node": f"worker:{os.getpid()}",
-                        "events": recorder.events(
-                            msg.get("trace_id") or None
-                        ),
-                        "events_emitted": recorder.emitted(),
-                    }
-                elif kind == "execute_fragment":
-                    with adoption, deadline_scope(deadline):
-                        out = state.execute_fragment(msg["fragment"], bw)
-                elif kind == "execute_plan":
-                    with adoption, deadline_scope(deadline):
-                        out = state.execute_plan(msg["fragment"], bw)
-                elif kind == "shutdown":
-                    send_msg(self.request, {"type": "bye"})
-                    threading.Thread(
-                        target=self.server.shutdown, daemon=True
-                    ).start()
-                    return
-                else:
-                    out = {"type": "error", "message": f"unknown request {kind!r}"}
-            except faults.InjectedConnectionAbort:
-                # simulated worker death for in-process chaos tests:
-                # close the connection without a response (the peer
-                # sees a mid-query EOF, exactly like a killed process)
-                return
-            except DataFusionError as e:
-                out = {"type": "error", "message": str(e)}
-                bw = BinWriter()  # a failed build may have partial segments
-                state.errors += 1
-            except Exception as e:  # noqa: BLE001 — workers must not die on a bad query
-                out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
-                bw = BinWriter()
-                state.errors += 1
-            if adoption.trace_id is not None and isinstance(out, dict):
-                out["spans"] = obs_trace.drain(adoption.trace_id)
-            try:
-                # CRC emission follows the wire-version handshake: only
-                # peers that advertised >= 2 get (and verify) segment CRCs
-                send_msg(self.request, out, bw, crc=crc_for_peer(msg))
-            except (ConnectionError, OSError):
-                return
+            out = {
+                "type": "flight_dump",
+                "node": f"worker:{os.getpid()}",
+                "events": recorder.events(msg.get("trace_id") or None),
+                "events_emitted": recorder.emitted(),
+            }
+        elif kind == "execute_fragment":
+            with adoption, deadline_scope(deadline):
+                out = state.execute_fragment(msg["fragment"], bw)
+        elif kind == "execute_plan":
+            with adoption, deadline_scope(deadline):
+                out = state.execute_plan(msg["fragment"], bw)
+        else:
+            out = {"type": "error", "message": f"unknown request {kind!r}"}
+    except faults.InjectedConnectionAbort:
+        raise
+    except DataFusionError as e:
+        out = {"type": "error", "message": str(e)}
+        bw = BinWriter()  # a failed build may have partial segments
+        state.errors += 1
+    except Exception as e:  # noqa: BLE001 — workers must not die on a bad query
+        out = {"type": "error", "message": f"{type(e).__name__}: {e}"}
+        bw = BinWriter()
+        state.errors += 1
+    if adoption.trace_id is not None and isinstance(out, dict):
+        out["spans"] = obs_trace.drain(adoption.trace_id)
+    return out, bw
 
 
-class WorkerServer(socketserver.ThreadingTCPServer):
-    allow_reuse_address = True
-    daemon_threads = True
+class WorkerServer(LoopServer):
+    """The worker on the selector event loop (socketserver-compatible
+    facade; see `utils/eventloop.py`): the accept/read/write side is
+    one thread regardless of connection count, fragment execution runs
+    on the bounded pool."""
+
+    worker_state: WorkerState
+    http_server = None
 
 
 def serve_http_status(state: WorkerState, host: str, port: int):
@@ -522,23 +502,45 @@ def serve(bind: str = "127.0.0.1:0", device=None, batch_size: int = 131072,
     host[:port] coordinators should DIAL — required knowledge when the
     bind address is a wildcard (0.0.0.0 is not dialable from another
     host) or NAT'd (containers)."""
+    from datafusion_tpu.utils.eventloop import ServerLoop, WireConnection
+
     host, _, port = bind.partition(":")
-    server = WorkerServer((host, int(port or 0)), _Handler)
-    server.worker_state = WorkerState(device=device, batch_size=batch_size)  # type: ignore[attr-defined]
-    server.http_server = None  # type: ignore[attr-defined]
+    state = WorkerState(device=device, batch_size=batch_size)
+    loop = ServerLoop(name="df-tpu-worker")
+
+    def on_message(conn, msg):
+        if msg.get("type") == "shutdown":
+            conn.reply(msg, {"type": "bye"})
+            loop.call_later(0.05, loop.stop)  # after the bye flushes
+            return
+        conn.defer_reply(msg, lambda: _serve_worker_request(state, msg))
+
+    lsock = loop.listen(host, int(port or 0),
+                        lambda lp, sock, a: WireConnection(
+                            lp, sock, a, on_message))
+    server = WorkerServer(loop, lsock)
+    server.worker_state = state
+    server.http_server = None
     if http_port:
         # negative = ephemeral bind (smoke harnesses read the port
-        # back); a bind failure degrades the debug plane, not the node
+        # back); a bind failure degrades the debug plane, not the node.
+        # The debug plane binds LOOPBACK by default regardless of the
+        # worker's bind — it serves diagnostics, not queries, and must
+        # not leave the host unless the operator says so
+        # (DATAFUSION_TPU_DEBUG_BIND=0.0.0.0, plus a bearer token).
+        from datafusion_tpu.obs.httpd import debug_bind_host
+
         try:
-            server.http_server = serve_http_status(  # type: ignore[attr-defined]
-                server.worker_state, host, max(int(http_port), 0)
+            server.http_server = serve_http_status(
+                server.worker_state, debug_bind_host(host),
+                max(int(http_port), 0)
             )
         except OSError:
             from datafusion_tpu.utils.metrics import METRICS
 
             METRICS.add("obs.debug_server_errors")
         else:
-            server.worker_state.debug_port = server.http_server.port  # type: ignore[attr-defined]
+            server.worker_state.debug_port = server.http_server.port
     if cluster:
         from datafusion_tpu import cluster as _cluster_mod
         from datafusion_tpu.cluster.agent import WorkerClusterAgent

@@ -1,31 +1,38 @@
 #!/usr/bin/env python
-"""Cluster control plane worked example: TWO service replicas
-(primary + standby), two coordinators, one shared worker pool, a shared
-warm cache hit — and a primary kill the fleet shrugs off.
+"""Cluster control plane worked example: a THREE-replica set with
+quorum-acked writes, ranked succession, two coordinators, one shared
+worker pool, a shared warm cache hit — and a primary kill the fleet
+shrugs off with ZERO acknowledged state lost.
 
 Everything runs in this one process (the in-process deployment shape —
 `ClusterNode` + `LocalClusterClient`); swap the client for
-`connect("host1:p1,host2:p2")` against two ``python -m
-datafusion_tpu.cluster`` processes (`--standby-of`/`--peers`) and
-nothing else changes.  The walk-through:
+`connect("h1:p1,h2:p2,h3:p3")` against three ``python -m
+datafusion_tpu.cluster`` processes (`--standby-of`/`--peers`/
+`--write-quorum 2`/`--rank N`) and nothing else changes.  The
+walk-through:
 
-1. start a PRIMARY and a log-shipping STANDBY replica, register two
-   embedded workers under TTL leases through the two-endpoint client;
+1. start a PRIMARY and two ranked STANDBY replicas with write quorum 2:
+   every client-visible mutation is pushed to the replicas and
+   acknowledged only once 2 of the 3 nodes hold it — there is no
+   async-replication loss window to "wait out" before a kill;
 2. coordinator A discovers the workers from the shared membership
    (no worker list configured anywhere) and runs a GROUP BY;
 3. coordinator B — a different context, as if behind a load balancer —
    submits the same SQL and is served from the SHARED result tier:
    no fragment dispatched, `cache.shared=True` on the replay;
-4. KILL THE PRIMARY: the standby's election fires on primary silence,
-   it promotes (term bump), re-arms every replicated lease, and the
-   client's endpoint sweep rides the next request over — the workers
-   keep their original leases, and a coordinator born after the kill
-   still gets the warm shared-tier hit (the tier replicated too);
+4. KILL THE PRIMARY mid-fleet: rank 0's election polls its peers
+   (quorum reachability + highest-revision catch-up), promotes with a
+   term bump, and re-arms every lease with its SHIPPED remaining
+   deadline — the workers keep their original leases, rank 1 observes
+   the new term and follows instead of racing, and a coordinator born
+   after the kill still gets the warm shared-tier hit;
 5. a broadcast invalidation ON THE NEW PRIMARY drops every worker's
-   fragment-cache entries on their next lease refresh (no TTL wait) —
-   coherence machinery fully live after the failover;
+   fragment-cache entries on their next lease refresh (no TTL wait);
 6. the revived old primary is FENCED: the term exchange demotes it,
-   and a write stamped with its stale term is rejected.
+   and a write stamped with its stale term is rejected;
+7. partition BOTH surviving replicas away from the new primary: a
+   write is refused with the transient ``quorum_unavailable`` — the
+   cluster would rather fail an ack than lie about durability.
 
     JAX_PLATFORMS=cpu python examples/cluster.py
 """
@@ -43,6 +50,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from datafusion_tpu.cache.result import CachedResultRelation
 from datafusion_tpu.cluster import ClusterNode, LocalClusterClient
 from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.errors import ClusterQuorumError
 from datafusion_tpu.exec.datasource import CsvDataSource
 from datafusion_tpu.exec.materialize import collect
 from datafusion_tpu.parallel.coordinator import DistributedContext
@@ -55,7 +63,7 @@ SCHEMA = Schema([
 ])
 SQL = ("SELECT region, SUM(v), COUNT(1), MIN(v), MAX(v) "
        "FROM events GROUP BY region")
-TTL_S = 1.0
+TTL_S = 2.0
 
 
 def make_partitions(tmp: str, n: int = 4, rows: int = 50_000) -> list:
@@ -83,12 +91,18 @@ def main() -> None:
     tmp = tempfile.mkdtemp(prefix="df_tpu_cluster_")
     paths = make_partitions(tmp)
 
-    # -- 1. replicated control plane + two embedded workers --
-    primary = ClusterNode(addr="primary:1")
-    standby = ClusterNode(addr="standby:2", standby_of=primary,
-                          election_timeout_s=1.0,
-                          replicate_interval_s=0.2).start()
-    client = LocalClusterClient([primary, standby])
+    # -- 1. three-replica quorum control plane + two embedded workers --
+    primary = ClusterNode(addr="replica:1", write_quorum=2)
+    s0 = ClusterNode(addr="replica:2", standby_of=primary, write_quorum=2,
+                     rank=0, election_timeout_s=1.0,
+                     replicate_interval_s=0.2).start()
+    s1 = ClusterNode(addr="replica:3", standby_of=primary, write_quorum=2,
+                     rank=1, election_timeout_s=1.0,
+                     replicate_interval_s=0.2).start()
+    primary.peers = [s0, s1]
+    s0.peers = [primary, s1]
+    s1.peers = [primary, s0]
+    client = LocalClusterClient([primary, s0, s1])
     servers = []
     for _ in range(2):
         server = serve("127.0.0.1:0", device="cpu", cluster=client,
@@ -96,8 +110,9 @@ def main() -> None:
         threading.Thread(target=server.serve_forever, daemon=True).start()
         servers.append(server)
     view = client.membership()
-    print(f"membership epoch {view['epoch']} (term {view['term']}): "
-          f"{sorted(view['workers'])}")
+    print(f"membership epoch {view['epoch']} (term {view['term']}, "
+          f"write quorum {primary.write_quorum}/"
+          f"{primary.cluster_size()}): {sorted(view['workers'])}")
 
     # -- 2. coordinator A: workers discovered, query executed --
     ca = DistributedContext(cluster=client)
@@ -122,27 +137,29 @@ def main() -> None:
           f"({cold_ms / max(warm_ms, 1e-6):.0f}x); "
           f"attrs {rel.stats.attrs}")
 
-    # -- 4. kill the PRIMARY: the standby's election takes over --
-    # wait out the replication lag first: log shipping is asynchronous,
-    # and a kill inside the window loses the unreplicated tail (the
-    # cluster.replication_lag_revisions gauge is exactly this number)
-    deadline = time.monotonic() + 10.0
-    while standby.state._rev < primary.state._rev:
-        assert time.monotonic() < deadline, "standby never caught up"
-        time.sleep(0.05)
+    # -- 4. kill the PRIMARY: ranked election, zero acked loss --
+    # NO "wait for replication" step here: with write quorum 2, every
+    # acknowledged mutation (grants, joins, result publishes) already
+    # sits on 2 of the 3 replicas — the loss window the old
+    # cluster.replication_lag_revisions gauge measured is closed by
+    # construction.
     leases = [s.worker_state.cluster_agent.lease for s in servers]
     primary.partitioned = True  # SIGKILL, in-process
-    deadline = time.monotonic() + 10.0
-    while standby.role != "primary" and time.monotonic() < deadline:
+    deadline = time.monotonic() + 15.0
+    while s0.role != "primary" and s1.role != "primary":
+        assert time.monotonic() < deadline, "no replica promoted"
         time.sleep(0.05)
-    print(f"primary killed -> standby promoted: role={standby.role}, "
-          f"term={standby.term}, promotions={standby.promotions}")
+    new_primary = s0 if s0.role == "primary" else s1
+    print(f"primary killed -> rank {new_primary.rank} promoted: "
+          f"term={new_primary.term}, elections deferred by the other "
+          f"rank: {(s1 if new_primary is s0 else s0).elections_deferred}")
     for s, lease in zip(servers, leases):
         agent = s.worker_state.cluster_agent
         agent.poll_once()  # heartbeat fails over inside the client
         assert agent.lease == lease and agent.reregistrations == 0
     print("worker leases preserved across the failover "
-          "(0 re-registrations)")
+          "(0 re-registrations — remaining deadlines shipped, "
+          "not full-TTL re-armed)")
     cc = DistributedContext(cluster=client)  # born after the kill
     register(cc, paths)
     rel = cc.sql(SQL)
@@ -162,15 +179,30 @@ def main() -> None:
 
     # -- 6. the revived old primary is fenced --
     primary.partitioned = False
-    out = standby.handle_request({"type": "kv_put", "key": "boom",
-                                  "value": 1, "term": 1})
+    out = new_primary.handle_request({"type": "kv_put", "key": "boom",
+                                      "value": 1, "term": 1})
     print(f"stale-term write from the old primary: {out['code']!r}")
-    primary.handle_request({"type": "peer_status", "term": standby.term,
-                            "role": "primary", "addr": standby.addr})
+    primary.handle_request({"type": "peer_status",
+                            "term": new_primary.term,
+                            "role": "primary", "addr": new_primary.addr})
     print(f"old primary after the term exchange: role={primary.role}, "
           f"term={primary.term} (resyncs as a standby)")
 
-    standby.stop()
+    # -- 7. quorum loss refuses the ack instead of lying --
+    other = s1 if new_primary is s0 else s0
+    primary.partitioned = True
+    other.partitioned = True
+    try:
+        LocalClusterClient(new_primary).put("config/x", 1)
+        raise AssertionError("a quorumless write must not be acked")
+    except ClusterQuorumError as e:
+        print(f"write with both replicas gone: refused transiently "
+              f"({e.acks}/{e.quorum} acks) — retry when the set heals")
+    primary.partitioned = False
+    other.partitioned = False
+
+    s0.stop()
+    s1.stop()
     ca.close()
     cb.close()
     cc.close()

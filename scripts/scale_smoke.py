@@ -1,0 +1,347 @@
+"""Fleet-scale smoke: quorum replica set + event-driven serving under a
+real SIGKILL election.
+
+The step past cluster_smoke's primary/standby pair — this harness runs
+the cluster the way ROADMAP item 5 describes a fleet:
+
+1. THREE cluster service replicas as OS processes (primary + 2 ranked
+   standbys), write quorum 2, peer-probing each other.
+2. TENS of worker processes (``DFTPU_SCALE_WORKERS``, default 10)
+   registered under short TTL leases through the 3-endpoint client.
+3. A coordinator running distributed queries, with an SLO armed so the
+   burn-rate gauges are live.
+4. HUNDREDS of parked long-poll watches (``DFTPU_SCALE_WATCHES``,
+   default 250) on the primary — and the primary's thread count
+   asserted BOUNDED (the selector event loop's contract: a parked
+   watch is a file descriptor + a waiter entry, not a thread).
+5. A writer hammering quorum-acked KV writes while the primary is
+   SIGKILL'd mid-workload.  After the ranked election:
+   - ZERO acknowledged writes lost (every acked key is on the
+     promoted node),
+   - zero failed queries across the window,
+   - no worker re-registered (leases survived with their SHIPPED
+     remaining deadlines, not a fresh TTL),
+   - the membership view saw no revision regression (the async-
+     replication loss window is closed),
+   - SLO burn gauges stayed green,
+   - fresh watches park-and-wake on the promoted node.
+
+Run directly:  python scripts/scale_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_WORKERS = int(os.environ.get("DFTPU_SCALE_WORKERS", "10"))
+N_WATCHES = int(os.environ.get("DFTPU_SCALE_WATCHES", "250"))
+# generous thread ceiling for the primary: 1 selector + a bounded pool
+# + control/main threads.  The point is it does NOT scale with
+# N_WATCHES — the threaded server would sit at ~N_WATCHES + workers.
+THREAD_CEILING = int(os.environ.get("DFTPU_SCALE_THREAD_CEILING", "40"))
+
+
+def _free_ports(n: int) -> list[int]:
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _spawn(args, env, name: str):
+    stderr_path = tempfile.mktemp(prefix=f"dftpu_{name}_err_")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", *args], cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=open(stderr_path, "w"), text=True,
+    )
+    proc._stderr_path = stderr_path  # type: ignore[attr-defined]
+    return proc
+
+
+def _await_line(proc, needle: str, name: str, timeout_s: float = 120.0):
+    box: dict = {}
+
+    def read():
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                return
+            if needle in line:
+                box["line"] = line
+                return
+
+    t = threading.Thread(target=read, daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    if "line" not in box:
+        proc.kill()
+        tail = open(proc._stderr_path).read()[-2000:]
+        raise AssertionError(f"{name} never printed {needle!r}; stderr:\n{tail}")
+    return box["line"]
+
+
+def _retry(fn, deadline_s: float = 30.0, what: str = "operation"):
+    deadline = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — smoke-level retry wrapper
+            last = e
+            time.sleep(0.1)
+    raise AssertionError(f"{what} never succeeded: {last}")
+
+
+def main() -> int:
+    from datafusion_tpu.cluster import connect
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+    from datafusion_tpu.parallel.wire import recv_msg, send_msg
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["DATAFUSION_TPU_CLUSTER_TTL_S"] = "2"
+    env["DATAFUSION_TPU_CLUSTER_ELECTION_S"] = "1"
+    os.environ["DATAFUSION_TPU_SLO_QUERIES_P95"] = "30"  # green unless broken
+
+    procs: list = []
+    watch_socks: list = []
+    tmpdir = tempfile.mkdtemp(prefix="dftpu_scale_")
+    try:
+        # -- 1. three-replica quorum control plane ---------------------
+        ports = _free_ports(3)
+        addrs = [f"127.0.0.1:{p}" for p in ports]
+        peers = ",".join(addrs)
+        svc = _spawn(["datafusion_tpu.cluster", "--bind", addrs[0],
+                      "--peers", peers, "--write-quorum", "2"],
+                     env, "svc0")
+        procs.append(svc)
+        _await_line(svc, "listening on", "primary service")
+        for rank, addr in enumerate(addrs[1:]):
+            stb = _spawn(["datafusion_tpu.cluster", "--bind", addr,
+                          "--standby-of", addrs[0], "--peers", peers,
+                          "--write-quorum", "2", "--rank", str(rank)],
+                         env, f"svc{rank + 1}")
+            procs.append(stb)
+            _await_line(stb, "listening on", f"standby rank {rank}")
+        print(f"replica set up: {addrs[0]} (primary) + 2 ranked standbys, "
+              "write quorum 2", flush=True)
+
+        # -- 2. tens of workers ----------------------------------------
+        wenv = dict(env)
+        wenv["DATAFUSION_TPU_CLUSTER"] = peers
+        for i in range(N_WORKERS):
+            procs.append(_spawn(["datafusion_tpu.worker",
+                                 "--bind", "127.0.0.1:0",
+                                 "--device", "cpu"], wenv, f"w{i}"))
+        client = connect(peers)
+        _retry(lambda: len(client.membership()["workers"]) >= N_WORKERS
+               or (_ for _ in ()).throw(AssertionError("not yet")),
+               deadline_s=180.0, what=f"{N_WORKERS} worker registrations")
+        print(f"{N_WORKERS} workers registered "
+              f"(epoch {client.membership()['epoch']})", flush=True)
+
+        # -- 3. coordinator + SLO --------------------------------------
+        schema = Schema([Field("region", DataType.UTF8, False),
+                         Field("v", DataType.INT64, False)])
+        import numpy as np
+
+        rng = np.random.default_rng(3)
+        paths = []
+        for p in range(4):
+            path = os.path.join(tmpdir, f"part{p}.csv")
+            with open(path, "w") as f:
+                f.write("region,v\n")
+                for _ in range(1500):
+                    f.write(f"r{rng.integers(0, 5)},"
+                            f"{rng.integers(-100, 100)}\n")
+            paths.append(path)
+        ctx = DistributedContext(cluster=peers)
+        ctx.register_datasource("t", PartitionedDataSource(
+            [CsvDataSource(p, schema, True, 131072) for p in paths]
+        ))
+        want = sorted(collect(
+            ctx.sql("SELECT region, COUNT(1), SUM(v) FROM t GROUP BY region")
+        ).to_rows())
+        print(f"coordinator serving {len(ctx.workers)} workers; "
+              f"baseline query: {len(want)} groups", flush=True)
+
+        # -- 4. park hundreds of watches on the primary ----------------
+        host, port = addrs[0].rsplit(":", 1)
+        rev0 = client.membership()["rev"]
+        for _ in range(N_WATCHES):
+            s = socket.create_connection((host, int(port)), timeout=10)
+            s.settimeout(45.0)
+            send_msg(s, {"type": "watch", "since": rev0, "timeout_s": 40.0})
+            watch_socks.append(s)
+        parked = _retry(
+            lambda: (lambda st: st if st["parked_watchers"] >= N_WATCHES
+                     else (_ for _ in ()).throw(AssertionError(st)))(
+                connect(addrs[0]).status()),
+            what=f"{N_WATCHES} parked watches",
+        )
+        threads = parked["threads"]
+        assert threads <= THREAD_CEILING, (
+            f"{threads} threads with {N_WATCHES} watches parked — the "
+            f"event loop should hold this near its pool size"
+        )
+        print(f"{parked['parked_watchers']} watches parked on the primary "
+              f"with only {threads} threads (ceiling {THREAD_CEILING})",
+              flush=True)
+
+        # -- 5. quorum writer + SIGKILL election -----------------------
+        acked: dict = {}
+        stop_writer = threading.Event()
+        writer_client = connect(peers)
+
+        def write_loop():
+            i = 0
+            while not stop_writer.is_set():
+                key = f"scale/acked/{i}"
+                try:
+                    writer_client.put(key, i)
+                except Exception:  # noqa: BLE001 — unacked: retry same key
+                    time.sleep(0.05)
+                    continue
+                acked[key] = i  # only ACKED writes recorded
+                i += 1
+                time.sleep(0.01)
+
+        wt = threading.Thread(target=write_loop, daemon=True)
+        wt.start()
+        time.sleep(1.0)
+        pre_kill_acked = len(acked)
+        procs[0].send_signal(signal.SIGKILL)
+        print(f"killed PRIMARY (SIGKILL) with {pre_kill_acked} writes "
+              "acked and the writer still running", flush=True)
+
+        def promoted_status():
+            for addr in addrs[1:]:
+                st = connect(addr).status()
+                if st["role"] == "primary" and st["term"] >= 2:
+                    return addr, st
+            raise AssertionError("no promotion yet")
+
+        new_primary, st = _retry(promoted_status, deadline_s=30.0,
+                                 what="ranked election")
+        print(f"promoted: {new_primary} term={st['term']} "
+              f"(quorum {st['write_quorum']}/{st['replica_set_size']})",
+              flush=True)
+
+        # queries must keep succeeding right through the election
+        failed = 0
+        for i in range(5):
+            try:
+                got = sorted(collect(ctx.sql(
+                    "SELECT region, COUNT(1), SUM(v) FROM t GROUP BY region"
+                )).to_rows())
+                assert got == want
+            except Exception as e:  # noqa: BLE001 — counted, reported below
+                print(f"query {i} failed: {e}", flush=True)
+                failed += 1
+        assert failed == 0, f"{failed} queries failed across the election"
+
+        time.sleep(2.0)  # one lease TTL on the new primary
+        stop_writer.set()
+        wt.join(timeout=10)
+
+        # -- zero acked-write loss -------------------------------------
+        new_client = connect(new_primary)
+        lost = [k for k, v in acked.items() if new_client.get(k) != v]
+        assert not lost, (
+            f"{len(lost)}/{len(acked)} ACKED writes missing after "
+            f"failover: {lost[:5]}"
+        )
+        print(f"zero acked-write loss: {len(acked)} acked writes all "
+              f"present on {new_primary}", flush=True)
+
+        # -- leases survived with shipped deadlines (no re-registers) --
+        membership = new_client.membership()
+        assert len(membership["workers"]) >= N_WORKERS, membership
+        rereg = 0
+        for addr in list(membership["workers"])[:N_WORKERS]:
+            h, p = addr.rsplit(":", 1)
+            with socket.create_connection((h, int(p)), timeout=10) as s:
+                s.settimeout(10.0)
+                send_msg(s, {"type": "status"})
+                wst = recv_msg(s)
+            cl = wst.get("cluster") or {}
+            rereg += int(cl.get("reregistrations", 0))
+            assert cl.get("term", 0) >= 2, (addr, cl)
+        assert rereg == 0, f"{rereg} re-registrations — leases were lost"
+        print(f"all {N_WORKERS} leases survived the election "
+              "(0 re-registrations; deadlines shipped, not re-armed)",
+              flush=True)
+
+        # -- loss window closed + SLO green ----------------------------
+        assert ctx.membership.rev_regressions == 0
+        metrics = ctx.metrics_text()
+        burn_lines = [ln for ln in metrics.splitlines()
+                      if "slo." in ln and "burn_rate" in ln]
+        assert burn_lines, "SLO burn gauges missing from the scrape"
+        for ln in burn_lines:
+            assert float(ln.rsplit(" ", 1)[1]) < 1.0, ln
+        print(f"SLO burn green through the election: {burn_lines}",
+              flush=True)
+
+        # -- watches park-and-wake on the promoted node ----------------
+        nh, np_ = new_primary.rsplit(":", 1)
+        rev1 = new_client.membership()["rev"]
+        fresh = []
+        for _ in range(50):
+            s = socket.create_connection((nh, int(np_)), timeout=10)
+            s.settimeout(30.0)
+            send_msg(s, {"type": "watch", "since": rev1,
+                         "timeout_s": 25.0})
+            fresh.append(s)
+        _retry(lambda: (lambda st: st if st["parked_watchers"] >= 50
+                        else (_ for _ in ()).throw(AssertionError(st)))(
+                            new_client.status()),
+               what="watches re-parked on the promoted node")
+        new_client.invalidate("wake")
+        woken = 0
+        for s in fresh:
+            out = recv_msg(s)
+            assert out["fired"] and out["term"] >= 2
+            woken += 1
+            s.close()
+        assert woken == 50
+        print("50 fresh watches parked and woke on the promoted node "
+              f"(term {st['term']})", flush=True)
+
+        ctx.close()
+        print("SCALE SMOKE PASSED", flush=True)
+        return 0
+    finally:
+        for s in watch_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == "__main__":
+    from datafusion_tpu.obs.httpd import run_with_ci_bundle
+
+    sys.exit(run_with_ci_bundle(main, "scale_smoke"))

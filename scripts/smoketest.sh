@@ -45,6 +45,9 @@ python scripts/kernel_smoke.py
 echo "== cluster smoke (failover + control plane: shared membership, shared cache tier, invalidation broadcast, fleet telemetry aggregation, primary/standby HA) =="
 python scripts/cluster_smoke.py
 
+echo "== scale smoke (3-replica quorum election under SIGKILL, lease-deadline shipping, parked-watch fan-out on the event loop) =="
+python scripts/scale_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

@@ -1098,3 +1098,437 @@ class TestFailoverChaos:
                     raise AssertionError(f"never retired: {ctx.workers}")
                 time.sleep(0.05)
             assert len(ctx.workers) == 2
+
+
+# -- replica sets: quorum-acked writes, ranked elections, deadlines -------
+
+
+def _replica_set(quorum=2, election_timeout_s=0.5):
+    """3-node in-process replica set: a primary + two ranked standbys,
+    quorum pushes armed, every node peering with the others."""
+    a = ClusterNode(addr="a:1", write_quorum=quorum)
+    b = ClusterNode(addr="b:2", standby_of=a, write_quorum=quorum,
+                    rank=0, election_timeout_s=election_timeout_s)
+    c = ClusterNode(addr="c:3", standby_of=a, write_quorum=quorum,
+                    rank=1, election_timeout_s=election_timeout_s)
+    a.peers = [b, c]
+    b.peers = [a, c]
+    c.peers = [a, b]
+    return a, b, c, LocalClusterClient([a, b, c])
+
+
+class TestReplicaSetQuorum:
+    def test_acked_write_is_on_quorum_before_the_client_sees_it(self):
+        a, b, c, client = _replica_set()
+        rev = client.put("config/x", 42)
+        # the ack implies BOTH standbys already hold the event (the
+        # primary pushes to all, quorum gates the ack)
+        assert b.state.get("config/x") == 42
+        assert c.state.get("config/x") == 42
+        assert b.state._rev >= rev and c.state._rev >= rev
+        assert METRICS.counts.get("cluster.quorum_writes_acked", 0) >= 1
+
+    def test_quorum_survives_one_dead_replica(self):
+        a, b, c, client = _replica_set()
+        c.partitioned = True
+        rev = client.put("config/x", 1)  # 2/2 acks: a + b
+        assert rev > 0 and b.state.get("config/x") == 1
+        assert c.state.get("config/x") is None  # catches up via pull
+        c.partitioned = False
+        assert c.replicate_once() != 0  # events, or a first-pull snapshot
+        assert c.state.get("config/x") == 1
+        assert c.state._rev == a.state._rev
+
+    def test_quorum_loss_refuses_the_ack_transiently(self):
+        from datafusion_tpu.errors import ClusterQuorumError
+
+        a, b, c, _ = _replica_set()
+        b.partitioned = True
+        c.partitioned = True
+        out = a.handle_request({"type": "kv_put", "key": "k", "value": 1})
+        assert out.get("code") == "quorum_unavailable"
+        assert out.get("acks") == 1 and out.get("quorum") == 2
+        with pytest.raises(ClusterQuorumError):
+            LocalClusterClient(a).put("k2", 2)
+        assert METRICS.counts.get("cluster.quorum_write_failures", 0) >= 2
+        # replicas return: the next write acks AND ships the backlog
+        b.partitioned = False
+        c.partitioned = False
+        assert LocalClusterClient(a).put("k3", 3) > 0
+        assert b.state.get("k") == 1  # the un-acked write replicated too
+        assert b.state.get("k3") == 3
+
+    def test_lease_refresh_heartbeats_skip_the_quorum_round_trip(self):
+        a, b, c, client = _replica_set()
+        g = client.lease_grant(30.0)  # mutation: needs quorum (and got it)
+        b.partitioned = True
+        c.partitioned = True
+        # refreshes append no events, so a partitioned replica set must
+        # not fail (or slow) the worker heartbeat path
+        resp = client.lease_refresh(g["lease"])
+        assert resp["found"] is True
+
+    def test_ranked_succession_with_election_quorum(self):
+        a, b, c, client = _replica_set()
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+        a.partitioned = True
+        now = time.monotonic()
+        # rank 1 defers inside its stagger window while rank 0 claims
+        assert not c.maybe_promote(now=now + 0.6)
+        assert b.maybe_promote(now=now + 0.6)
+        assert b.role == "primary" and b.term == 2
+        # rank 1 then observes the new term and follows instead of racing
+        assert not c.maybe_promote(now=now + 10.0)
+        assert c.role == "standby" and c.term == 2
+        assert c._primary_hint() == "b:2"
+        # the new primary serves the replicated membership
+        assert client.membership()["workers"].keys() == {"w:9"}
+
+    def test_election_defers_without_quorum_reachability(self):
+        a, b, c, _ = _replica_set()
+        a.partitioned = True
+        c.partitioned = True  # b can reach 1 < (3 - 2 + 1) = 2 nodes
+        assert not b.maybe_promote(now=time.monotonic() + 10.0)
+        assert b.role == "standby"
+        assert b.elections_deferred >= 1
+        # reachability restored: the same candidate now wins
+        c.partitioned = False
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        assert b.role == "primary"
+
+    def test_promoted_log_contains_every_acked_revision(self):
+        """The acceptance property: writes acked while one standby was
+        partitioned (quorum met via the OTHER standby) survive a
+        primary kill even when the LAGGING standby is the ranked
+        successor — its election catches up from the best responder
+        before promoting."""
+        a, b, c, client = _replica_set()
+        client.put("config/base", 0)
+        b.partitioned = True  # b lags; acks come from a + c
+        acked = {}
+        for i in range(5):
+            key = f"config/k{i}"
+            acked[key] = i
+            assert client.put(key, i) > 0
+        b.partitioned = False
+        assert b.state.get("config/k0") is None  # genuinely behind
+        a.partitioned = True  # SIGKILL the primary
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        # zero acked-write loss: the promoted node replayed c's log
+        for key, val in acked.items():
+            assert b.state.get(key) == val, key
+        # adopted c's whole log, +1 for b's own "promoted" event
+        assert b.state._rev == c.state._rev + 1
+        assert METRICS.counts.get("cluster.election_catchups", 0) >= 1
+
+    def test_push_and_pull_race_stays_idempotent(self):
+        a, b, c, client = _replica_set()
+        for i in range(4):
+            client.put(f"config/r{i}", i)  # pushed synchronously
+        # the pull loop replays the same tail: zero double-applies
+        assert b.replicate_once() == 0
+        revs = [e["rev"] for e in b.state._events]
+        assert len(revs) == len(set(revs))  # no duplicated log entries
+        assert b.state._rev == a.state._rev
+
+    def test_lagging_replica_resyncs_by_snapshot_push(self):
+        a, b, c, client = _replica_set()
+        client.put("config/seed", 1)
+        b.partitioned = True
+        for i in range(1100):  # blow past the retained log window
+            client.invalidate(f"t{i}")
+        b.partitioned = False
+        snaps_before = b.snapshots_applied
+        # clear the dead-replica push cooldown (quorum rounds skip a
+        # recently-failed link while the OTHER replica covers quorum;
+        # this test wants the push-path resync specifically, without
+        # sleeping out the real cooldown window)
+        for link in a._links.values():
+            link.last_error_at = None
+        assert client.put("config/after", 2) > 0
+        assert b.snapshots_applied == snaps_before + 1
+        assert b.state.get("config/after") == 2
+        assert b.state._rev == a.state._rev
+
+    def test_quorum_path_replicate_fault_site(self):
+        """cluster.replicate now also guards the primary's push path:
+        an injected push failure costs the ack (transient), not state."""
+        from datafusion_tpu.errors import ClusterQuorumError
+
+        a, b, c, _ = _replica_set()
+        client = LocalClusterClient(a)
+        with faults.scoped({"rules": [
+            {"site": "cluster.replicate", "op": "raise",
+             "exc": "ConnectionResetError", "count": 2},
+        ]}):
+            # one request = one quorum round = 2 push-site hits; the
+            # service answers quorum_unavailable for exactly that round
+            out = a.handle_request({"type": "kv_put", "key": "k",
+                                    "value": 1})
+            assert out.get("code") == "quorum_unavailable"
+        # the CLIENT retries quorum failures in place: exhaust its whole
+        # budget (3 attempts x 2 pushes) and the typed error surfaces
+        with faults.scoped({"rules": [
+            {"site": "cluster.replicate", "op": "raise",
+             "exc": "ConnectionResetError", "count": 6},
+        ]}):
+            with pytest.raises(ClusterQuorumError):
+                client.request({"type": "kv_put", "key": "kx", "value": 1})
+        assert METRICS.counts.get("cluster.client_quorum_retries", 0) >= 2
+        assert client.put("k2", 2) > 0  # faults drained: acks flow again
+
+
+class TestLeaseDeadlineShipping:
+    def test_pull_ships_remaining_deadlines(self):
+        a, b, client = _pair()
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        b.replicate_once()
+        shipped = b.state._shipped_deadlines
+        assert g["lease"] in shipped
+        assert 0.0 < shipped[g["lease"]] <= 30.0
+
+    def test_promote_rearms_to_shipped_deadline_not_full_ttl(self):
+        a, b, client = _pair()
+        g = client.lease_grant(10.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        b.replicate_once()
+        # the primary's clock says 2.5s remain (a holder that had been
+        # silent for 7.5s of its 10s TTL — half-dead, not fresh)
+        b.state.note_lease_deadlines({g["lease"]: 2.5})
+        b.state.promote(2, now=1000.0)
+        lease = b.state._leases[g["lease"]]
+        assert lease.expires == pytest.approx(1002.5)
+        # still alive inside the shipped budget...
+        assert b.state.lease_refresh(g["lease"], now=1002.0)["found"]
+
+    def test_promote_expires_past_deadline_holder_promptly(self):
+        a, b, client = _pair()
+        g = client.lease_grant(10.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        b.replicate_once()
+        b.state.note_lease_deadlines({g["lease"]: 0.0})  # already dead
+        b.state.promote(2, now=1000.0)
+        # the next sweep collects it — no full-TTL masking of a corpse
+        assert not b.state.lease_refresh(g["lease"], now=1000.1)["found"]
+        assert b.state.membership(now=1000.1)["workers"] == {}
+
+    def test_promote_caps_shipped_deadline_at_ttl(self):
+        a, b, client = _pair()
+        g = client.lease_grant(5.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        b.replicate_once()
+        b.state.note_lease_deadlines({g["lease"]: 99.0})  # bogus upstream
+        b.state.promote(2, now=1000.0)
+        assert b.state._leases[g["lease"]].expires <= 1005.0
+
+    def test_unshipped_lease_falls_back_to_full_ttl(self):
+        a, b, client = _pair()
+        g = client.lease_grant(5.0)
+        client.put("workers/w:9", {}, lease=g["lease"])
+        b.replicate_once()
+        b.state.note_lease_deadlines({})  # legacy upstream: nothing shipped
+        b.state.promote(2, now=1000.0)
+        assert b.state._leases[g["lease"]].expires == pytest.approx(1005.0)
+
+
+class TestDeltaPublish:
+    def _tcp_tier(self):
+        from datafusion_tpu.cluster.service import serve as serve_cluster
+
+        server = serve_cluster("127.0.0.1:0")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        host, port = server.server_address[:2]
+        return server, connect(f"{host}:{port}")
+
+    def _entry(self, seed=0):
+        rng = np.random.default_rng(7)
+        cols = [np.arange(200_000, dtype=np.int64),
+                rng.integers(0, 100, 200_000).astype(np.int64) + seed]
+        nbytes = sum(c.nbytes for c in cols)
+        return CachedResult(cols, [None, None], [None, None],
+                           200_000, nbytes), nbytes
+
+    def test_warm_republish_ships_only_changed_segments(self):
+        server, client = self._tcp_tier()
+        tier = SharedResultTier(client)
+        try:
+            entry, nbytes = self._entry(seed=0)
+            sent_full = tier._publish_one("fp-delta", entry, nbytes, ("t",))
+            assert sent_full > nbytes  # full snapshot crossed the wire
+            # identical republish: digests only, no column bytes
+            sent_same = tier._publish_one("fp-delta", entry, nbytes, ("t",))
+            assert sent_same < nbytes * 0.01
+            # one of two columns changes: ~half the bytes ship
+            entry2, _ = self._entry(seed=1)
+            sent_half = tier._publish_one("fp-delta", entry2, nbytes, ("t",))
+            assert nbytes * 0.4 < sent_half < nbytes * 0.7
+            assert METRICS.counts.get(
+                "coord.shared_cache_delta_published", 0) >= 2
+            # the assembled entry round-trips exactly
+            fetched = client.result_fetch("fp-delta")
+            assert fetched is not None
+            np.testing.assert_array_equal(
+                fetched[0].columns[1], entry2.columns[1]
+            )
+            np.testing.assert_array_equal(
+                fetched[0].columns[0], entry2.columns[0]
+            )
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_delta_falls_back_to_full_when_service_lost_the_base(self):
+        server, client = self._tcp_tier()
+        tier = SharedResultTier(client)
+        try:
+            entry, nbytes = self._entry()
+            tier._publish_one("fp-fb", entry, nbytes, ("t",))
+            client.invalidate("t")  # service dropped the entry
+            assert client.result_fetch("fp-fb") is None
+            misses = METRICS.counts.get("cluster.result_delta_misses", 0)
+            sent = tier._publish_one("fp-fb", entry, nbytes, ("t",))
+            assert sent > nbytes  # need_full -> full snapshot shipped
+            assert METRICS.counts.get(
+                "cluster.result_delta_misses", 0) == misses + 1
+            assert client.result_fetch("fp-fb") is not None
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_in_process_delta_replicates_to_standby(self):
+        a, b, _ = _pair()
+        client = LocalClusterClient([a, b])
+        tier = SharedResultTier(client)
+        entry, nbytes = self._entry()
+        tier._publish_one("fp-repl", entry, nbytes, ("t",))
+        entry2, _ = self._entry(seed=3)
+        tier._publish_one("fp-repl", entry2, nbytes, ("t",))
+        b.replicate_once()
+        stored = b.state.result_get("fp-repl")
+        assert stored is not None
+        np.testing.assert_array_equal(
+            stored["snapshot"]["columns"][1], entry2.columns[1]
+        )
+
+
+class TestWatchChurnChaos:
+    def test_watch_parked_across_promotion_under_seeded_faults(self, tmp_path):
+        """Satellite: a watch parked across a SIGKILL election wakes on
+        the promoted node with the correct term/epoch and neither
+        duplicates nor skips events — with chaos riding the election
+        and replication paths."""
+        import signal as _signal  # noqa: F401 — documents the smoke's TCP twin
+
+        servers = []
+        addrs = []
+        try:
+            # 3-replica TCP set in-process: a primary + 2 ranked standbys
+            from datafusion_tpu.cluster.service import serve as serve_cluster
+
+            pri = serve_cluster("127.0.0.1:0", write_quorum=2)
+            threading.Thread(target=pri.serve_forever, daemon=True).start()
+            servers.append(pri)
+            pri_addr = "%s:%d" % pri.server_address[:2]
+            addrs.append(pri_addr)
+            for rank in (0, 1):
+                stb = serve_cluster(
+                    "127.0.0.1:0", standby_of=pri_addr, write_quorum=2,
+                    rank=rank, election_timeout_s=0.5,
+                )
+                threading.Thread(target=stb.serve_forever,
+                                 daemon=True).start()
+                servers.append(stb)
+                addrs.append("%s:%d" % stb.server_address[:2])
+            for srv in servers:
+                srv.cluster_node.peers = list(addrs)
+            writer = connect(",".join(addrs))
+            watcher = connect(",".join(addrs))
+
+            # acked pre-kill state + one consumed event
+            g = writer.lease_grant(30.0)
+            writer.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+            writer.invalidate("seen")
+            since = writer.membership()["rev"]
+
+            got: dict = {}
+
+            def park():
+                deadline = time.monotonic() + 15.0
+                while time.monotonic() < deadline:
+                    try:
+                        out = watcher.watch(since, timeout_s=3.0)
+                    except (ConnectionError, OSError, ExecutionError):
+                        time.sleep(0.05)
+                        continue
+                    if out.get("events"):
+                        got.update(out)
+                        return
+
+            t = threading.Thread(target=park)
+            t.start()
+            time.sleep(0.3)  # let the watch park on the primary
+
+            with faults.scoped({"seed": 23, "rules": [
+                {"site": "cluster.election", "op": "raise",
+                 "exc": "ExecutionError", "count": 1},
+                {"site": "cluster.replicate", "op": "raise",
+                 "exc": "ConnectionResetError", "count": 1},
+            ]}):
+                # SIGKILL the primary (in-process twin: hard server stop;
+                # the OS-process + real-signal version runs in
+                # scripts/scale_smoke.py)
+                pri.shutdown()
+                pri.server_close()
+                # the acked invalidation lands on the PROMOTED node;
+                # the writer sweeps endpoints until the election settles
+                deadline = time.monotonic() + 15.0
+                while True:
+                    try:
+                        writer.invalidate("churn")
+                        break
+                    except (ConnectionError, OSError, ExecutionError):
+                        if time.monotonic() > deadline:
+                            raise
+                        time.sleep(0.1)
+            t.join(timeout=15.0)
+            assert not t.is_alive(), "watch never woke after the election"
+            kinds = [(e["kind"], e.get("table")) for e in got["events"]]
+            # exactly the post-cursor event: no duplicate of "seen", no
+            # skipped "churn"
+            assert kinds == [("invalidate", "churn")], kinds
+            assert got["term"] >= 2  # answered by the promoted node
+            assert "w:9" in got["workers"]  # membership survived intact
+        finally:
+            for srv in servers:
+                try:
+                    srv.shutdown()
+                    srv.server_close()
+                except OSError:
+                    pass
+
+    def test_dead_replica_cooldown_skips_push_while_quorum_holds(self):
+        """One dead replica must not tax every write: after a failed
+        push the link cools down and quorum rounds skip it (the other
+        replica covers quorum); it is dialed again once needed or once
+        the cooldown lapses."""
+        a, b, c, client = _replica_set()
+        client.put("config/x", 1)  # links warm, all healthy
+        b.partitioned = True
+        assert client.put("config/y", 2) > 0  # quorum via a + c
+        blink = next(l for l in a._links.values() if l.target is b)
+        assert blink.last_error_at is not None  # cooling
+        b.partitioned = False
+        assert client.put("config/z", 3) > 0
+        # quorum was met by c, so the cooling link was skipped — b is
+        # still behind and relies on its pull loop
+        assert b.state.get("config/z") is None
+        assert b.replicate_once() != 0
+        assert b.state.get("config/z") == 3
+        # but if the OTHER replica dies, the cooling link IS dialed
+        # (quorum beats the cooldown)
+        c.partitioned = True
+        assert client.put("config/w", 4) > 0  # acks: a + b (re-probed)
+        assert b.state.get("config/w") == 4
+        assert blink.last_error_at is None  # healthy again

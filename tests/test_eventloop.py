@@ -1,0 +1,359 @@
+"""Selector event loop (utils/eventloop.py) + the servers riding it.
+
+Covers the loop primitives (frame round trips, per-connection request
+ordering, timers, socketserver-facade lifecycle), the fleet-scale
+contract — hundreds of PARKED long-poll watches on one cluster service
+node must cost file descriptors, not threads (thread count asserted) —
+the debug HTTP plane's event-loop transport (keep-alive, bearer-token
+auth with constant-time compare, loopback bind default), and the worker
+agent's re-register storm controls (capped full-jitter backoff,
+bounded re-register stagger).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from datafusion_tpu.cluster import connect
+from datafusion_tpu.cluster.service import serve as serve_cluster
+from datafusion_tpu.parallel.wire import (
+    _LEN,
+    encode_frame,
+    frame_nbytes,
+    parse_frame,
+    recv_msg,
+    send_msg,
+)
+from datafusion_tpu.utils.eventloop import (
+    LoopServer,
+    ServerLoop,
+    WireConnection,
+    default_pool_size,
+)
+
+
+def _start(server):
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+class TestServerLoop:
+    def _echo_server(self):
+        loop = ServerLoop(name="test-echo")
+
+        def on_message(conn, msg):
+            if msg.get("type") == "park":
+                # deferred reply from a timer: the parked-request shape
+                loop.call_later(
+                    float(msg.get("delay_s", 0.05)),
+                    lambda: conn.reply(msg, {"type": "parked_reply",
+                                             "n": msg.get("n")}),
+                )
+                return
+            conn.reply(msg, {"type": "echo", "n": msg.get("n")})
+
+        lsock = loop.listen(
+            "127.0.0.1", 0,
+            lambda lp, s, a: WireConnection(lp, s, a, on_message),
+        )
+        return LoopServer(loop, lsock)
+
+    def test_frame_roundtrip_and_ordering(self):
+        server = self._echo_server()
+        _start(server)
+        try:
+            host, port = server.server_address[:2]
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.settimeout(5.0)
+                # several pipelined frames in one connection answer in
+                # order (the threaded handler's sequential contract)
+                for i in range(5):
+                    send_msg(s, {"type": "echo", "n": i})
+                for i in range(5):
+                    out = recv_msg(s)
+                    assert out == {"type": "echo", "n": i}
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_parked_reply_after_timer(self):
+        server = self._echo_server()
+        _start(server)
+        try:
+            host, port = server.server_address[:2]
+            with socket.create_connection((host, port), timeout=5) as s:
+                s.settimeout(5.0)
+                send_msg(s, {"type": "park", "n": 7, "delay_s": 0.05})
+                t0 = time.monotonic()
+                out = recv_msg(s)
+                assert out["type"] == "parked_reply" and out["n"] == 7
+                assert time.monotonic() - t0 >= 0.04
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_shutdown_without_serve_forever(self):
+        # construct-then-close must not hang (fixture teardown shape)
+        server = self._echo_server()
+        server.shutdown()
+        server.server_close()
+
+    def test_large_binary_frame_roundtrip(self):
+        import numpy as np
+
+        from datafusion_tpu.parallel.wire import BinWriter, dec_array, enc_array
+
+        loop = ServerLoop(name="test-bin")
+
+        def on_message(conn, msg):
+            arr = dec_array(msg["payload"])
+            bw = BinWriter()
+            conn.reply(msg, {"type": "sum", "total": int(arr.sum()),
+                             "echo": enc_array(arr, bw)}, bw)
+
+        lsock = loop.listen(
+            "127.0.0.1", 0,
+            lambda lp, s, a: WireConnection(lp, s, a, on_message),
+        )
+        server = LoopServer(loop, lsock)
+        _start(server)
+        try:
+            host, port = server.server_address[:2]
+            a = np.arange(300_000, dtype=np.int64)
+            with socket.create_connection((host, port), timeout=10) as s:
+                s.settimeout(10.0)
+                bw = BinWriter()
+                send_msg(s, {"type": "sum", "wire_version": 2,
+                             "payload": enc_array(a, bw)}, bw, crc=True)
+                out = recv_msg(s)
+            assert out["total"] == int(a.sum())
+            np.testing.assert_array_equal(dec_array(out["echo"]), a)
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_encode_frame_matches_send_msg_bytes(self):
+        chunks = encode_frame({"type": "x", "v": 1})
+        assert frame_nbytes(chunks) == sum(len(bytes(c)) for c in chunks)
+        payload = b"".join(bytes(memoryview(c).cast("B")) for c in chunks)
+        (n,) = _LEN.unpack(payload[:8])
+        assert parse_frame(bytearray(payload[8:8 + n])) == \
+            {"type": "x", "v": 1}
+
+
+class TestParkedWatchScale:
+    N_WATCHES = 220
+
+    def test_hundreds_of_parked_watches_cost_no_threads(self):
+        """The fleet-scale acceptance shape, in miniature: ≥200 parked
+        long-poll watches on ONE service node, thread count bounded by
+        the executor pool (not the connection count), and one event
+        wakes them all."""
+        server = serve_cluster("127.0.0.1:0")
+        _start(server)
+        socks = []
+        try:
+            host, port = server.server_address[:2]
+            client = connect(f"{host}:{port}")
+            rev0 = client.membership()["rev"]
+            before = threading.active_count()
+            for _ in range(self.N_WATCHES):
+                s = socket.create_connection((host, port), timeout=10)
+                s.settimeout(30.0)
+                send_msg(s, {"type": "watch", "since": rev0,
+                             "timeout_s": 25.0})
+                socks.append(s)
+            deadline = time.monotonic() + 10.0
+            while client.status()["parked_watchers"] < self.N_WATCHES:
+                assert time.monotonic() < deadline, (
+                    f"only {client.status()['parked_watchers']} parked"
+                )
+                time.sleep(0.05)
+            grown = threading.active_count() - before
+            # the whole point: parked watches are fd + waiter entries,
+            # not threads.  Allow the executor pool plus a little slack.
+            assert grown <= default_pool_size() + 2, (
+                f"{grown} new threads for {self.N_WATCHES} parked watches"
+            )
+            # one client-visible event wakes every parked watcher
+            client.invalidate("wake_t")
+            woken = 0
+            for s in socks:
+                out = recv_msg(s)
+                assert out["type"] == "watch" and out["fired"] is True
+                kinds = [e["kind"] for e in out["events"]]
+                assert kinds == ["invalidate"]
+                woken += 1
+            assert woken == self.N_WATCHES
+            assert client.status()["parked_watchers"] == 0
+        finally:
+            for s in socks:
+                try:
+                    s.close()
+                except OSError:
+                    pass
+            server.shutdown()
+            server.server_close()
+
+
+class TestDebugHttpPlane:
+    def _server(self, monkeypatch, token=None):
+        from datafusion_tpu.obs.httpd import DebugServer
+
+        if token is None:
+            monkeypatch.delenv("DATAFUSION_TPU_DEBUG_TOKEN", raising=False)
+        else:
+            monkeypatch.setenv("DATAFUSION_TPU_DEBUG_TOKEN", token)
+        return DebugServer(0, "127.0.0.1", label="test:http")
+
+    def _get(self, url, token=None):
+        headers = {"Authorization": f"Bearer {token}"} if token else {}
+        req = urllib.request.Request(url, headers=headers)
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read()
+
+    def test_endpoints_over_eventloop(self, monkeypatch):
+        srv = self._server(monkeypatch)
+        try:
+            code, body = self._get(f"{srv.url}/status")
+            assert code == 200 and json.loads(body)["node"] == "test:http"
+            code, body = self._get(f"{srv.url}/debug/metrics")
+            assert code == 200 and b"# TYPE" in body
+            code, body = self._get(f"{srv.url}/debug/flights")
+            assert code == 200 and "events" in json.loads(body)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(f"{srv.url}/debug/nope")
+            assert ei.value.code == 404
+        finally:
+            srv.close()
+
+    def test_keepalive_serves_sequential_requests(self, monkeypatch):
+        srv = self._server(monkeypatch)
+        try:
+            host, port = srv.server_address[:2]
+            with socket.create_connection((host, port), timeout=10) as s:
+                s.settimeout(10.0)
+                for _ in range(3):
+                    s.sendall(b"GET /healthz HTTP/1.1\r\n"
+                              b"Host: x\r\nConnection: keep-alive\r\n\r\n")
+                    head = b""
+                    while b"\r\n\r\n" not in head:
+                        head += s.recv(4096)
+                    assert b"200 OK" in head
+                    assert b"keep-alive" in head
+                    body_at = head.index(b"\r\n\r\n") + 4
+                    clen = int(
+                        [ln for ln in head.split(b"\r\n")
+                         if ln.lower().startswith(b"content-length")][0]
+                        .split(b":")[1]
+                    )
+                    body = head[body_at:]
+                    while len(body) < clen:
+                        body += s.recv(4096)
+                    assert json.loads(body[:clen])["type"] == "status"
+        finally:
+            srv.close()
+
+    def test_token_guards_debug_paths_not_probes(self, monkeypatch):
+        srv = self._server(monkeypatch, token="sekrit-42")
+        try:
+            # probe surface stays open (liveness checks carry no token)
+            code, _ = self._get(f"{srv.url}/healthz")
+            assert code == 200
+            # /debug/* and /metrics are guarded
+            for path in ("/debug/metrics", "/metrics", "/debug/flights",
+                         "/debug/bundle?seconds=0"):
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    self._get(f"{srv.url}{path}")
+                assert ei.value.code == 401, path
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                self._get(f"{srv.url}/debug/metrics", token="wrong")
+            assert ei.value.code == 401
+            code, body = self._get(f"{srv.url}/debug/metrics",
+                                   token="sekrit-42")
+            assert code == 200 and b"# TYPE" in body
+        finally:
+            srv.close()
+
+    def test_auth_uses_constant_time_compare(self):
+        from datafusion_tpu.obs.httpd import _authorized
+
+        assert _authorized({}, None)
+        assert _authorized({"authorization": "Bearer tok"}, "tok")
+        assert _authorized({"authorization": "bearer tok"}, "tok")
+        assert not _authorized({"authorization": "Bearer nope"}, "tok")
+        assert not _authorized({}, "tok")
+
+    def test_bind_defaults_to_loopback(self, monkeypatch):
+        from datafusion_tpu.obs.httpd import debug_bind_host
+
+        monkeypatch.delenv("DATAFUSION_TPU_DEBUG_BIND", raising=False)
+        assert debug_bind_host("0.0.0.0") == "127.0.0.1"
+        assert debug_bind_host("10.1.2.3") == "127.0.0.1"
+        assert debug_bind_host("127.0.0.1") == "127.0.0.1"
+        assert debug_bind_host(None) == "127.0.0.1"
+        monkeypatch.setenv("DATAFUSION_TPU_DEBUG_BIND", "0.0.0.0")
+        assert debug_bind_host("127.0.0.1") == "0.0.0.0"
+
+
+class TestAgentStormControls:
+    def _agent(self, **kw):
+        from datafusion_tpu.cluster import ClusterState, LocalClusterClient
+        from datafusion_tpu.cluster.agent import WorkerClusterAgent
+
+        class _WS:
+            batch_size = 4
+            fragment_cache = None
+
+        return WorkerClusterAgent(
+            LocalClusterClient(ClusterState()), "w:1", _WS(),
+            ttl_s=6.0, **kw,
+        )
+
+    def test_retry_delay_backs_off_with_jitter_and_cap(self):
+        agent = self._agent()
+        assert agent._retry_delay_s() == agent.refresh_s  # healthy: fixed
+        agent._failures = 1
+        delays = {agent._retry_delay_s() for _ in range(64)}
+        assert all(0.05 <= d <= agent._backoff_cap_s for d in delays)
+        assert len(delays) > 8  # jittered, not a constant
+        agent._failures = 50  # deep failure: capped at one TTL
+        for _ in range(64):
+            assert agent._retry_delay_s() <= agent._backoff_cap_s
+        assert agent._backoff_cap_s == pytest.approx(6.0)
+
+    def test_register_stagger_bounded(self):
+        agent = self._agent()
+        cap = min(agent.reregister_jitter_s, agent.refresh_s)
+        samples = [agent._register_stagger_s() for _ in range(128)]
+        assert all(0.0 <= s <= cap for s in samples)
+        assert len({round(s, 6) for s in samples}) > 16  # spread, not a spike
+
+    def test_poll_once_stays_deterministic_without_stagger(self):
+        # direct drivers (tests, failover chaos) must see an immediate
+        # re-register — the stagger only arms on the background loop
+        agent = self._agent()
+        agent.poll_once()
+        assert agent.lease is not None
+        lease = agent.lease
+        agent.client.lease_revoke(lease)
+        t0 = time.monotonic()
+        agent.poll_once()
+        assert time.monotonic() - t0 < 0.5
+        assert agent.reregistrations == 1 and agent.lease != lease
+
+    def test_failures_reset_on_success(self):
+        agent = self._agent()
+        agent._failures = 3
+        agent.poll_once(stagger=False)
+        # the loop resets on success; emulate its bookkeeping contract
+        agent._failures = 0
+        assert agent._retry_delay_s() == agent.refresh_s
