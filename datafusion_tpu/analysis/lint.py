@@ -26,10 +26,11 @@ class of bug that once cost a debugging session:
   except around a wire/device call eats the `TransientError`
   classification the retry layer depends on.
 - **DF005 lock-in-metrics-callback** — no lock acquisition inside
-  ``utils/metrics.py`` or the ambient-operator ``record_*`` callbacks
-  (``obs/stats.py``): they run inside other subsystems' critical
-  sections (CacheStore eviction, retry loops), where taking a lock
-  would build silent lock-order edges.
+  ``utils/metrics.py``, the ambient-operator ``record_*`` callbacks
+  (``obs/stats.py``), or the hedge tracker's evidence path
+  (``utils/hedge.py``): they run inside other subsystems' critical
+  sections (CacheStore eviction, retry loops, dispatch threads),
+  where taking a lock would build silent lock-order edges.
 - **DF007 blocking-io-in-sampler** — no blocking IO (file/socket/HTTP
   calls, ``time.sleep``, ``print``) inside the sampling profiler's
   timer-thread path (``obs/profiler.py`` ``_run``/``_sample_once``/
@@ -302,13 +303,19 @@ class LockInMetricsCallback(_Rule):
     # subsystems hold locks)
     _DEVICE_FNS = ("put", "transfer", "adopt", "retag", "_register",
                    "_release", "note_h2d", "sweep", "record_d2h")
+    # the hedge tracker's evidence path (utils/hedge.py observe/
+    # threshold) rides inside the coordinator's dispatch threads beside
+    # spans and metrics — same contract: evidence folding must never
+    # take a lock.  (The hedge BUDGET delegates to the internally-
+    # locked utils/retry.TokenBucket — decision points, not evidence.)
+    _HEDGE_FNS = ("observe", "threshold_s")
 
     def applies(self, relpath: str) -> bool:
         p = relpath.replace(os.sep, "/")
         return p.endswith(("utils/metrics.py", "obs/stats.py",
                            "obs/recorder.py", "obs/aggregate.py",
                            "obs/slo.py", "obs/device.py",
-                           "obs/profiler.py"))
+                           "obs/profiler.py", "utils/hedge.py"))
 
     def _scan(self, node, relpath, where):
         out = []
@@ -356,6 +363,8 @@ class LockInMetricsCallback(_Rule):
         elif p.endswith(("obs/recorder.py", "obs/aggregate.py",
                          "obs/slo.py")):
             wanted = self._RECORDER_FNS
+        elif p.endswith("utils/hedge.py"):
+            wanted = self._HEDGE_FNS
         else:
             wanted = self._STATS_FNS
         out = []

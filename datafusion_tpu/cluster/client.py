@@ -18,7 +18,19 @@ advances to the next; a ``not_primary`` rejection follows the replica's
 redirect hint; sweeps are separated by capped full-jitter backoff
 (`utils/retry.backoff_s`, the `TransientError` taxonomy's policy).  A
 primary kill therefore costs one retried round inside the client, not a
-failed lease refresh or membership poll.
+failed lease refresh or membership poll.  The sweep *classifies*
+failures: an instant ``ECONNREFUSED`` is cheap to re-probe, but an
+endpoint that TIMED OUT (blackholed: SYN retries, a response that never
+came) is skipped for the rest of that request's sweep, and per-endpoint
+circuit breakers (`utils/breaker.py`, env-armed) carry the evidence
+across requests.
+
+**Persistent channels** (TCP client): watch long-polls and heartbeat
+lease refreshes each ride ONE kept-alive socket (`_Channel`), dialed
+once and re-pinned only after a failover — the selector-loop service
+parks them threadless, so neither watchers nor heartbeating agents pay
+a connect per interval (``cluster.watch_channel_*`` /
+``cluster.heartbeat_channel_*`` counters).
 
 The fault site ``cluster.request`` fires per request attempt with the
 request type as context — a chaos rule raising
@@ -94,27 +106,86 @@ class _ClientApi:
         """Index of the endpoint matching a redirect hint, if known."""
         return None
 
+    def _endpoint_label(self, idx: int) -> str:
+        """Stable identity of one endpoint (breaker naming)."""
+        return str(idx)
+
+    def _endpoint_breaker(self, idx: int):
+        from datafusion_tpu.utils import breaker as breaker_mod
+
+        return breaker_mod.breaker_for(
+            f"cluster:{self._endpoint_label(idx)}")
+
     def request(self, msg: dict, timeout: Optional[float] = None,
                 bw=None, sent_box: Optional[list] = None) -> dict:
         """One request with the endpoint-failover sweep.  `sent_box`
         (a caller-owned single-slot list) receives the byte count of
         the attempt that succeeded — per call, so concurrent requests
-        on a shared client never read each other's sizes."""
+        on a shared client never read each other's sizes.
+
+        The sweep classifies endpoint failures: an instant fast-fail
+        (`ECONNREFUSED`, reset) just advances, but a *timeout* —
+        connect SYN retries or a response that never came, the
+        blackholed-endpoint signature — marks the endpoint for the
+        rest of THIS request's sweep, so later laps skip it instead of
+        re-paying its full timeout per lap (``cluster.client_timeout_
+        skips``).  Per-endpoint circuit breakers (env-armed,
+        `utils/breaker.py`) carry that memory *across* requests: an
+        open endpoint is skipped while any alternative exists
+        (``cluster.client_breaker_skips``), and transport outcomes
+        feed it — a healthy typed reply (redirect, quorum shortfall)
+        counts as success, the service answered."""
         n = self._endpoint_count()
         max_attempts = n * _FAILOVER_SWEEPS
         attempts = 0
         last: Optional[Exception] = None
+        timed_out: set = set()  # endpoints that ate a timeout this sweep
+        # endpoints a standby NAMED as primary this request: fresher
+        # evidence than any timeout mark or open breaker — without the
+        # override, a recovered-but-open-circuited primary would be
+        # skip/redirect-ping-ponged until the sweep exhausts
+        redirected_to: set = set()
+
+        def avoided(i: int) -> bool:
+            if i in redirected_to:
+                return False
+            if i in timed_out:
+                return True
+            b = self._endpoint_breaker(i)
+            return b is not None and b.denies()
+
         while True:
             idx = self._active % n
+            if avoided(idx) and not all(avoided(i) for i in range(n)):
+                # a known-blackholed / open-circuited endpoint with a
+                # live alternative ahead: skip, don't re-pay
+                METRICS.add("cluster.client_timeout_skips"
+                            if idx in timed_out
+                            else "cluster.client_breaker_skips")
+                self._active = idx + 1
+                attempts += 1
+                if attempts >= max_attempts:
+                    if last is None:  # skipped before any real attempt
+                        raise ConnectionError(
+                            "every cluster endpoint is avoided "
+                            "(open circuits / timeouts)")
+                    raise last
+                continue
+            breaker = self._endpoint_breaker(idx)
             faults.check("cluster.request", op=msg.get("type"), endpoint=idx)
             try:
-                return self._request_endpoint(idx, msg, timeout, bw, sent_box)
+                out = self._request_endpoint(idx, msg, timeout, bw, sent_box)
+                if breaker is not None:
+                    breaker.record(True)
+                return out
             except ClusterQuorumError as e:
                 # the PRIMARY answered but could not gather its write
                 # quorum: rotating endpoints would only bounce off
                 # standbys' redirects — retry in place after a backoff
                 # and give the replica set (or the election) a moment
                 last = e
+                if breaker is not None:
+                    breaker.record(True)  # transport healthy
                 METRICS.add("cluster.client_quorum_retries")
                 attempts += 1
                 if attempts >= max_attempts:
@@ -125,11 +196,29 @@ class _ClientApi:
                 continue
             except ClusterNotPrimaryError as e:
                 last = e
+                if breaker is not None:
+                    breaker.record(True)  # a standby answering is healthy
                 hinted = self._endpoint_index_for(e.primary)
                 self._active = hinted if hinted is not None else idx + 1
+                if hinted is not None:
+                    # a standby naming THIS endpoint as primary is
+                    # fresher evidence than one old timeout on it (or
+                    # its open breaker): a transiently-stalled primary
+                    # must be retried, not skipped until exhaustion
+                    timed_out.discard(hinted % n)
+                    redirected_to.add(hinted % n)
                 METRICS.add("cluster.client_redirects")
             except (ConnectionError, OSError) as e:
                 last = e
+                if breaker is not None:
+                    breaker.record(False)
+                if isinstance(e, TimeoutError):
+                    # connect SYN retries or a response that never came:
+                    # the blackholed signature — remember it this sweep
+                    # (and void any older redirect naming it: evidence
+                    # freshness goes both ways)
+                    timed_out.add(idx)
+                    redirected_to.discard(idx)
                 self._active = idx + 1
                 METRICS.add("cluster.client_failovers")
             attempts += 1
@@ -150,8 +239,9 @@ class _ClientApi:
     def lease_grant(self, ttl_s: float) -> dict:
         return self.request({"type": "lease_grant", "ttl_s": ttl_s})
 
-    def lease_refresh(self, lease: str, since: Optional[int] = None,
-                      telemetry: Optional[dict] = None) -> dict:
+    @staticmethod
+    def _lease_refresh_msg(lease: str, since: Optional[int],
+                           telemetry: Optional[dict]) -> dict:
         msg: dict = {"type": "lease_refresh", "lease": lease}
         if since is not None:
             msg["since"] = since
@@ -159,7 +249,11 @@ class _ClientApi:
             # worker node snapshot piggybacked on the heartbeat
             # (obs/aggregate.py; served back via `telemetry()`)
             msg["telemetry"] = telemetry
-        return self.request(msg)
+        return msg
+
+    def lease_refresh(self, lease: str, since: Optional[int] = None,
+                      telemetry: Optional[dict] = None) -> dict:
+        return self.request(self._lease_refresh_msg(lease, since, telemetry))
 
     def lease_revoke(self, lease: str) -> bool:
         return bool(self.request({"type": "lease_revoke", "lease": lease}).get("found"))
@@ -317,6 +411,9 @@ class LocalClusterClient(_ClientApi):
     def _endpoint_count(self) -> int:
         return len(self.nodes)
 
+    def _endpoint_label(self, idx: int) -> str:
+        return self.nodes[idx].addr or f"node{idx}"
+
     def _endpoint_index_for(self, addr) -> Optional[int]:
         if addr is None:
             return None
@@ -334,6 +431,21 @@ class LocalClusterClient(_ClientApi):
                 f"cluster node {node.addr or idx} is partitioned (injected)"
             )
         return _raise_error_reply(node.handle_request(msg))
+
+
+class _Channel:
+    """One kept-alive socket for a repeating request pattern (watch
+    long-polls, heartbeat lease refreshes): requests ride the pinned
+    socket until it dies, then fall back to the failover sweep and
+    re-pin on whatever endpoint the sweep settled on.  Connects and
+    drops count as ``cluster.<name>_channel_connects/_drops``."""
+
+    __slots__ = ("name", "sock", "lock")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.sock: Optional[socket.socket] = None
+        self.lock = threading.Lock()
 
 
 class ClusterClient(_ClientApi):
@@ -358,36 +470,41 @@ class ClusterClient(_ClientApi):
         self.endpoints = endpoints
         self.request_timeout = request_timeout
         self._active = 0
-        # persistent watch channel: long-poll watches re-arm on ONE
-        # kept-alive socket (the selector service parks it threadless),
-        # so a watcher costs the fleet a connect per failover, not a
-        # connect per poll interval
-        self._watch_sock: Optional[socket.socket] = None
-        self._watch_lock = threading.Lock()
-        self._watch_closed = False
+        # persistent channels: long-poll watches AND heartbeat lease
+        # refreshes each re-arm on ONE kept-alive socket (the selector
+        # service parks/serves it threadless), so a watcher or a
+        # heartbeating agent costs the fleet a connect per failover,
+        # not a connect per poll/refresh interval
+        self._channels = {"watch": _Channel("watch"),
+                          "heartbeat": _Channel("heartbeat")}
+        self._closed = False
 
     def __repr__(self):
         return f"ClusterClient({self.address})"
 
     def close(self) -> None:
-        """Deliberately does NOT take the watch lock: a watcher thread
-        may be parked in a long poll (or mid-failover-sweep) holding
-        it, and close must not wait that out.  Closing the socket out
-        from under the parked recv surfaces as OSError in the watcher,
-        which drops the channel; the closed flag stops it re-pinning."""
-        self._watch_closed = True
-        self._drop_watch_sock()
+        """Deliberately does NOT take the channel locks: a watcher
+        thread may be parked in a long poll (or mid-failover-sweep)
+        holding one, and close must not wait that out.  Closing the
+        socket out from under the parked recv surfaces as OSError in
+        the watcher, which drops the channel; the closed flag stops it
+        re-pinning."""
+        self._closed = True
+        for ch in self._channels.values():
+            self._drop_channel(ch)
 
-    def _drop_watch_sock(self) -> None:
-        sock, self._watch_sock = self._watch_sock, None
+    @staticmethod
+    def _drop_channel(ch: _Channel) -> None:
+        sock, ch.sock = ch.sock, None
         if sock is not None:
             try:
                 sock.close()
             except OSError:
                 pass
 
-    def _watch_channel_request(self, msg: dict, timeout_s: float) -> dict:
-        # watch lock held; raises on any transport/reply problem — the
+    def _channel_send(self, ch: _Channel, msg: dict,
+                      reply_timeout: Optional[float]) -> dict:
+        # channel lock held; raises on any transport/reply problem — the
         # caller drops the channel and falls back to the failover sweep
         from datafusion_tpu.parallel.wire import (
             CRC_ENABLED,
@@ -398,40 +515,59 @@ class ClusterClient(_ClientApi):
 
         if CRC_ENABLED and "wire_version" not in msg:
             msg = {**msg, "wire_version": WIRE_VERSION}
-        s = self._watch_sock
-        # widened past the park interval: the park itself must never
-        # read as a dead service
-        s.settimeout(timeout_s + 10.0)
+        s = ch.sock
+        s.settimeout(reply_timeout)
         send_msg(s, msg, crc=CRC_ENABLED)
         out = recv_msg(s)
         if out is None:
-            raise ConnectionError("cluster service closed the watch channel")
+            raise ConnectionError(
+                f"cluster service closed the {ch.name} channel")
         return _raise_error_reply(out)
 
-    def watch(self, since: int, timeout_s: float = 10.0) -> dict:
-        msg = {"type": "watch", "since": since, "timeout_s": timeout_s}
-        with self._watch_lock:
-            if self._watch_sock is not None:
+    def _channel_request(self, name: str, msg: dict,
+                         reply_timeout: Optional[float]) -> dict:
+        """One request over the named persistent channel, falling back
+        to the failover sweep (which follows ``not_primary`` redirects)
+        and re-pinning a fresh socket on the surviving endpoint."""
+        ch = self._channels[name]
+        with ch.lock:
+            if ch.sock is not None:
                 try:
-                    return self._watch_channel_request(dict(msg), timeout_s)
+                    return self._channel_send(ch, dict(msg), reply_timeout)
                 except (ConnectionError, OSError, ExecutionError):
                     # channel died (failover, idle reset): sweep below
-                    self._drop_watch_sock()
-                    METRICS.add("cluster.watch_channel_drops")
-            # failover sweep (follows not_primary redirects), then pin
-            # a fresh channel on whatever endpoint the sweep settled on
-            out = self.request(msg, timeout=timeout_s + 10.0)
-            if self._watch_closed:
+                    self._drop_channel(ch)
+                    METRICS.add(f"cluster.{name}_channel_drops")
+            out = self.request(msg, timeout=reply_timeout)
+            if self._closed:
                 return out  # closed mid-sweep: don't re-pin a channel
             try:
-                self._watch_sock = socket.create_connection(
+                ch.sock = socket.create_connection(
                     self.endpoints[self._active % len(self.endpoints)],
                     timeout=5.0,
                 )
-                METRICS.add("cluster.watch_channel_connects")
+                METRICS.add(f"cluster.{name}_channel_connects")
             except OSError:
-                self._watch_sock = None
+                ch.sock = None
             return out
+
+    def watch(self, since: int, timeout_s: float = 10.0) -> dict:
+        msg = {"type": "watch", "since": since, "timeout_s": timeout_s}
+        # reply timeout widened past the park interval: the park itself
+        # must never read as a dead service
+        return self._channel_request("watch", msg, timeout_s + 10.0)
+
+    def lease_refresh(self, lease: str, since: Optional[int] = None,
+                      telemetry: Optional[dict] = None) -> dict:
+        """Heartbeats ride the persistent channel: an agent refreshes
+        every TTL/3 forever, and a fleet of workers each dialing a
+        fresh TCP connection per refresh taxes the service's accept
+        loop exactly when it is busiest (the ROADMAP item 5 follow-on
+        the watch channel already fixed for watchers)."""
+        return self._channel_request(
+            "heartbeat", self._lease_refresh_msg(lease, since, telemetry),
+            self.request_timeout,
+        )
 
     @property
     def host(self) -> str:
@@ -447,6 +583,10 @@ class ClusterClient(_ClientApi):
 
     def _endpoint_count(self) -> int:
         return len(self.endpoints)
+
+    def _endpoint_label(self, idx: int) -> str:
+        h, p = self.endpoints[idx]
+        return f"{h}:{p}"
 
     def _endpoint_index_for(self, addr) -> Optional[int]:
         if not isinstance(addr, str) or ":" not in addr:

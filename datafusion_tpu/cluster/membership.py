@@ -27,12 +27,19 @@ a fleet scales out and shrinks with zero coordinator intervention.
 A refresh that cannot reach the service keeps the last view (stale
 liveness beats no liveness) and the staleness is observable: the
 ``cluster.watch_lag_s`` gauge is the age of the last successful
-refresh.  The fault site ``cluster.watch`` makes stale-view handling
-testable on demand.
+refresh, and once that age outruns the **grace window**
+(``DATAFUSION_TPU_STALE_VIEW_GRACE_S``, default 15s) the view flips
+an explicit degraded-mode flag — the ``cluster.view_stale`` gauge
+goes to 1, ``coord.membership_went_stale`` counts the transition, and
+a ``cluster.view_stale`` flight event marks the moment — so "the
+coordinator is serving worker liveness off a last-good view" is an
+alarmable state, not a silent one.  The fault site ``cluster.watch``
+makes stale-view handling testable on demand.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, Optional
@@ -58,6 +65,11 @@ class MembershipView:
         self.refresh_errors = 0
         self.rev_regressions = 0
         self._callbacks: list[Callable[["MembershipView"], None]] = []
+        # degraded-mode grace window: a view older than this is STALE
+        # (served, tolerated, but gauge-flagged — see module doc)
+        env = os.environ.get("DATAFUSION_TPU_STALE_VIEW_GRACE_S", "")
+        self.stale_grace_s = float(env) if env else 15.0
+        self._stale_flagged = False
 
     def subscribe(self, fn: Callable[["MembershipView"], None]) -> None:
         """Call `fn(view)` after every refresh/watch that observed an
@@ -87,6 +99,7 @@ class MembershipView:
             self.term = out.get("term", self.term)
             self.workers = out.get("workers", {})
             self._last_refresh = time.monotonic()
+            self._stale_flagged = False  # fresh view: degraded mode over
         if changed:
             for fn in self._callbacks:
                 try:
@@ -148,9 +161,38 @@ class MembershipView:
                 return None
             return time.monotonic() - self._last_refresh
 
+    def stale(self) -> bool:
+        """The degraded-mode flag: every refresh inside the grace
+        window failed, so worker liveness is being served off a
+        last-good view.  A view that never refreshed is *starting*,
+        not degraded.  The False→True transition counts once
+        (``coord.membership_went_stale``) and emits a flight event —
+        the worked evidence of a cluster outage the coordinator rode
+        out.  Check-and-flip runs under the view lock: concurrent
+        scrapes must not double-count the transition, and a racing
+        refresh must not have its reset overwritten (which would
+        silence the NEXT outage's transition entirely)."""
+        with self._lock:
+            if self._last_refresh is None:
+                return False
+            lag = time.monotonic() - self._last_refresh
+            if lag <= self.stale_grace_s:
+                return False
+            transition = not self._stale_flagged
+            self._stale_flagged = True
+            epoch = self.epoch
+        if transition:
+            METRICS.add("coord.membership_went_stale")
+            from datafusion_tpu.obs.recorder import record as flight_record
+
+            flight_record("cluster.view_stale",
+                          lag_s=round(lag, 3), epoch=epoch)
+        return True
+
     def gauges(self) -> dict:
         """Prometheus gauges for `prometheus_text(extra_gauges=...)`."""
         lag = self.watch_lag_s
+        stale = self.stale()
         with self._lock:
             return {
                 "cluster.epoch": self.epoch,
@@ -159,6 +201,7 @@ class MembershipView:
                 "cluster.watch_lag_s": round(lag, 3) if lag is not None else -1,
                 "cluster.watch_errors": self.refresh_errors,
                 "cluster.rev_regressions": self.rev_regressions,
+                "cluster.view_stale": int(stale),
             }
 
     def __repr__(self):

@@ -1212,14 +1212,30 @@ class ClusterNode:
     def _push_to(self, link: _ReplicaLink, needed_rev: int) -> bool:
         """One synchronous push round against one replica; True when it
         acked at least `needed_rev`.  Raises on an unreachable replica
-        (the quorum commit counts, never retries inline)."""
+        (the quorum commit counts, never retries inline).
+
+        **Batching under write load**: concurrent commits serialize on
+        the link lock, and a push payload is built from the CURRENT
+        log tail — so the round in flight while N more mutations apply
+        ships THEIR events too.  A commit that acquires the lock and
+        finds its revision already acked piggybacked on that round and
+        skips its own (``cluster.replicate_push_piggybacked``): an
+        invalidation storm pays one round trip per *batch* of
+        mutations, not one per mutation.  Actual round trips count as
+        ``cluster.replicate_push_rounds``."""
         from datafusion_tpu.parallel.wire import BinWriter
 
         with link.lock:
+            if link.acked_rev >= needed_rev:
+                # an overlapping commit's push (payload built after our
+                # events applied) already shipped and acked our tail
+                METRICS.add("cluster.replicate_push_piggybacked")
+                return True
             faults.check("cluster.replicate", addr=self.addr,
                          peer=link.name, push=True)
             tcp = isinstance(link.target, str)
             bw = BinWriter() if tcp else None
+            METRICS.add("cluster.replicate_push_rounds")
             resp = link.request_once(
                 self._push_payload(link.acked_rev, bw), bw
             )
@@ -1228,6 +1244,7 @@ class ClusterNode:
                 # (it lagged past the retained window): resync it with
                 # one full snapshot, inline
                 bw = BinWriter() if tcp else None
+                METRICS.add("cluster.replicate_push_rounds")
                 resp = link.request_once(
                     self._push_payload(link.acked_rev, bw,
                                        force_snapshot=True), bw,

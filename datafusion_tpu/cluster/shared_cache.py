@@ -160,11 +160,20 @@ class SharedResultTier:
     _PUBLISHED_KEYS_MAX = 512
 
     def __init__(self, client, queue_depth: int = 64):
+        from datafusion_tpu.utils import breaker as breaker_mod
+
         self.client = client
         self._q: "queue.Queue" = queue.Queue(maxsize=queue_depth)
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._lock = lockcheck.make_lock("cluster.shared_tier")
+        # per-target circuit breaker (None when breakers are off): an
+        # open circuit means DEGRADED LOCAL-ONLY caching — loads skip
+        # the round trip, publications drop fast — instead of every
+        # query's miss path paying a dead service's timeout.  Recovery
+        # is the breaker's half-open probe: the first load/publish
+        # after the cool-down tests the service and re-closes
+        self._breaker = breaker_mod.breaker_for("shared_cache")
         # key -> column digests of this publisher's last publication;
         # armed, a republish ships a DELTA (changed columns only, with
         # a full-snapshot fallback when the service disagrees).
@@ -173,15 +182,30 @@ class SharedResultTier:
 
     # -- read-through --
     def load(self, key: str):
+        b = self._breaker
+        if b is not None and not b.allow():
+            # open circuit: serve local-only rather than queue on a
+            # dead/sick service (the cache ABOVE this tier still works)
+            METRICS.add("coord.shared_cache_fast_fails")
+            return None
         try:
             with obs_trace.span("cluster.shared_cache", op="get"):
                 fetched = self.client.result_fetch(key)
         except (ConnectionError, OSError, ExecutionError):
+            if b is not None:
+                b.record(False)
             METRICS.add("coord.shared_cache_errors")
             return None
         except (KeyError, TypeError, ValueError):
+            if b is not None:
+                # the service ANSWERED (malformed entry): transport is
+                # healthy — and the reserved half-open probe slot must
+                # be released either way
+                b.record(True)
             METRICS.add("coord.shared_cache_decode_errors")
             return None
+        if b is not None:
+            b.record(True)
         if fetched is None:
             METRICS.add("coord.shared_cache_misses")
             return None
@@ -222,8 +246,18 @@ class SharedResultTier:
             except queue.Empty:
                 continue
             key, value, nbytes, tags = item
+            b = self._breaker
+            if b is not None and not b.allow():
+                # open circuit: silent local-only caching — drop the
+                # publication fast instead of burning the publisher
+                # thread on a dead service's timeout per entry
+                METRICS.add("coord.shared_cache_publish_skipped")
+                self._q.task_done()
+                continue
             try:
                 sent = self._publish_one(key, value, nbytes, tags)
+                if b is not None:
+                    b.record(True)
                 METRICS.add("coord.shared_cache_published")
                 if sent:
                     # actual wire cost of the publication (binary
@@ -231,8 +265,14 @@ class SharedResultTier:
                     # RAW-segment path
                     METRICS.add("coord.shared_cache_publish_bytes", int(sent))
             except (ConnectionError, OSError, ExecutionError):
+                if b is not None:
+                    b.record(False)
                 METRICS.add("coord.shared_cache_errors")
             except Exception:  # noqa: BLE001 — the publisher must outlive bad entries
+                if b is not None:
+                    # a bad ENTRY, not a bad service: release the
+                    # reserved probe slot as transport-healthy
+                    b.record(True)
                 METRICS.add("coord.shared_cache_errors")
             finally:
                 self._q.task_done()

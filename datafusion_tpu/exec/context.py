@@ -656,8 +656,12 @@ class ExecutionContext:
         """Engine counters/timings in Prometheus text exposition format
         (obs/export.py; `METRICS` is the single counter backend), plus
         this process's histogram quantiles (query latency, per-table
-        `scan.<t>.latency`/`scan.<t>.bytes`) as gauges."""
+        `scan.<t>.latency`/`scan.<t>.bytes`) and circuit-breaker state
+        gauges (utils/breaker.py; empty when breakers are off)."""
         from datafusion_tpu.obs.aggregate import histogram_gauges
         from datafusion_tpu.obs.export import prometheus_text
+        from datafusion_tpu.utils import breaker as breaker_mod
 
-        return prometheus_text(METRICS, extra_gauges=histogram_gauges())
+        gauges = histogram_gauges()
+        gauges.update(breaker_mod.gauges())
+        return prometheus_text(METRICS, extra_gauges=gauges)

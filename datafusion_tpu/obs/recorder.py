@@ -86,7 +86,11 @@ _DUMP_INTERVAL_S = float(
 # lock-free emit path).
 _ring: tuple[list, int] = ([None] * _CAP, _CAP)
 _cursor = itertools.count()
-_last_auto_dump = 0.0  # time.monotonic of the last throttled auto dump
+# time.monotonic of the last auto dump; None = never.  NOT 0.0: the
+# monotonic clock is system uptime on Linux, so on a freshly-booted
+# host (or container) `now - 0.0` is small and a long dump interval
+# would throttle the very FIRST capture of the process's life
+_last_auto_dump: Optional[float] = None
 
 
 def enabled() -> bool:
@@ -123,7 +127,7 @@ def configure(enabled: Optional[bool] = None,
         _DIR = directory
     if dump_interval_s is not None:
         _DUMP_INTERVAL_S = float(dump_interval_s)
-        _last_auto_dump = 0.0
+        _last_auto_dump = None
 
 
 def clear() -> None:
@@ -239,7 +243,8 @@ def auto_capture(reason: str, extra_fn: Optional[Callable[[], dict]] = None,
     if not _ENABLED:
         return None
     now = time.monotonic()
-    if _DUMP_INTERVAL_S > 0 and now - _last_auto_dump < _DUMP_INTERVAL_S:
+    if _DUMP_INTERVAL_S > 0 and _last_auto_dump is not None \
+            and now - _last_auto_dump < _DUMP_INTERVAL_S:
         from datafusion_tpu.utils.metrics import METRICS
 
         METRICS.add("flight.dumps_throttled")

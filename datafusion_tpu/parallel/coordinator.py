@@ -383,7 +383,8 @@ _DISPATCH_PROBE_ROUNDS = 2
 
 def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
               request_type: str,
-              deadline: Optional[Deadline] = None
+              deadline: Optional[Deadline] = None,
+              hedge=None, local_exec=None,
               ) -> list[tuple[PlanFragment, dict]]:
     """Send the fragments to the workers concurrently (round-robin over
     live workers; one thread per in-flight fragment, so N workers
@@ -397,23 +398,239 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
     query even with the background heartbeat disabled.  `deadline`
     bounds the whole fragment, including reassignment retries, and
     rides each request as the remaining budget in seconds.
+
+    **Gray-failure resilience** (each default off, each leaving the
+    path above byte-identical when off):
+
+    - `hedge` (a `utils/hedge.HedgeTracker`): a dispatched fragment
+      that outruns its worker's hedge threshold (observed-quantile x
+      factor, floor-clamped) is speculatively re-sent to a different
+      live worker; the first successful response wins, the loser's
+      duplicate is discarded (idempotent ``(query_id, shard)`` ids +
+      merge-side dedup make that safe).  ``coord.hedges_*`` counters
+      and ``hedged``/``hedge_won`` span markers record every decision.
+    - per-target **circuit breakers** (`utils/breaker`, env-armed):
+      worker picks skip targets whose breaker is open (recent evidence
+      says sick) while any alternative exists; request outcomes —
+      including a hedge loser's, reported from its own attempt thread —
+      feed the breakers, a response *timeout* counting as the gray
+      failure it is (without marking the worker dead: slow != dead).
+    - the process **retry budget** (`utils/retry.retry_budget`): each
+      fragment's first dispatch earns credit, each reassignment replay
+      spends it, and an empty bucket fails the fragment instead of
+      joining a correlated retry storm.
+    - `local_exec` (degraded mode, DATAFUSION_TPU_LOCAL_FALLBACK):
+      when every worker is dead AND the synchronous probe rounds find
+      nothing, run the fragment on the coordinator itself rather than
+      failing the query (``coord.local_fallbacks``).
     """
     import itertools
+    import queue as _queue
     from concurrent.futures import ThreadPoolExecutor
+
+    from datafusion_tpu.utils import breaker as breaker_mod
+    from datafusion_tpu.utils.retry import retry_budget
 
     if not workers:
         raise ExecutionError("no workers configured")
     rr = itertools.count()
+    budget = retry_budget()
     # captured HERE because contextvars don't cross into pool threads:
     # per-fragment dispatch spans parent under the caller's span, and
     # the wire context makes worker-side spans chain under those
     trace_parent = obs_trace.current_span()
     trace_wire = obs_trace.wire_context()
 
+    def _breaker(w):
+        return breaker_mod.breaker_for(f"worker:{w.host}:{w.port}")
+
+    def pick_worker(live):
+        """Round-robin over live workers, skipping targets whose
+        breaker denies (open circuit: fast-fail instead of paying the
+        sick target's timeout) — unless every live worker is denied,
+        where availability beats protection."""
+        for _ in range(len(live)):
+            cand = live[next(rr) % len(live)]
+            b = _breaker(cand)
+            if b is None or b.allow():
+                return cand
+            METRICS.add("coord.breaker_skips")
+        METRICS.add("coord.breaker_bypassed")
+        return live[next(rr) % len(live)]
+
+    def pick_hedge_target(primary):
+        """A different live, breaker-admitted worker for the hedge —
+        None when the primary is the only choice."""
+        live = [w for w in workers if w.alive and w is not primary]
+        for _ in range(len(live)):
+            cand = live[next(rr) % len(live)]
+            b = _breaker(cand)
+            if b is None or b.allow():
+                return cand
+        return None
+
+    def hedged_request(primary, frag, msg, timeout, sp):
+        """Dispatch with speculative re-dispatch (see the function
+        doc).  Each attempt runs on its own daemon thread and does its
+        OWN outcome bookkeeping (breaker record, latency observation,
+        mark-down on connection failure) before reporting — so an
+        abandoned loser still delivers its evidence when it eventually
+        finishes, minutes after the winner returned."""
+        results: _queue.Queue = _queue.Queue()
+
+        def attempt(worker, a_msg, hedged, a_sp, a_timeout):
+            t0 = time.perf_counter()
+            r, err = None, None
+            try:
+                try:
+                    r = worker.request(a_msg, timeout=a_timeout)
+                except Exception as e:  # noqa: BLE001 — ferried to the chooser below
+                    err = e
+                b = _breaker(worker)
+                if err is None:
+                    if b is not None:
+                        b.record(True)
+                    hedge.observe(f"{worker.host}:{worker.port}",
+                                  time.perf_counter() - t0)
+                elif isinstance(err, RequestTimeoutError):
+                    # alive-but-slow: the gray-failure evidence breakers
+                    # exist for — but NOT a mark_down (slow != dead)
+                    if b is not None:
+                        b.record(False)
+                elif isinstance(err, (ConnectionError, OSError)):
+                    if b is not None:
+                        b.record(False)
+                    worker.mark_down()
+                elif b is not None:
+                    # answered-with-error (bad plan, execution failure):
+                    # transport-healthy; also releases the probe slot
+                    b.record(True)
+                if a_sp is not None:
+                    if err is not None:
+                        a_sp.attrs["failed"] = type(err).__name__
+                    obs_trace.finish_span(a_sp)
+            finally:
+                results.put((worker, hedged, r, err))
+
+        hedge.observe_dispatch()
+        threading.Thread(
+            target=attempt, args=(primary, msg, False, None, timeout),
+            name="df-tpu-dispatch", daemon=True,
+        ).start()
+        inflight = 1
+        launched = False
+
+        def launch_hedge(after_s):
+            nonlocal inflight, launched
+            # budget BEFORE target: pick_hedge_target's allow() reserves
+            # a half-open probe slot on the chosen worker, and a denied
+            # budget after that reservation would leak the slot (no
+            # request ever pairs a record() with it) — permanently
+            # exiling a recovering worker
+            if not hedge.try_hedge():
+                METRICS.add("coord.hedges_suppressed")
+                return
+            # deadline BEFORE target, for the same reason as budget:
+            # any return after pick_hedge_target's allow() reservation
+            # that never dispatches would leak the probe slot.  The
+            # hedge also gets the budget REMAINING NOW, not the stale
+            # value computed at primary-dispatch time — a hedged
+            # fragment must not run up to ~2x the query deadline
+            h_timeout = timeout
+            if deadline is not None:
+                remaining = deadline.remaining()
+                if remaining <= 0.001:
+                    hedge.refund()  # no budget left to hedge inside
+                    METRICS.add("coord.hedges_suppressed")
+                    return
+                h_timeout = remaining
+            alt = pick_hedge_target(primary)
+            if alt is None:
+                hedge.refund()  # approved but nobody to send it to
+                METRICS.add("coord.hedges_suppressed")
+                return
+            if deadline is not None and alt.request_timeout is not None:
+                h_timeout = min(h_timeout, alt.request_timeout)
+            # `launched` only flips once an attempt REALLY starts: a
+            # suppressed threshold-time hedge leaves the timeout-time
+            # retry armed (tokens may have accrued, a breaker cooled)
+            launched = True
+            h_msg = dict(msg)
+            if deadline is not None:
+                h_msg["deadline_s"] = max(deadline.remaining(), 0.001)
+            h_sp = None
+            if trace_wire is not None:
+                h_sp = obs_trace.begin_span(
+                    "coord.dispatch", parent=trace_parent,
+                    trace_id=trace_wire["trace_id"],
+                    attrs={**frag.span_attrs(), "hedged": True,
+                           "worker": f"{alt.host}:{alt.port}"},
+                )
+                h_msg["trace"] = {**trace_wire,
+                                  "parent_span_id": h_sp.span_id}
+            METRICS.add("coord.hedges_dispatched")
+            flight.record("query.hedge", shard=frag.shard,
+                          slow=f"{primary.host}:{primary.port}",
+                          hedge=f"{alt.host}:{alt.port}",
+                          after_s=round(after_s, 4))
+            if sp is not None:
+                sp.attrs["hedged"] = True
+            threading.Thread(
+                target=attempt, args=(alt, h_msg, True, h_sp, h_timeout),
+                name="df-tpu-hedge", daemon=True,
+            ).start()
+            inflight += 1
+
+        first = None
+        wait_s = hedge.threshold_s(f"{primary.host}:{primary.port}")
+        if deadline is not None:
+            wait_s = min(wait_s, max(deadline.remaining(), 0.001))
+        try:
+            first = results.get(timeout=wait_s)
+        except _queue.Empty:
+            launch_hedge(wait_s)
+        errors = []
+        while True:
+            if first is None:
+                if inflight <= 0:
+                    break
+                first = results.get()
+            worker, hedged, resp, err = first
+            first = None
+            inflight -= 1
+            if err is None:
+                if hedged:
+                    METRICS.add("coord.hedges_won")
+                    flight.record("query.hedge_won", shard=frag.shard,
+                                  worker=f"{worker.host}:{worker.port}")
+                    if sp is not None:
+                        sp.attrs["hedge_won"] = True
+                        sp.attrs["winner"] = f"{worker.host}:{worker.port}"
+                elif inflight:
+                    METRICS.add("coord.hedges_lost")  # primary outran it
+                return resp
+            errors.append((hedged, err))
+            if not hedged and not launched \
+                    and isinstance(err, RequestTimeoutError):
+                # the primary's request TIMEOUT beat the hedge threshold
+                # (a tight per-request timeout, or a threshold inflated
+                # by cold-run history): the timeout IS the straggler
+                # signal — hedge now rather than fail the fragment
+                launch_hedge(wait_s)
+        # every attempt failed: surface the PRIMARY's error — its type
+        # drives the caller's failover-vs-timeout handling, and the
+        # attempt threads already did the per-worker bookkeeping
+        for hedged, err in errors:
+            if not hedged:
+                raise err
+        raise errors[0][1]
+
     def run(item):
         fi, frag = item
         attempts = 0
         probe_rounds = 0
+        if budget is not None:
+            budget.earn()  # a fragment's first dispatch accrues credit
         while True:
             if deadline is not None:
                 deadline.check(f"fragment {fi}/{len(fragments)}")
@@ -432,11 +649,18 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 if probe_rounds <= _DISPATCH_PROBE_ROUNDS:
                     time.sleep(backoff_s(probe_rounds, base=0.05, cap=0.5))
                     continue
+                if local_exec is not None:
+                    # degraded mode: every worker is gone and probing
+                    # found nothing — run the fragment HERE rather than
+                    # fail the query (explicit, counted, flight-marked)
+                    METRICS.add("coord.local_fallbacks")
+                    flight.record("query.local_fallback", shard=frag.shard)
+                    return frag, local_exec(frag, request_type)
                 raise ExecutionError(
                     f"all {len(workers)} workers are down "
                     f"(fragment {fi}/{len(fragments)})"
                 )
-            w = live[next(rr) % len(live)]
+            w = pick_worker(live)
             msg = {"type": request_type, "fragment": frag.to_json_str()}
             timeout = -1
             if deadline is not None:
@@ -457,9 +681,24 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 msg["trace"] = {**trace_wire, "parent_span_id": sp.span_id}
             flight.record("query.dispatch", shard=frag.shard,
                           worker=f"{w.host}:{w.port}", attempt=attempts)
+            # hedging needs a second live worker to re-dispatch to; the
+            # hedged path owns its per-attempt breaker/liveness
+            # bookkeeping — but only once an attempt actually STARTS
+            # (`attempted_by_hedge`): an exception before that (the
+            # coord.request fault site) is handled inline like the
+            # non-hedged path, or the pick's probe reservation leaks
+            hedging = hedge is not None and len(live) > 1
+            attempted_by_hedge = False
             try:
                 faults.check("coord.request", shard=frag.shard)
-                resp = w.request(msg, timeout=timeout)
+                if hedging:
+                    attempted_by_hedge = True
+                    resp = hedged_request(w, frag, msg, timeout, sp)
+                else:
+                    resp = w.request(msg, timeout=timeout)
+                    b = _breaker(w)
+                    if b is not None:
+                        b.record(True)
                 if resp.get("cache_hit"):
                     # the worker served this fragment from its fragment
                     # cache (no partition re-scan) — the flag rides the
@@ -479,7 +718,11 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 # unit — mark the worker dead and replay this fragment
                 # elsewhere.  (A response *timeout* is an ExecutionError,
                 # not a failover: slow != dead.)
-                w.mark_down()
+                if not attempted_by_hedge:
+                    w.mark_down()
+                    b = _breaker(w)
+                    if b is not None:
+                        b.record(False)
                 METRICS.add("coord.fragment_reassigned")
                 flight.record("worker.failover", shard=frag.shard,
                               worker=f"{w.host}:{w.port}",
@@ -490,10 +733,21 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                         f"fragment reassignment exhausted "
                         f"(fragment {fi}: {attempts} attempts)"
                     ) from None
+                if budget is not None and not budget.spend():
+                    METRICS.add("coord.reassign_budget_denied")
+                    raise ExecutionError(
+                        f"fragment {fi} reassignment denied: the retry "
+                        f"budget is exhausted (correlated-failure storm "
+                        f"control; raise DATAFUSION_TPU_RETRY_BUDGET)"
+                    ) from None
             except RequestTimeoutError as e:
                 if sp is not None:
                     sp.attrs["timed_out"] = True
                     obs_trace.finish_span(sp)
+                if not attempted_by_hedge:
+                    b = _breaker(w)
+                    if b is not None:
+                        b.record(False)  # gray failure: slow, not dead
                 # only the socket-timeout error is eligible: a genuine
                 # worker error (bad plan, execution failure) must keep
                 # its message even when the deadline has since lapsed
@@ -502,6 +756,16 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                         f"fragment {fi}/{len(fragments)} exceeded the "
                         f"query deadline"
                     ) from e
+                raise
+            except ExecutionError:
+                # the worker ANSWERED, with an application error (bad
+                # plan, execution failure): transport-healthy evidence
+                # — and the half-open probe slot a reserving pick took
+                # must be released.  The error itself propagates.
+                if not attempted_by_hedge:
+                    b = _breaker(w)
+                    if b is not None:
+                        b.record(True)
                 raise
 
     with ThreadPoolExecutor(max_workers=min(len(fragments) or 1, 32)) as ex:
@@ -567,7 +831,8 @@ class DistributedAggregateRelation(Relation):
 
     def __init__(self, plan, agg, pred, scan, ds: PartitionedDataSource,
                  workers: list[WorkerHandle], functions=None,
-                 query_deadline_s: Optional[float] = None):
+                 query_deadline_s: Optional[float] = None,
+                 hedge=None, local_exec=None):
         # verified once at construction: the plan is immutable, and
         # batches()/re-collects must not re-walk it per iteration
         _check_fragment_plan(plan)
@@ -585,6 +850,8 @@ class DistributedAggregateRelation(Relation):
         self.workers = workers
         self.in_schema = in_schema
         self.query_deadline_s = query_deadline_s
+        self.hedge = hedge
+        self.local_exec = local_exec
 
     def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
         return _collect_worker_flight_dumps(self.workers, trace_id)
@@ -620,7 +887,8 @@ class DistributedAggregateRelation(Relation):
             else Deadline.after(self.query_deadline_s)
         )
         responses = _dispatch(
-            self.workers, self._fragments(), "execute_fragment", deadline
+            self.workers, self._fragments(), "execute_fragment", deadline,
+            hedge=self.hedge, local_exec=self.local_exec,
         )
 
         n_keys = len(t.key_cols)
@@ -730,13 +998,16 @@ class DistributedUnionRelation(Relation):
     not only aggregates)."""
 
     def __init__(self, plan, ds: PartitionedDataSource, workers: list[WorkerHandle],
-                 query_deadline_s: Optional[float] = None):
+                 query_deadline_s: Optional[float] = None,
+                 hedge=None, local_exec=None):
         _check_fragment_plan(plan)
         self.plan = plan
         self.ds = ds
         self.workers = workers
         self._schema = plan.schema
         self.query_deadline_s = query_deadline_s
+        self.hedge = hedge
+        self.local_exec = local_exec
 
     def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
         return _collect_worker_flight_dumps(self.workers, trace_id)
@@ -766,7 +1037,8 @@ class DistributedUnionRelation(Relation):
             if self.query_deadline_s is None
             else Deadline.after(self.query_deadline_s)
         )
-        responses = _dispatch(self.workers, fragments, "execute_plan", deadline)
+        responses = _dispatch(self.workers, fragments, "execute_plan", deadline,
+                              hedge=self.hedge, local_exec=self.local_exec)
         dicts: list[Optional[StringDictionary]] = [
             StringDictionary() if f.data_type == DataType.UTF8 else None
             for f in self._schema.fields
@@ -836,6 +1108,15 @@ class DistributedContext(ExecutionContext):
     every query end to end — dispatch, reassignment retries, and
     worker-side device retries all honor the remaining budget.
 
+    Gray-failure resilience (README "Resilience"; each default off):
+    `hedge` (a `utils/hedge.HedgeTracker`, or env DATAFUSION_TPU_HEDGE)
+    arms hedged fragment dispatch; env DATAFUSION_TPU_BREAKER arms
+    per-target circuit breakers around the worker channels (and the
+    cluster client + shared tier underneath); env
+    DATAFUSION_TPU_RETRY_BUDGET bounds reassignment retries; env
+    DATAFUSION_TPU_LOCAL_FALLBACK serves fragments coordinator-side
+    when every worker is dead.
+
     `cluster` (address string — possibly a comma-separated HA endpoint
     list "h1:p1,h2:p2" — `ClusterState`/`ClusterNode`, or client; or
     env DATAFUSION_TPU_CLUSTER) joins the cluster control plane
@@ -866,6 +1147,7 @@ class DistributedContext(ExecutionContext):
         result_cache=None,
         cluster=None,
         debug_port: Optional[int] = None,
+        hedge=None,
     ):
         import os
 
@@ -939,6 +1221,25 @@ class DistributedContext(ExecutionContext):
             # "0" means off (the documented default), not a 0s budget
             query_deadline_s = (float(env) or None) if env else None
         self.query_deadline_s = query_deadline_s
+        # gray-failure resilience (all default off — see utils/hedge.py
+        # and utils/breaker.py): the hedge tracker rides every
+        # distributed relation this context builds, and the local
+        # fallback worker serves fragments COORDINATOR-side when the
+        # whole fleet is unreachable (degraded mode, not an error)
+        if hedge is None:
+            from datafusion_tpu.utils import hedge as hedge_mod
+
+            hedge = hedge_mod.from_env()
+        self.hedge = hedge
+        self._local_worker = None
+        from datafusion_tpu.utils.retry import _env_bool
+
+        if _env_bool("DATAFUSION_TPU_LOCAL_FALLBACK"):
+            from datafusion_tpu.parallel.worker import WorkerState
+
+            # minted eagerly: dispatch threads share it without a
+            # creation race; idle cost is one fragment-cache store
+            self._local_worker = WorkerState(batch_size=batch_size)
         if heartbeat_interval is None:
             env = os.environ.get("DATAFUSION_TPU_HEARTBEAT_S")
             heartbeat_interval = float(env) if env else None
@@ -956,6 +1257,20 @@ class DistributedContext(ExecutionContext):
     def _parse_addr(addr: str) -> tuple[str, int]:
         host, _, port = addr.rpartition(":")
         return host, int(port)
+
+    def _local_exec(self, frag: PlanFragment, request_type: str) -> dict:
+        """Degraded-mode coordinator-local fragment execution: the same
+        `WorkerState` entry points a remote worker serves, producing
+        the same raw wire payload (inline-encoded arrays, which
+        `dec_array` decodes like any response) — the merge path cannot
+        tell the difference."""
+        if request_type == "execute_fragment":
+            return self._local_worker.execute_fragment(frag.to_json_str())
+        return self._local_worker.execute_plan(frag.to_json_str())
+
+    @property
+    def _local_exec_fn(self):
+        return self._local_exec if self._local_worker is not None else None
 
     def _debug_gauges(self) -> dict:
         """The debug plane's scrape gauges: fleet-aggregated telemetry
@@ -1156,12 +1471,19 @@ class DistributedContext(ExecutionContext):
 
     def metrics_text(self) -> str:
         """Prometheus text with the fleet-aggregated telemetry gauges
-        (and, in cluster mode, the membership gauges) folded in."""
+        (and, in cluster mode, the membership gauges — including the
+        degraded-mode ``cluster.view_stale`` flag), the per-target
+        circuit-breaker states, and the hedge tracker's per-worker
+        EWMAs folded in."""
         from datafusion_tpu.obs.export import prometheus_text
+        from datafusion_tpu.utils import breaker as breaker_mod
 
         gauges = self.fleet_gauges()
         if self.membership is not None:
             gauges.update(self.membership.gauges())
+        gauges.update(breaker_mod.gauges())
+        if self.hedge is not None:
+            gauges.update(self.hedge.gauges())
         return prometheus_text(METRICS, extra_gauges=gauges)
 
     def _execute_plan(self, plan: LogicalPlan) -> Relation:
@@ -1183,6 +1505,7 @@ class DistributedContext(ExecutionContext):
                 plan, agg, pred, scan, ds, self.workers,
                 functions=self._jax_functions(),
                 query_deadline_s=self.query_deadline_s,
+                hedge=self.hedge, local_exec=self._local_exec_fn,
             )
         ds = _match_distributed_pipeline(plan, self.datasources)
         if ds is not None:
@@ -1193,5 +1516,6 @@ class DistributedContext(ExecutionContext):
             return DistributedUnionRelation(
                 plan, ds, self.workers,
                 query_deadline_s=self.query_deadline_s,
+                hedge=self.hedge, local_exec=self._local_exec_fn,
             )
         return super()._execute_plan(plan)

@@ -149,11 +149,15 @@ class WorkerState:
         """Point-in-time gauges for the Prometheus rendering: span
         buffer depth plus the fragment cache's levels (and, in cluster
         mode, the lease age / epoch / events-applied gauges)."""
+        from datafusion_tpu.utils import breaker as breaker_mod
+
         gauges = {"obs.span_buffer_depth": obs_trace.buffered()}
         if self.fragment_cache is not None:
             gauges.update(self.fragment_cache.gauges())
         if self.cluster_agent is not None:
             gauges.update(self.cluster_agent.gauges())
+        # per-target circuit-breaker states (empty when breakers off)
+        gauges.update(breaker_mod.gauges())
         return gauges
 
     def status(self) -> dict:

@@ -34,7 +34,12 @@ Rule fields:
 - ``op``: ``raise`` | ``delay`` | ``corrupt`` | ``kill``.
 - ``exc`` / ``message``: exception to raise (resolved from builtins,
   then `datafusion_tpu.errors`).  Default ``ExecutionError``.
-- ``seconds``: sleep length for ``delay``.
+- ``seconds``: sleep length for ``delay`` — a number, or a
+  ``[lo, hi]`` range drawn per firing from a seeded stream (keyed on
+  plan seed, rule index, site, and the firing ordinal, like the ``p``
+  draws), so *gray failures* — alive-but-slow workers, crawling wire
+  reads — are injectable with run-over-run identical slowdown
+  schedules at the existing wire/fragment/device sites.
 - ``after``: 1-based hit index at which the rule starts firing
   (default 1 = first hit).
 - ``count``: number of firings (default 1; 0 means unlimited).
@@ -101,9 +106,9 @@ def _resolve_exc(name: str):
 
 class _Rule:
     __slots__ = (
-        "site", "op", "exc", "message", "seconds", "after", "count",
-        "p", "role", "where", "offset", "hits", "fired", "rng",
-        "seed", "index", "site_hits",
+        "site", "op", "exc", "message", "seconds", "seconds_hi",
+        "after", "count", "p", "role", "where", "offset", "hits",
+        "fired", "rng", "seed", "index", "site_hits",
     )
 
     def __init__(self, spec: dict, seed: int, index: int):
@@ -114,7 +119,16 @@ class _Rule:
         self.exc = spec.get("exc", "ExecutionError")
         _resolve_exc(self.exc)  # fail at install, not at fire
         self.message = spec.get("message", f"injected fault at {self.site}")
-        self.seconds = float(spec.get("seconds", 0.0))
+        secs = spec.get("seconds", 0.0)
+        if isinstance(secs, (list, tuple)):
+            if len(secs) != 2 or float(secs[0]) > float(secs[1]):
+                raise ValueError(
+                    f"delay 'seconds' range must be [lo, hi]: {secs!r}")
+            self.seconds = float(secs[0])
+            self.seconds_hi = float(secs[1])
+        else:
+            self.seconds = float(secs)
+            self.seconds_hi = None
         self.after = int(spec.get("after", 1))
         self.count = spec.get("count", 1) or 0  # 0 = unlimited
         self.p = spec.get("p")
@@ -140,6 +154,24 @@ class _Rule:
         k = self.site_hits[site] = self.site_hits.get(site, 0) + 1
         draw = random.Random(f"{self.seed}:{self.index}:{site}:{k}").random()
         return draw < self.p
+
+    def delay_s(self, site: str, ordinal: int) -> float:
+        """Sleep length for a firing ``delay`` rule: the fixed
+        ``seconds``, or — for a ``[lo, hi]`` range — a seeded uniform
+        draw keyed on (plan seed, rule index, site, firing ordinal).
+        `ordinal` is the rule's `fired` count CAPTURED under the plan
+        lock at `_due` time (a post-lock read would let concurrent
+        firings share an ordinal), so each firing's draw is unique and
+        the whole schedule is a pure function of the plan — a
+        probabilistic gray-failure soak replays the same slowdowns run
+        over run (thread interleaving can reorder which SITE receives
+        which ordinal, exactly like count-capped p-rules)."""
+        if self.seconds_hi is None:
+            return self.seconds
+        draw = random.Random(
+            f"{self.seed}:{self.index}:{site}:delay:{ordinal}"
+        ).random()
+        return self.seconds + draw * (self.seconds_hi - self.seconds)
 
     def matches(self, site: str, role: str, ctx: dict) -> bool:
         if self.role is not None and self.role != role:
@@ -171,8 +203,12 @@ class FaultPlan:
         ]
         self._lock = lockcheck.make_lock("faults.plan")
 
-    def _due(self, site: str, role: str, ctx: dict) -> Optional[_Rule]:
-        """Advance hit counters; return the rule that fires, if any."""
+    def _due(self, site: str, role: str, ctx: dict
+             ) -> "Optional[tuple[_Rule, int]]":
+        """Advance hit counters; return ``(rule, firing ordinal)`` for
+        the rule that fires, if any.  The ordinal is captured HERE,
+        under the lock — delay-range draws key on it, and a post-lock
+        read of `fired` would let concurrent firings share one."""
         with self._lock:
             for rule in self.rules:
                 if not rule.matches(site, role, ctx):
@@ -185,7 +221,7 @@ class FaultPlan:
                 if rule.p is not None and not rule.p_fires(site):
                     continue
                 rule.fired += 1
-                return rule
+                return rule, rule.fired
         return None
 
     def snapshot(self) -> list[dict]:
@@ -251,10 +287,10 @@ def check(site: str, **ctx: Any) -> None:
     plan = _PLAN
     if plan is None:
         return
-    rule = plan._due(site, _ROLE, ctx)
-    if rule is None:
+    due = plan._due(site, _ROLE, ctx)
+    if due is None:
         return
-    _fire(rule, site)
+    _fire(due[0], site, due[1])
 
 
 def corrupt(site: str, data, **ctx: Any):
@@ -264,11 +300,12 @@ def corrupt(site: str, data, **ctx: Any):
     plan = _PLAN
     if plan is None:
         return data
-    rule = plan._due(site, _ROLE, ctx)
-    if rule is None:
+    due = plan._due(site, _ROLE, ctx)
+    if due is None:
         return data
+    rule, ordinal = due
     if rule.op != "corrupt":
-        _fire(rule, site)
+        _fire(rule, site, ordinal)
         return data
     buf = bytearray(data)
     if buf:
@@ -284,12 +321,12 @@ def corrupt(site: str, data, **ctx: Any):
     return buf
 
 
-def _fire(rule: _Rule, site: str) -> None:
+def _fire(rule: _Rule, site: str, ordinal: int) -> None:
     from datafusion_tpu.utils.metrics import METRICS
 
     METRICS.add(f"faults.fired.{site}")
     if rule.op == "delay":
-        time.sleep(rule.seconds)
+        time.sleep(rule.delay_s(site, ordinal))
         return
     if rule.op == "kill":
         # simulate SIGKILL mid-work: no cleanup, no flushing, the

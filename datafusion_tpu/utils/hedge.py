@@ -1,0 +1,136 @@
+"""Hedged-dispatch policy: when to speculatively re-send a fragment.
+
+Tail latency in a scatter-gather engine is set by the *slowest*
+replica, not the median — one alive-but-slow worker (gray failure:
+heartbeats renew, fragments crawl) stalls every query that routes a
+fragment at it.  Hedging is the standard counter-measure (the
+"tail at scale" defense): when a dispatched fragment has outrun what
+its peers routinely achieve, send a duplicate to a different live
+worker and take whichever valid response lands first.  Duplicates are
+safe by construction here — fragments carry idempotent
+``(query_id, shard)`` ids, workers serve replays from the fragment
+cache, and the coordinator's merge loops drop duplicate responses.
+
+`HedgeTracker` is the coordinator's evidence and throttle:
+
+- **per-worker latency**: an EWMA and a mergeable log2
+  `LatencyHistogram` per worker (the PR 8 histogram machinery), fed by
+  every successful dispatch, plus a fleet-wide histogram;
+- **the hedge threshold**: ``max(floor, quantile(p) * factor)`` from
+  the dispatched worker's own history (what *it* routinely achieves),
+  falling back to the fleet histogram below ``min_samples``, and to
+  the bare floor with no history at all;
+- **a hedge budget**: a `utils/retry.TokenBucket` accruing ``ratio``
+  tokens per primary dispatch and spending one per hedge, so hedges
+  stay a bounded fraction of real traffic — a fleet-wide slowdown
+  (overload, not one straggler) must not double its own load.
+
+The observe/threshold path is deliberately **lock-free** (dict stores
+and GIL-atomic bucket increments): it runs inside the dispatch path
+beside spans and metrics, under the same DF005 contract as the flight
+recorder — `analysis/lint.py` enforces it.
+
+Default **off** (`DATAFUSION_TPU_HEDGE=1` arms it; `from_env()`
+returns None otherwise, and a None policy leaves the dispatch path
+byte-identical).
+
+Tunables (env, read by `from_env`):
+  DATAFUSION_TPU_HEDGE_FACTOR       threshold = quantile * this (3.0)
+  DATAFUSION_TPU_HEDGE_FLOOR_S      threshold floor, seconds (0.25)
+  DATAFUSION_TPU_HEDGE_QUANTILE     history quantile (0.95)
+  DATAFUSION_TPU_HEDGE_MIN_SAMPLES  history required per tier (4)
+  DATAFUSION_TPU_HEDGE_RATIO        hedge tokens per dispatch (0.25)
+  DATAFUSION_TPU_HEDGE_BURST        token-bucket cap (4.0)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from datafusion_tpu.obs.aggregate import LatencyHistogram
+from datafusion_tpu.utils.retry import TokenBucket, _env_bool, _env_float
+
+
+class HedgeTracker:
+    """Per-coordinator hedging evidence + budget (see module doc)."""
+
+    def __init__(self, factor: float = 3.0, floor_s: float = 0.25,
+                 quantile: float = 0.95, min_samples: int = 4,
+                 ratio: float = 0.25, burst: float = 4.0):
+        self.factor = float(factor)
+        self.floor_s = float(floor_s)
+        self.quantile = float(quantile)
+        self.min_samples = int(min_samples)
+        self.ratio = float(ratio)
+        self.burst = max(1.0, float(burst))
+        # per-worker histograms + EWMAs and the fleet-wide histogram.
+        # Written lock-free from dispatch threads (dict store, list
+        # increment); a racing first-observe may drop one sample
+        self._hists: dict[str, LatencyHistogram] = {}
+        self.ewma: dict[str, float] = {}
+        self._fleet = LatencyHistogram()
+        # one initial token: the very first straggler can hedge
+        self._bucket = TokenBucket(self.ratio, self.burst, initial=1.0)
+
+    # -- evidence (lock-free: rides the dispatch path, DF005) --
+    def observe(self, target: str, seconds: float) -> None:
+        """One successful fragment round trip against `target`."""
+        h = self._hists.get(target)
+        if h is None:
+            h = self._hists.setdefault(target, LatencyHistogram())
+        h.observe(seconds)
+        self._fleet.observe(seconds)
+        prev = self.ewma.get(target)
+        self.ewma[target] = seconds if prev is None \
+            else 0.8 * prev + 0.2 * seconds
+
+    def observe_dispatch(self) -> None:
+        """One primary dispatch: accrue hedge credit (ratio tokens)."""
+        self._bucket.earn()
+
+    def threshold_s(self, target: str) -> float:
+        """How long `target`'s in-flight fragment may run before a
+        hedge fires: its own history's quantile x factor, the fleet's
+        below min_samples, the bare floor with no history."""
+        h = self._hists.get(target)
+        if h is None or h.count < self.min_samples:
+            h = self._fleet
+        if h.count < self.min_samples:
+            return self.floor_s
+        q = h.quantile(self.quantile)
+        if q is None:
+            return self.floor_s
+        return max(self.floor_s, q * self.factor)
+
+    def try_hedge(self) -> bool:
+        """Spend one hedge token; False = budget exhausted, don't
+        hedge."""
+        return self._bucket.spend()
+
+    def refund(self) -> None:
+        """Return a spent token (the hedge was approved but never
+        launched — e.g. no alternative worker existed)."""
+        self._bucket.refund()
+
+    # -- introspection --
+    def gauges(self) -> dict:
+        out = {"hedge.tokens": round(self._bucket.tokens, 3)}
+        # .copy(): dispatch threads insert new workers mid-scrape
+        for target, v in sorted(self.ewma.copy().items()):
+            out[f"hedge.ewma_s.{target}"] = round(v, 6)
+        return out
+
+
+def from_env() -> Optional[HedgeTracker]:
+    """A tracker per the env knobs, or None when hedging is off (the
+    default) — a None policy is the byte-identical dispatch path."""
+    if not _env_bool("DATAFUSION_TPU_HEDGE"):
+        return None
+    return HedgeTracker(
+        factor=_env_float("DATAFUSION_TPU_HEDGE_FACTOR", 3.0),
+        floor_s=_env_float("DATAFUSION_TPU_HEDGE_FLOOR_S", 0.25),
+        quantile=_env_float("DATAFUSION_TPU_HEDGE_QUANTILE", 0.95),
+        min_samples=int(_env_float("DATAFUSION_TPU_HEDGE_MIN_SAMPLES", 4)),
+        ratio=_env_float("DATAFUSION_TPU_HEDGE_RATIO", 0.25),
+        burst=_env_float("DATAFUSION_TPU_HEDGE_BURST", 4.0),
+    )

@@ -17,9 +17,29 @@ every client on the same instant); and every sleep is bounded by the
 caller's deadline (`utils.deadline`), so retries can never exceed a
 query's budget.
 
+**Retry budget** (default off): backoff decorrelates a fleet in time,
+but under a *correlated* fault burst (30% of calls failing
+everywhere) every client still retries — total offered load amplifies
+by 1/(1-p) exactly when the system can least afford it.
+`RetryBudget` is a process-global token bucket capping the ratio of
+retries to first attempts: each first attempt accrues ``ratio``
+tokens, each retry spends one, and a spend that finds the bucket
+empty is *denied* — the failure surfaces immediately (and the layer
+above decides: coordinator failover, query error) instead of joining
+a coordinated retry storm.  Throughput degrades smoothly with the
+fault rate rather than collapsing under its own recovery traffic.
+Consumers: `device_call` retries here, and the coordinator's fragment
+reassignment loop (`parallel/coordinator.py`).  Metrics:
+``retry.first_attempts`` / ``retry.budget_spent`` /
+``retry.budget_denied`` — the asserted evidence that retry volume
+stayed inside the configured ratio.
+
 Tunables (env): DATAFUSION_TPU_RETRY_ATTEMPTS (default 4),
 DATAFUSION_TPU_RETRY_BASE_S (default 0.25),
-DATAFUSION_TPU_RETRY_CAP_S (default 5.0).
+DATAFUSION_TPU_RETRY_CAP_S (default 5.0),
+DATAFUSION_TPU_RETRY_BUDGET (retry:first-attempt ratio; unset/0 = no
+budget, byte-identical paths), DATAFUSION_TPU_RETRY_BURST (bucket
+cap, default max(2, 10*ratio)).
 """
 
 from __future__ import annotations
@@ -37,6 +57,16 @@ from datafusion_tpu.utils.metrics import METRICS
 def _env_float(name: str, default: float) -> float:
     v = os.environ.get(name)
     return default if not v else float(v)
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    """One truthy-env idiom for every resilience switch (breakers,
+    hedging, local fallback) — the accepted token set must not drift
+    per call site."""
+    v = os.environ.get(name)
+    if not v:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
 
 
 _ATTEMPTS = int(_env_float("DATAFUSION_TPU_RETRY_ATTEMPTS", 4))
@@ -64,6 +94,109 @@ def backoff_s(attempt: int, base: "float | None" = None,
     return _RNG.uniform(0.0, ceiling)
 
 
+class TokenBucket:
+    """Ratio/burst token bucket, shared by the retry budget and the
+    hedge budget (`utils/hedge.py`).  Internally locked: an unlocked
+    read-modify-write would let concurrent spenders all pass the
+    check on ONE remaining token — over-granting exactly during the
+    correlated failure storm the budget exists to bound (and breaking
+    the CI-asserted retries <= ratio*first+burst invariant).  The
+    critical section is two float ops and never nests another lock, so
+    spend/earn stay cheap enough for retry and dispatch paths."""
+
+    __slots__ = ("ratio", "burst", "_tokens", "_lock")
+
+    def __init__(self, ratio: float, burst: float, initial: float = 1.0):
+        from datafusion_tpu.analysis import lockcheck
+
+        self.ratio = max(0.0, float(ratio))
+        self.burst = float(burst)
+        self._tokens = min(self.burst, float(initial))
+        self._lock = lockcheck.make_lock("utils.token_bucket")
+
+    def earn(self) -> None:
+        """One unit of real traffic: accrue `ratio` tokens (capped)."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + self.ratio)
+
+    def spend(self) -> bool:
+        """Consume one token; False = bucket empty, don't."""
+        with self._lock:
+            if self._tokens < 1.0:
+                return False
+            self._tokens -= 1.0
+            return True
+
+    def refund(self) -> None:
+        """Return a spent token (the spender never acted on it)."""
+        with self._lock:
+            self._tokens = min(self.burst, self._tokens + 1.0)
+
+    @property
+    def tokens(self) -> float:
+        return self._tokens
+
+
+class RetryBudget:
+    """A `TokenBucket` bounding retries to a ratio of first attempts
+    (see module doc), with the metrics the acceptance gates assert."""
+
+    def __init__(self, ratio: float, burst: "float | None" = None):
+        ratio = max(0.0, float(ratio))
+        self._bucket = TokenBucket(
+            ratio,
+            float(burst) if burst is not None else max(2.0, 10.0 * ratio),
+        )
+
+    @property
+    def ratio(self) -> float:
+        return self._bucket.ratio
+
+    @property
+    def burst(self) -> float:
+        return self._bucket.burst
+
+    def earn(self) -> None:
+        """One first attempt: accrue `ratio` tokens (capped)."""
+        self._bucket.earn()
+        METRICS.add("retry.first_attempts")
+
+    def spend(self) -> bool:
+        """One retry wants to happen: True = granted (token consumed),
+        False = denied, fail now instead of amplifying the storm."""
+        if not self._bucket.spend():
+            METRICS.add("retry.budget_denied")
+            return False
+        METRICS.add("retry.budget_spent")
+        return True
+
+    @property
+    def tokens(self) -> float:
+        return self._bucket.tokens
+
+
+def _budget_from_env() -> "RetryBudget | None":
+    ratio = _env_float("DATAFUSION_TPU_RETRY_BUDGET", 0.0)
+    if ratio <= 0:
+        return None
+    burst = os.environ.get("DATAFUSION_TPU_RETRY_BURST")
+    return RetryBudget(ratio, float(burst) if burst else None)
+
+
+_BUDGET = _budget_from_env()
+
+
+def retry_budget() -> "RetryBudget | None":
+    """The process-global budget (None = unbudgeted, the default)."""
+    return _BUDGET
+
+
+def set_retry_budget(budget: "RetryBudget | None") -> None:
+    """Install/clear the process-global budget (tests, embedders)."""
+    global _BUDGET
+    _BUDGET = budget
+
+
 def is_transient(err: Exception) -> bool:
     """Typed transient test (kept as the public name callers know)."""
     return classify_transient(err) is not None
@@ -86,6 +219,9 @@ def device_call(fn, /, *args, _tag=None, **kwargs):
     so that wall is device execution, not async dispatch; elsewhere it
     is dispatch-only and launches stay asynchronous."""
     attempt = 0
+    budget = _BUDGET
+    if budget is not None:
+        budget.earn()
     while True:
         try:
             faults.check("device.call", attempt=attempt)
@@ -131,6 +267,16 @@ def device_call(fn, /, *args, _tag=None, **kwargs):
                 raise
             attempt += 1
             if attempt >= _ATTEMPTS:
+                raise
+            if budget is not None and not budget.spend():
+                # retry denied: under a correlated fault burst the
+                # budget converts would-be retry amplification into
+                # prompt failures the layer above can shed or fail over
+                METRICS.add("device.retry_budget_exhausted")
+                from datafusion_tpu.obs.recorder import record as flight_record
+
+                flight_record("device.retry_denied", attempt=attempt,
+                              error=type(transient).__name__)
                 raise
             delay = backoff_s(attempt)
             deadline = current_deadline()

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Gray-failure smoketest: a SIGSTOP'd worker mid-workload.
+
+A SIGKILL'd worker is the EASY failure — the coordinator sees a
+connection reset and fails over (chaos_smoke covers it).  This smoke
+covers the hard one: a worker that is alive-but-frozen (SIGSTOP — the
+kernel still completes TCP handshakes for its listen backlog, so
+connects succeed and requests simply never answer).  The gate:
+
+1. 3 worker OS processes; a healthy warm-up run establishes the
+   baseline p99 and feeds the hedge tracker's latency history.
+2. SIGSTOP one worker, then run 20 distinct queries.  Every query
+   must complete (zero failures) with p99 <= 3x the healthy p99:
+   hedged dispatch re-sends the frozen worker's fragments to live
+   peers (`coord.hedges_won` > 0, asserted), and the per-target
+   circuit breaker — fed by the frozen worker's response timeouts —
+   opens and routes later picks around it (`breaker.opened` > 0,
+   asserted).
+3. SIGCONT; the revived worker serves again (no permanent exile).
+4. A retry-budget leg: 30% injected transient device faults over 300
+   calls — total retry volume must stay within the configured budget
+   ratio, asserted from the metrics (storm control, not amplification).
+
+Exit non-zero on any gate miss; `scripts/smoketest.sh` and CI run this
+after the unit tests, with a debug bundle uploaded on failure.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# pin before any datafusion/jax import: hermetic CPU run, fast retries,
+# and the resilience layer ARMED (hedging + breakers are default-off)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DATAFUSION_TPU_RETRY_BASE_S", "0.001")
+os.environ["DATAFUSION_TPU_HEDGE"] = "1"
+os.environ["DATAFUSION_TPU_HEDGE_FLOOR_S"] = "0.2"
+os.environ["DATAFUSION_TPU_HEDGE_FACTOR"] = "2.0"
+# hedge off the MEDIAN, not the p95: the short warm-up history carries
+# cold-compile outliers that would push a p95-based threshold past the
+# request timeout and make the first frozen-worker query pay it all
+os.environ["DATAFUSION_TPU_HEDGE_QUANTILE"] = "0.5"
+os.environ["DATAFUSION_TPU_HEDGE_RATIO"] = "0.5"
+os.environ["DATAFUSION_TPU_BREAKER"] = "1"
+os.environ["DATAFUSION_TPU_BREAKER_FAILURES"] = "2"
+os.environ["DATAFUSION_TPU_BREAKER_OPEN_S"] = "60"
+
+
+def _write_partitions(tmpdir: str, n_parts: int = 3, rows_per: int = 600):
+    import numpy as np
+
+    rng = np.random.default_rng(19)
+    regions = ["north", "south", "east", "west"]
+    paths = []
+    for p in range(n_parts):
+        path = os.path.join(tmpdir, f"part{p}.csv")
+        with open(path, "w") as f:
+            f.write("region,v,x\n")
+            for _ in range(rows_per):
+                f.write(f"{regions[rng.integers(0, 4)]},"
+                        f"{rng.integers(-1000, 1000)},"
+                        f"{rng.uniform(-5, 5):.6f}\n")
+        paths.append(path)
+    return paths
+
+
+def _spawn_worker():
+    import subprocess
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "datafusion_tpu.worker",
+         "--bind", "127.0.0.1:0", "--device", "cpu"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+    )
+    line = proc.stdout.readline()
+    assert "listening on" in line, f"worker failed to start: {line!r}"
+    host, port = line.strip().rsplit(" ", 1)[1].rsplit(":", 1)
+    return proc, (host, int(port))
+
+
+def _budget_leg() -> None:
+    """30% transient faults, budgeted retries: volume stays in ratio."""
+    from datafusion_tpu.errors import DeviceTransientError
+    from datafusion_tpu.testing import faults
+    from datafusion_tpu.utils import retry
+    from datafusion_tpu.utils.metrics import METRICS
+
+    ratio = 0.25
+    retry.seed_backoff(7)
+    retry.set_retry_budget(retry.RetryBudget(ratio, burst=1.0))
+    first0 = METRICS.counts.get("retry.first_attempts", 0)
+    spent0 = METRICS.counts.get("retry.budget_spent", 0)
+    failures = 0
+    try:
+        with faults.scoped({"seed": 11, "rules": [
+            {"site": "device.call", "op": "raise",
+             "exc": "DeviceTransientError", "p": 0.3, "count": 0},
+        ]}):
+            for _ in range(300):
+                try:
+                    retry.device_call(lambda: 1)
+                except DeviceTransientError:
+                    failures += 1
+    finally:
+        retry.set_retry_budget(None)
+    first = METRICS.counts.get("retry.first_attempts", 0) - first0
+    spent = METRICS.counts.get("retry.budget_spent", 0) - spent0
+    assert first == 300, first
+    assert spent <= ratio * first + 1.0, (
+        f"retry volume {spent} exceeds the budget "
+        f"({ratio} x {first} + burst)"
+    )
+    print(f"budget leg: 30% faults over {first} calls -> {spent} retries "
+          f"(<= {ratio:.0%} + burst), {failures} fast failures", flush=True)
+
+
+def main() -> int:
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+    from datafusion_tpu.utils.metrics import METRICS
+
+    schema = Schema([
+        Field("region", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+        Field("x", DataType.FLOAT64, True),
+    ])
+
+    procs = []
+    tmpdir = tempfile.mkdtemp(prefix="dftpu_gray_")
+    stopped = None
+    try:
+        paths = _write_partitions(tmpdir)
+
+        def make_pds():
+            return PartitionedDataSource(
+                [CsvDataSource(p, schema, True, 131072) for p in paths])
+
+        def sql(i: int) -> str:
+            # distinct predicates: every query re-executes its
+            # fragments instead of riding the worker fragment caches
+            return (f"SELECT region, COUNT(1), SUM(v), MIN(v), MAX(v) "
+                    f"FROM t WHERE v > {i - 900} GROUP BY region")
+
+        addrs = []
+        for _ in range(3):
+            proc, addr = _spawn_worker()
+            procs.append(proc)
+            addrs.append(addr)
+        print(f"3 workers at {addrs}", flush=True)
+
+        # the per-request timeout is what converts a frozen worker into
+        # breaker evidence (RequestTimeoutError) instead of a 60s hang
+        dctx = DistributedContext(addrs, request_timeout=2.0,
+                                  query_deadline_s=60.0,
+                                  result_cache=False)
+        dctx.register_datasource("t", make_pds())
+        lctx = ExecutionContext(device="cpu")
+        lctx.register_datasource("t", make_pds())
+
+        def run(i: int) -> float:
+            t0 = time.monotonic()
+            got = sorted(collect(dctx.sql(sql(i))).to_rows())
+            wall = time.monotonic() - t0
+            want = sorted(collect(lctx.sql(sql(i))).to_rows())
+            assert got == want, f"query {i} diverges under gray failure"
+            return wall
+
+        healthy = [run(i) for i in range(6)]
+        healthy_p99 = max(healthy)
+        print(f"healthy baseline: p99={healthy_p99:.3f}s "
+              f"(min={min(healthy):.3f}s)", flush=True)
+
+        victim = procs[1]
+        os.kill(victim.pid, signal.SIGSTOP)
+        stopped = victim.pid
+        print(f"SIGSTOP worker pid={victim.pid} ({addrs[1]})", flush=True)
+
+        walls = [run(i) for i in range(6, 26)]  # 20 queries, 0 failures
+        p99 = max(walls)
+        print(f"gray run: 20/20 queries ok, p99={p99:.3f}s "
+              f"(healthy p99 {healthy_p99:.3f}s)", flush=True)
+        assert p99 <= 3.0 * healthy_p99, (
+            f"gray p99 {p99:.3f}s exceeds 3x healthy {healthy_p99:.3f}s"
+        )
+        hedges_won = METRICS.counts.get("coord.hedges_won", 0)
+        opened = METRICS.counts.get("breaker.opened", 0)
+        assert hedges_won > 0, "no hedge ever won against the frozen worker"
+        assert opened > 0, "the frozen worker's breaker never opened"
+        print(f"hedges_won={hedges_won} "
+              f"hedges_dispatched="
+              f"{METRICS.counts.get('coord.hedges_dispatched', 0)} "
+              f"breaker.opened={opened} "
+              f"breaker_skips={METRICS.counts.get('coord.breaker_skips', 0)}",
+              flush=True)
+
+        os.kill(victim.pid, signal.SIGCONT)
+        stopped = None
+        # the revived worker serves again once its breaker half-opens;
+        # here just prove the cluster still answers correctly
+        run(26)
+        print("SIGCONT: revived cluster agrees", flush=True)
+
+        _budget_leg()
+        print("GRAY SMOKETEST PASSED", flush=True)
+        return 0
+    finally:
+        if stopped is not None:
+            try:
+                os.kill(stopped, signal.SIGCONT)
+            except OSError:
+                pass
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                p.kill()
+
+
+if __name__ == "__main__":
+    from datafusion_tpu.obs.httpd import run_with_ci_bundle
+
+    sys.exit(run_with_ci_bundle(main, "gray_smoke_failure"))

@@ -30,6 +30,9 @@ echo "== analysis check (self-lint + plan verifier + lockcheck report) =="
 echo "== chaos smoke (distributed query under a seeded fault plan) =="
 python scripts/chaos_smoke.py
 
+echo "== gray smoke (SIGSTOP'd worker mid-workload: hedged dispatch + breakers + retry budget) =="
+python scripts/gray_smoke.py
+
 echo "== trace smoke (EXPLAIN ANALYZE + merged worker trace + flight-recorder artifact + OTLP export) =="
 python scripts/trace_smoke.py
 

@@ -1158,6 +1158,51 @@ class TestReplicaSetQuorum:
         assert b.state.get("k") == 1  # the un-acked write replicated too
         assert b.state.get("k3") == 3
 
+    def test_sustained_writes_batch_quorum_push_rounds(self):
+        """An invalidation/write storm piggybacks pending event tails
+        onto the in-flight push round: total push rounds stay BELOW
+        the event count (naively it would be events x replicas), and
+        every acked write still lands on both replicas."""
+        a, b, c, client = _replica_set()
+        base_rounds = METRICS.counts.get("cluster.replicate_push_rounds", 0)
+        base_piggy = METRICS.counts.get(
+            "cluster.replicate_push_piggybacked", 0)
+        n = 8
+        barrier = threading.Barrier(n)
+        errors: list = []
+
+        def put(i):
+            try:
+                barrier.wait(timeout=10)
+                client.put(f"storm/{i}", i)
+            except Exception as e:  # noqa: BLE001 — surfaced via the assert below
+                errors.append(e)
+
+        # delay the first push round per link: the other 7 writers
+        # apply their events while it holds the link lock, so the
+        # delayed round's payload (built after the sleep) carries the
+        # whole storm and they all piggyback
+        with faults.scoped({"rules": [
+            {"site": "cluster.replicate", "op": "delay",
+             "seconds": 0.25, "count": 2},
+        ]}):
+            threads = [threading.Thread(target=put, args=(i,))
+                       for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+        assert not errors, errors
+        rounds = METRICS.counts.get(
+            "cluster.replicate_push_rounds", 0) - base_rounds
+        piggy = METRICS.counts.get(
+            "cluster.replicate_push_piggybacked", 0) - base_piggy
+        assert piggy >= 1
+        assert rounds < n  # push-round count < event count
+        for i in range(n):
+            assert b.state.get(f"storm/{i}") == i
+            assert c.state.get(f"storm/{i}") == i
+
     def test_lease_refresh_heartbeats_skip_the_quorum_round_trip(self):
         a, b, c, client = _replica_set()
         g = client.lease_grant(30.0)  # mutation: needs quorum (and got it)

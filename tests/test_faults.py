@@ -151,6 +151,35 @@ class TestFaultPlanMechanics:
             assert (snap["hits"], snap["fired"]) == (4, 2)
         assert faults.active() is None
 
+    def test_delay_range_draws_are_seeded(self):
+        spec = {"site": "s", "op": "delay", "seconds": [0.0, 0.01],
+                "count": 3}
+        r1 = faults._Rule(spec, seed=9, index=0)
+        r2 = faults._Rule(spec, seed=9, index=0)
+        draws1 = [r1.delay_s("s", k) for k in range(1, 4)]
+        draws2 = [r2.delay_s("s", k) for k in range(1, 4)]
+        assert draws1 == draws2  # pure function of the plan
+        assert len(set(draws1)) == 3  # per-firing ordinals differ
+        assert all(0.0 <= d <= 0.01 for d in draws1)
+        r3 = faults._Rule(spec, seed=10, index=0)
+        assert r3.delay_s("s", 1) != draws1[0]  # seed moves the schedule
+        # scalar form unchanged; malformed ranges rejected at install
+        r4 = faults._Rule({"site": "s", "op": "delay", "seconds": 0.25},
+                          0, 0)
+        assert r4.delay_s("s", 1) == 0.25
+        with pytest.raises(ValueError):
+            faults._Rule({"site": "s", "op": "delay",
+                          "seconds": [1.0, 0.5]}, 0, 0)
+
+    def test_delay_range_fires_end_to_end(self):
+        with faults.scoped({"seed": 3, "rules": [
+            {"site": "s", "op": "delay", "seconds": [0.0, 0.001],
+             "count": 0},
+        ]}) as plan:
+            faults.check("s")
+            faults.check("s")
+            assert plan.snapshot()[0]["fired"] == 2
+
     def test_site_glob_and_where(self):
         with faults.scoped({"rules": [
             {"site": "wire.*", "op": "raise", "exc": "ValueError",
@@ -613,8 +642,9 @@ class TestDistributedChaos:
         paths = _write_partitions(tmp_path)
         real = coord_mod._dispatch
 
-        def duplicating(workers, fragments, request_type, deadline=None):
-            out = real(workers, fragments, request_type, deadline)
+        def duplicating(workers, fragments, request_type, deadline=None,
+                        **kw):
+            out = real(workers, fragments, request_type, deadline, **kw)
             return out + [out[0]]
 
         monkeypatch.setattr(coord_mod, "_dispatch", duplicating)

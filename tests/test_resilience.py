@@ -1,0 +1,732 @@
+"""Gray-failure resilience suite: hedged dispatch, retry budgets,
+per-target circuit breakers, and degraded-mode serving.
+
+Crash failures are covered by the chaos suite (test_faults.py); this
+suite covers the *alive-but-slow* class — seeded latency faults
+(`delay` rules with ranges), hedge-dedup correctness (hedged winner +
+late loser merge exactly once), retry-budget exhaustion under a fault
+storm, breaker open/half-open/close transitions including concurrent
+probes, the cluster client's blackholed-endpoint sweep classification,
+the heartbeat keep-alive channel, and every degraded-mode flag's
+appearance in ``metrics_text()``.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.cluster.client import _ClientApi
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.errors import DeviceTransientError, ExecutionError
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.materialize import collect
+from datafusion_tpu.parallel.coordinator import DistributedContext
+from datafusion_tpu.parallel.worker import serve
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils import breaker as breaker_mod
+from datafusion_tpu.utils import hedge as hedge_mod
+from datafusion_tpu.utils import retry
+from datafusion_tpu.utils.metrics import METRICS
+
+SCHEMA = Schema(
+    [
+        Field("region", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+        Field("x", DataType.FLOAT64, True),
+    ]
+)
+
+SQL = ("SELECT region, COUNT(1), SUM(v), MIN(v), MAX(v), MIN(x), MAX(x) "
+       "FROM t GROUP BY region")
+
+
+def _write_partitions(tmp_path, n_parts=3, rows_per=200):
+    rng = np.random.default_rng(31)
+    regions = ["north", "south", "east", "west"]
+    paths = []
+    for p in range(n_parts):
+        path = tmp_path / f"part{p}.csv"
+        with open(path, "w", encoding="utf-8") as f:
+            f.write("region,v,x\n")
+            for _ in range(rows_per):
+                f.write(f"{regions[rng.integers(0, 4)]},"
+                        f"{int(rng.integers(-1000, 1000))},"
+                        f"{rng.uniform(-5, 5):.6f}\n")
+        paths.append(str(path))
+    return paths
+
+
+def _register(ctx, paths):
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.parallel.partition import PartitionedDataSource
+
+    ctx.register_datasource("t", PartitionedDataSource(
+        [CsvDataSource(p, SCHEMA, True, 131072) for p in paths]))
+    return ctx
+
+
+def _rows(ctx):
+    return sorted(collect(ctx.sql(SQL)).to_rows())
+
+
+def _count(name):
+    return METRICS.counts.get(name, 0)
+
+
+@pytest.fixture()
+def inproc_workers():
+    """Two in-process workers over real TCP sockets (the chaos-smoke
+    deployment shape: hermetic, but the wire/dispatch paths are real)."""
+    servers, addrs = [], []
+    for _ in range(2):
+        server = serve("127.0.0.1:0", device="cpu")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        servers.append(server)
+        addrs.append(server.server_address[:2])
+    yield addrs
+    for s in servers:
+        s.shutdown()
+        s.server_close()
+
+
+@pytest.fixture()
+def breakers_on():
+    """Arm breakers for one test; fresh registry both sides."""
+    breaker_mod.configure(True)
+    breaker_mod.reset()
+    yield
+    breaker_mod.configure(None)
+    breaker_mod.reset()
+
+
+# -- circuit breaker state machine ------------------------------------
+
+class TestCircuitBreaker:
+    def test_consecutive_failures_open(self):
+        b = breaker_mod.CircuitBreaker("t", failures=3, open_s=60.0)
+        for _ in range(2):
+            b.record(False)
+        assert b.state == "closed" and b.allow()
+        b.record(False)
+        assert b.state == "open"
+        assert not b.allow() and b.denies()
+
+    def test_success_resets_the_streak(self):
+        b = breaker_mod.CircuitBreaker("t", failures=3, window=100,
+                                       ratio=1.1, open_s=60.0)
+        for _ in range(10):
+            b.record(False)
+            b.record(False)
+            b.record(True)
+        assert b.state == "closed"
+
+    def test_ratio_over_full_window_opens(self):
+        b = breaker_mod.CircuitBreaker("t", failures=100, window=10,
+                                       ratio=0.5, open_s=60.0)
+        # alternate: never 100 consecutive, but 50% of a full window
+        for i in range(10):
+            b.record(i % 2 == 0)
+        assert b.state == "open"
+
+    def test_half_open_probe_then_close(self):
+        now = [0.0]
+        b = breaker_mod.CircuitBreaker("t", failures=1, open_s=5.0,
+                                       half_open_probes=1,
+                                       now=lambda: now[0])
+        b.record(False)
+        assert b.state == "open" and not b.allow()
+        now[0] = 6.0
+        assert b.allow()  # cool-down lapsed: half-open, probe admitted
+        assert b.state == "half_open"
+        assert not b.allow()  # concurrent probe capped
+        b.record(True)
+        assert b.state == "closed" and b.allow()
+
+    def test_half_open_probe_failure_reopens(self):
+        now = [0.0]
+        b = breaker_mod.CircuitBreaker("t", failures=1, open_s=5.0,
+                                       now=lambda: now[0])
+        b.record(False)
+        now[0] = 6.0
+        assert b.allow()
+        b.record(False)
+        assert b.state == "open"
+        assert not b.allow()  # cool-down re-armed at t=6
+        now[0] = 12.0
+        assert b.allow() and b.state == "half_open"
+
+    def test_concurrent_probes_bounded(self):
+        now = [10.0]
+        b = breaker_mod.CircuitBreaker("t", failures=1, open_s=1.0,
+                                       half_open_probes=2,
+                                       now=lambda: now[0])
+        b.record(False)
+        now[0] = 20.0
+        results = []
+        barrier = threading.Barrier(4)
+
+        def probe():
+            barrier.wait(timeout=5)
+            results.append(b.allow())
+
+        threads = [threading.Thread(target=probe) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert sum(results) == 2  # exactly half_open_probes admitted
+
+    def test_late_loser_report_after_open_is_dropped(self):
+        b = breaker_mod.CircuitBreaker("t", failures=1, open_s=60.0)
+        b.record(False)
+        assert b.state == "open"
+        b.record(True)  # a request that started before the open
+        assert b.state == "open"  # not corrupted into closed
+
+    def test_cooled_open_closes_via_record_without_allow(self):
+        """Peek-style consumers (the cluster sweep) never reserve via
+        allow(); their post-cool-down outcome must still count as the
+        probe, or an open endpoint breaker could never close."""
+        now = [0.0]
+        b = breaker_mod.CircuitBreaker("t", failures=1, open_s=5.0,
+                                       now=lambda: now[0])
+        b.record(False)
+        assert b.state == "open"
+        now[0] = 6.0
+        assert not b.denies()  # cooled: the sweep may attempt it
+        b.record(True)
+        assert b.state == "closed"
+
+    def test_registry_bounded_against_worker_churn(self, breakers_on,
+                                                   monkeypatch):
+        """Ephemeral-port worker restarts mint fresh breaker names;
+        the registry evicts closed (evidence-free) entries at the cap
+        and keeps mid-incident ones."""
+        import datafusion_tpu.utils.breaker as bm
+
+        monkeypatch.setattr(bm, "_REGISTRY_MAX", 4)
+        incident = breaker_mod.breaker_for("worker:h:0")
+        for _ in range(incident.failures):
+            incident.record(False)
+        assert incident.state == "open"
+        for i in range(1, 12):
+            breaker_mod.breaker_for(f"worker:h:{i}")
+        assert len(bm._REGISTRY) <= 4
+        assert "worker:h:0" in bm._REGISTRY  # live evidence survives
+
+    def test_registry_disabled_and_gauges(self):
+        breaker_mod.configure(False)
+        try:
+            assert breaker_mod.breaker_for("x") is None
+        finally:
+            breaker_mod.configure(None)
+        breaker_mod.configure(True)
+        try:
+            breaker_mod.reset()
+            b = breaker_mod.breaker_for("worker:h:1")
+            assert b is breaker_mod.breaker_for("worker:h:1")
+            b.record(False)
+            for _ in range(10):
+                b.record(False)
+            assert breaker_mod.gauges()["breaker.worker:h:1.state"] == 2
+        finally:
+            breaker_mod.configure(None)
+            breaker_mod.reset()
+
+
+# -- retry budget -----------------------------------------------------
+
+class TestRetryBudget:
+    def test_bucket_semantics(self):
+        rb = retry.RetryBudget(0.5, burst=2.0)
+        rb.earn()  # 1.0 + 0.5 = 1.5
+        assert rb.spend()  # 0.5 left
+        assert not rb.spend()
+        rb.earn()  # 1.0
+        assert rb.spend()
+
+    def test_token_bucket_never_over_grants_concurrently(self):
+        """N threads racing one bucket must get exactly `tokens`
+        grants — an unlocked bucket over-grants during the correlated
+        storm the budget exists to bound."""
+        from datafusion_tpu.utils.retry import TokenBucket
+
+        bucket = TokenBucket(0.0, burst=8.0, initial=8.0)
+        granted = []
+        barrier = threading.Barrier(16)
+
+        def spender():
+            barrier.wait(timeout=10)
+            granted.append(sum(bucket.spend() for _ in range(4)))
+
+        threads = [threading.Thread(target=spender) for _ in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert sum(granted) == 8
+
+    def test_device_call_denied_fails_fast(self):
+        retry.set_retry_budget(retry.RetryBudget(0.0, burst=0.0))
+        base = _count("device.retry_budget_exhausted")
+        try:
+            with faults.scoped({"rules": [
+                {"site": "device.call", "op": "raise",
+                 "exc": "DeviceTransientError", "count": 0},
+            ]}):
+                with pytest.raises(DeviceTransientError):
+                    retry.device_call(lambda: 1)
+        finally:
+            retry.set_retry_budget(None)
+        assert _count("device.retry_budget_exhausted") == base + 1
+        assert _count("retry.budget_denied") >= 1
+
+    def test_device_call_within_budget_retries(self, monkeypatch):
+        monkeypatch.setattr(retry, "_BASE_S", 0.001)
+        monkeypatch.setattr(retry, "_CAP_S", 0.002)
+        retry.set_retry_budget(retry.RetryBudget(1.0, burst=4.0))
+        base = _count("retry.budget_spent")
+        try:
+            with faults.scoped({"rules": [
+                {"site": "device.call", "op": "raise",
+                 "exc": "DeviceTransientError", "count": 2},
+            ]}):
+                assert retry.device_call(lambda: 41) == 41
+        finally:
+            retry.set_retry_budget(None)
+        assert _count("retry.budget_spent") == base + 2
+
+    def test_retry_volume_bounded_under_fault_storm(self, monkeypatch):
+        """30% injected transient faults: total retries stay within the
+        configured budget ratio (the smooth-degradation acceptance
+        gate, asserted from the metrics)."""
+        monkeypatch.setattr(retry, "_BASE_S", 0.0001)
+        monkeypatch.setattr(retry, "_CAP_S", 0.0002)
+        ratio = 0.2
+        retry.set_retry_budget(retry.RetryBudget(ratio, burst=1.0))
+        first0 = _count("retry.first_attempts")
+        spent0 = _count("retry.budget_spent")
+        failures = 0
+        try:
+            with faults.scoped({"seed": 11, "rules": [
+                {"site": "device.call", "op": "raise",
+                 "exc": "DeviceTransientError", "p": 0.3, "count": 0},
+            ]}):
+                for _ in range(200):
+                    try:
+                        retry.device_call(lambda: 1)
+                    except DeviceTransientError:
+                        failures += 1
+        finally:
+            retry.set_retry_budget(None)
+        first = _count("retry.first_attempts") - first0
+        spent = _count("retry.budget_spent") - spent0
+        assert first == 200
+        # retries never exceed ratio * first attempts + the burst
+        assert spent <= ratio * first + 1.0
+        assert failures > 0  # denied retries failed fast, not retried
+
+    def test_dispatch_reassignment_consumes_the_budget(
+            self, tmp_path, inproc_workers):
+        """An empty budget converts fragment-reassignment storms into
+        prompt failures; the same scenario recovers with no budget."""
+        paths = _write_partitions(tmp_path)
+        want = _rows(_register(ExecutionContext(device="cpu"), paths))
+        plan = {"rules": [
+            {"site": "worker.fragment", "op": "raise",
+             "exc": "InjectedConnectionAbort", "count": 1},
+        ]}
+        retry.set_retry_budget(retry.RetryBudget(0.0, burst=0.0))
+        base = _count("coord.reassign_budget_denied")
+        try:
+            ctx = _register(DistributedContext(inproc_workers,
+                                               result_cache=False), paths)
+            with faults.scoped(plan):
+                with pytest.raises(ExecutionError):
+                    _rows(ctx)
+            assert _count("coord.reassign_budget_denied") == base + 1
+        finally:
+            retry.set_retry_budget(None)
+        # unbudgeted (the default): the reassignment replays and heals
+        ctx = _register(DistributedContext(inproc_workers,
+                                           result_cache=False), paths)
+        with faults.scoped(plan):
+            assert _rows(ctx) == want
+
+
+# -- hedge tracker ----------------------------------------------------
+
+class TestHedgeTracker:
+    def test_threshold_floor_then_history(self):
+        h = hedge_mod.HedgeTracker(factor=2.0, floor_s=0.1, min_samples=2)
+        assert h.threshold_s("w") == 0.1  # no history: floor
+        h.observe("w", 1.0)
+        h.observe("w", 1.0)
+        # log2 histogram quantile is a bucket upper bound (>= 1.0)
+        assert h.threshold_s("w") >= 2.0
+        assert h.ewma["w"] == 1.0
+
+    def test_fleet_history_backfills_new_workers(self):
+        h = hedge_mod.HedgeTracker(factor=1.0, floor_s=0.001, min_samples=2)
+        h.observe("a", 0.5)
+        h.observe("b", 0.5)
+        assert h.threshold_s("never-seen") >= 0.5  # fleet histogram
+
+    def test_hedge_token_budget(self):
+        h = hedge_mod.HedgeTracker(ratio=0.5, burst=2.0)
+        assert h.try_hedge()  # the initial token
+        assert not h.try_hedge()
+        for _ in range(2):
+            h.observe_dispatch()
+        assert h.try_hedge()
+        assert not h.try_hedge()
+
+    def test_refund_returns_a_spent_token(self):
+        h = hedge_mod.HedgeTracker(ratio=0.0, burst=2.0)
+        assert h.try_hedge()
+        assert not h.try_hedge()
+        h.refund()  # approved hedge never launched (no target)
+        assert h.try_hedge()
+
+    def test_from_env_default_off(self, monkeypatch):
+        monkeypatch.delenv("DATAFUSION_TPU_HEDGE", raising=False)
+        assert hedge_mod.from_env() is None
+        monkeypatch.setenv("DATAFUSION_TPU_HEDGE", "1")
+        monkeypatch.setenv("DATAFUSION_TPU_HEDGE_FLOOR_S", "0.125")
+        t = hedge_mod.from_env()
+        assert t is not None and t.floor_s == 0.125
+
+
+# -- hedged dispatch (the chaos leg) ----------------------------------
+
+class TestHedgedDispatch:
+    def test_hedged_winner_and_late_loser_merge_exactly_once(
+            self, tmp_path, inproc_workers):
+        """A seeded `worker.fragment` delay makes the primary crawl;
+        the hedge fires, wins, and the loser's late (identical)
+        response is discarded — the merged result equals the fault-free
+        run with zero duplicate merges."""
+        paths = _write_partitions(tmp_path)
+        want = _rows(_register(ExecutionContext(device="cpu"), paths))
+        tracker = hedge_mod.HedgeTracker(floor_s=0.05, min_samples=10**6)
+        ctx = _register(DistributedContext(inproc_workers, hedge=tracker,
+                                           result_cache=False), paths)
+        won0 = _count("coord.hedges_won")
+        dup0 = _count("coord.duplicate_responses_dropped")
+        with faults.scoped({"rules": [
+            {"site": "worker.fragment", "op": "delay", "seconds": 0.6,
+             "where": {"shard": 0}, "count": 1},
+        ]}):
+            assert _rows(ctx) == want
+        assert _count("coord.hedges_won") == won0 + 1
+        assert _count("coord.duplicate_responses_dropped") == dup0
+        # let the abandoned loser finish its 0.6s sleep, then prove the
+        # healed path still agrees (no leaked state, no double-merge)
+        time.sleep(0.7)
+        assert _rows(ctx) == want
+
+    def test_hedge_suppressed_without_alternative(self, tmp_path):
+        server = serve("127.0.0.1:0", device="cpu")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            paths = _write_partitions(tmp_path, n_parts=2)
+            tracker = hedge_mod.HedgeTracker(floor_s=0.01,
+                                             min_samples=10**6)
+            ctx = _register(DistributedContext(
+                [server.server_address[:2]], hedge=tracker,
+                result_cache=False), paths)
+            d0 = _count("coord.hedges_dispatched")
+            want = _rows(_register(ExecutionContext(device="cpu"), paths))
+            assert _rows(ctx) == want
+            assert _count("coord.hedges_dispatched") == d0  # nobody to hedge to
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    def test_seeded_delay_range_drives_hedges(self, tmp_path,
+                                              inproc_workers):
+        """Latency faults with a [lo, hi] range: the gray-failure soak
+        shape — every delayed fragment still merges exactly once."""
+        paths = _write_partitions(tmp_path)
+        want = _rows(_register(ExecutionContext(device="cpu"), paths))
+        tracker = hedge_mod.HedgeTracker(floor_s=0.05, min_samples=10**6,
+                                         ratio=1.0, burst=8.0)
+        ctx = _register(DistributedContext(inproc_workers, hedge=tracker,
+                                           result_cache=False), paths)
+        with faults.scoped({"seed": 7, "rules": [
+            {"site": "worker.fragment", "op": "delay",
+             "seconds": [0.3, 0.5], "count": 2},
+        ]}):
+            assert _rows(ctx) == want
+
+    def test_breaker_open_worker_skipped(self, tmp_path, inproc_workers,
+                                         breakers_on):
+        """An open breaker takes a worker out of the pick rotation
+        while an alternative exists — the query routes around the sick
+        target without paying its timeout."""
+        paths = _write_partitions(tmp_path)
+        want = _rows(_register(ExecutionContext(device="cpu"), paths))
+        (h0, p0), _ = inproc_workers
+        b = breaker_mod.breaker_for(f"worker:{h0}:{p0}")
+        for _ in range(b.failures):
+            b.record(False)
+        assert b.state == "open"
+        skips0 = _count("coord.breaker_skips")
+        ctx = _register(DistributedContext(inproc_workers,
+                                           result_cache=False), paths)
+        assert _rows(ctx) == want
+        assert _count("coord.breaker_skips") > skips0
+
+
+# -- degraded-mode serving -------------------------------------------
+
+class TestDegradedModes:
+    def test_stale_view_flag_in_metrics_text(self, tmp_path, monkeypatch,
+                                             inproc_workers):
+        from datafusion_tpu.cluster import ClusterNode, LocalClusterClient
+
+        monkeypatch.setenv("DATAFUSION_TPU_STALE_VIEW_GRACE_S", "0.05")
+        node = ClusterNode()
+        client = LocalClusterClient([node])
+        ctx = DistributedContext(inproc_workers, cluster=client,
+                                 result_cache=False)
+        assert 'name="cluster.view_stale"} 0' in ctx.metrics_text()
+        node.partitioned = True  # the whole control plane goes dark
+        time.sleep(0.08)
+        stale0 = _count("coord.membership_went_stale")
+        text = ctx.metrics_text()
+        assert 'name="cluster.view_stale"} 1' in text
+        assert _count("coord.membership_went_stale") == stale0 + 1
+        # serving continues off the last-good view the whole time
+        node.partitioned = False
+        ctx.membership.poll()
+        assert 'name="cluster.view_stale"} 0' in ctx.metrics_text()
+
+    def test_shared_tier_open_circuit_serves_local_only(self, breakers_on):
+        from datafusion_tpu.cluster.shared_cache import SharedResultTier
+
+        class DeadClient:
+            def result_fetch(self, key):
+                raise ConnectionRefusedError("service down")
+
+        tier = SharedResultTier(DeadClient())
+        b = tier._breaker
+        assert b is not None
+        for _ in range(b.failures):
+            assert tier.load("fp") is None  # errors feed the breaker
+        assert b.state == "open"
+        ff0 = _count("coord.shared_cache_fast_fails")
+        assert tier.load("fp") is None  # fast-fail, no round trip
+        assert _count("coord.shared_cache_fast_fails") == ff0 + 1
+        # the degraded flag renders in the scrape
+        text = ExecutionContext(device="cpu").metrics_text()
+        assert 'name="breaker.shared_cache.state"} 2' in text
+
+    def test_shared_tier_decode_error_releases_the_probe(self,
+                                                         breakers_on):
+        """A malformed reply during the half-open probe must release
+        the reserved probe slot (and count as transport-healthy) — a
+        leak would wedge the tier in local-only mode forever."""
+        from datafusion_tpu.cluster.shared_cache import SharedResultTier
+
+        class WeirdClient:
+            mode = "dead"
+
+            def result_fetch(self, key):
+                if self.mode == "dead":
+                    raise ConnectionRefusedError("service down")
+                raise KeyError("malformed entry")
+
+        wc = WeirdClient()
+        tier = SharedResultTier(wc)
+        b = tier._breaker
+        for _ in range(b.failures):
+            tier.load("fp")
+        assert b.state == "open"
+        b._opened_at = b._now() - b.open_s - 1  # cool-down lapsed
+        wc.mode = "malformed"
+        assert tier.load("fp") is None  # the probe: answered, undecodable
+        assert b.state == "closed"  # slot released, circuit closed
+        assert tier.load("fp") is None  # loads keep flowing
+
+    def test_local_fallback_serves_when_fleet_is_gone(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_LOCAL_FALLBACK", "1")
+        server = serve("127.0.0.1:0", device="cpu")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        addr = server.server_address[:2]
+        paths = _write_partitions(tmp_path, n_parts=2)
+        want = _rows(_register(ExecutionContext(device="cpu"), paths))
+        ctx = _register(DistributedContext([addr], result_cache=False),
+                        paths)
+        assert _rows(ctx) == want  # healthy: served remotely
+        server.shutdown()
+        server.server_close()
+        lf0 = _count("coord.local_fallbacks")
+        assert _rows(ctx) == want  # fleet dead: served HERE, degraded
+        assert _count("coord.local_fallbacks") > lf0
+
+    def test_local_fallback_off_by_default(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DATAFUSION_TPU_LOCAL_FALLBACK", raising=False)
+        ctx = DistributedContext([("127.0.0.1", 1)])
+        assert ctx._local_worker is None and ctx._local_exec_fn is None
+
+
+# -- cluster client: sweep classification + heartbeat channel ---------
+
+class _ScriptedClient(_ClientApi):
+    """A `_ClientApi` over scripted per-endpoint behaviors: each
+    endpoint holds a list of callables consumed one per attempt (the
+    last repeats) — sweep-policy tests without sockets."""
+
+    def __init__(self, scripts):
+        self.scripts = scripts
+        self.calls = [0] * len(scripts)
+        self._active = 0
+
+    def _endpoint_count(self):
+        return len(self.scripts)
+
+    def _endpoint_index_for(self, addr):
+        return int(addr) if addr is not None else None
+
+    def _request_endpoint(self, idx, msg, timeout, bw=None, sent_box=None):
+        self.calls[idx] += 1
+        step = self.scripts[idx]
+        fn = step.pop(0) if len(step) > 1 else step[0]
+        return fn()
+
+
+class TestClientSweep:
+    def test_redirect_hint_overrides_timeout_memory(self):
+        """One transient timeout on the true primary must not make the
+        sweep skip/redirect-ping-pong off the standby until exhaustion:
+        a standby naming that endpoint as primary is fresher evidence,
+        so the redirect clears its timed-out mark and retries it."""
+        from datafusion_tpu.errors import ClusterNotPrimaryError
+
+        def stalled_once_then_ok():
+            return {"type": "pong"}
+
+        def stall():
+            raise TimeoutError("GC pause")
+
+        def redirect():
+            raise ClusterNotPrimaryError("standby", primary="0")
+
+        client = _ScriptedClient([
+            [stall, stalled_once_then_ok],  # primary: one stall, then fine
+            [redirect],                     # standby: always points at 0
+        ])
+        out = client.request({"type": "ping"})
+        assert out == {"type": "pong"}
+        assert client.calls == [2, 1]  # retried the primary, succeeded
+
+    def test_redirect_overrides_an_open_breaker(self, breakers_on):
+        """A standby naming an endpoint as primary is fresher evidence
+        than that endpoint's open breaker: the redirect must be
+        followed, not skip/ping-ponged until the sweep exhausts."""
+        from datafusion_tpu.errors import ClusterNotPrimaryError
+
+        def ok():
+            return {"type": "pong"}
+
+        def redirect():
+            raise ClusterNotPrimaryError("standby", primary="0")
+
+        client = _ScriptedClient([[ok], [redirect]])
+        b = breaker_mod.breaker_for("cluster:0")
+        for _ in range(b.failures):
+            b.record(False)
+        assert b.state == "open"
+        client._active = 1  # start at the standby
+        assert client.request({"type": "ping"}) == {"type": "pong"}
+        assert client.calls == [1, 1]
+
+    def test_open_breaker_skips_the_first_attempt_too(self, breakers_on):
+        """The cross-request breaker memory must apply from a sweep's
+        FIRST lap — an open starting endpoint is routed around, not
+        probed at full timeout cost on every fresh request."""
+        def must_not_run():
+            raise AssertionError("open-circuited endpoint was dialed")
+
+        def ok():
+            return {"type": "pong"}
+
+        client = _ScriptedClient([[must_not_run], [ok]])
+        b = breaker_mod.breaker_for("cluster:0")
+        for _ in range(b.failures):
+            b.record(False)
+        assert b.state == "open"
+        assert client.request({"type": "ping"}) == {"type": "pong"}
+        assert client.calls == [0, 1]
+    def test_blackholed_endpoint_skipped_within_sweep(self):
+        from datafusion_tpu.cluster.client import ClusterClient
+
+        blackhole = socket.socket()
+        blackhole.bind(("127.0.0.1", 0))
+        blackhole.listen(1)  # accepts, never answers: pure blackhole
+        bh_port = blackhole.getsockname()[1]
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            dead_port = s.getsockname()[1]  # released: instant refusal
+        try:
+            client = ClusterClient(
+                f"127.0.0.1:{bh_port},127.0.0.1:{dead_port}",
+                request_timeout=0.3)
+            skips0 = _count("cluster.client_timeout_skips")
+            t0 = time.monotonic()
+            with pytest.raises((ConnectionError, OSError)):
+                client.request({"type": "ping"})
+            elapsed = time.monotonic() - t0
+            # the blackhole's timeout was paid ONCE; later sweep laps
+            # skipped it instead of re-paying 0.3s each
+            assert _count("cluster.client_timeout_skips") == skips0 + 2
+            assert elapsed < 3.0
+        finally:
+            blackhole.close()
+
+    def test_heartbeat_rides_a_persistent_channel(self):
+        from datafusion_tpu.cluster import connect
+        from datafusion_tpu.cluster.service import serve as serve_cluster
+
+        server = serve_cluster("127.0.0.1:0")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        try:
+            host, port = server.server_address[:2]
+            client = connect(f"{host}:{port}")
+            g = client.lease_grant(30.0)
+            c0 = _count("cluster.heartbeat_channel_connects")
+            d0 = _count("cluster.heartbeat_channel_drops")
+            for _ in range(3):
+                assert client.lease_refresh(g["lease"])["found"]
+            # ONE channel pin, then every refresh reuses the socket
+            assert _count("cluster.heartbeat_channel_connects") == c0 + 1
+            assert _count("cluster.heartbeat_channel_drops") == d0
+        finally:
+            client.close()
+            server.shutdown()
+            server.server_close()
+
+    def test_heartbeat_channel_drop_falls_back_to_sweep(self):
+        from datafusion_tpu.cluster import connect
+        from datafusion_tpu.cluster.service import serve as serve_cluster
+
+        server = serve_cluster("127.0.0.1:0")
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        host, port = server.server_address[:2]
+        client = connect(f"{host}:{port}")
+        g = client.lease_grant(30.0)
+        assert client.lease_refresh(g["lease"])["found"]  # pins channel
+        server.shutdown()
+        server.server_close()
+        d0 = _count("cluster.heartbeat_channel_drops")
+        with pytest.raises((ConnectionError, OSError)):
+            client.lease_refresh(g["lease"])
+        assert _count("cluster.heartbeat_channel_drops") == d0 + 1
+        client.close()
