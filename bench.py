@@ -35,7 +35,7 @@ def main():
     device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache"
+        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache,conc"
     ).split(",")
     runners = {
         "1": suite.config1_csv_filter,
@@ -52,6 +52,10 @@ def main():
         "worker": suite.config_worker_smoke,
         # warm-repeat phase: result-cache hit rate + warm/cold speedup
         "cache": suite.config_cache,
+        # throughput under concurrency: the serving front door (async
+        # admission + HBM-pinned tables + cross-query megabatching) vs
+        # serialized back-to-back execution of the same workload
+        "conc": suite.config_concurrency,
     }
     if float(os.environ.get("BENCH_SF", 1)) == 10 and "3" in [
         w.strip() for w in wanted

@@ -677,6 +677,145 @@ def config_cache(device_kind: str):
     }
 
 
+def config_concurrency(device_kind: str):
+    """Throughput under concurrency: the serving front door vs
+    serialized back-to-back execution of the SAME workload — the first
+    config where queries/s, not single-query latency, is the number.
+
+    Closed-loop: `clients` threads each submit `per_client` distinct-
+    literal variants of one aggregate shape (one compiled core,
+    result-cache-proof literals).  The serving leg pins the table in
+    device memory, shares group-id encoders across queries, and fuses
+    compatible concurrent plans into megabatched launches; reported
+    p50/p99 come from the `serve.latency` fleet histogram (timed
+    round only).
+
+    On the CPU backend a per-launch latency floor is injected
+    (`BENCH_SERVE_LAUNCH_FLOOR_MS`, default 10; =0 disables) — see
+    `benchmarks/serve_load.launch_floor_plan`, the harness shared with
+    `scripts/serve_smoke.py` so the two cannot drift.  BOTH legs run
+    under the same floor; real accelerators run uninjected."""
+    from benchmarks import serve_load
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.obs.aggregate import HISTOGRAMS
+    from datafusion_tpu.testing import faults
+    from datafusion_tpu.utils.metrics import METRICS
+
+    rows = int(os.environ.get("BENCH_SERVE_ROWS", 32768))
+    groups = int(os.environ.get("BENCH_SERVE_GROUPS", 64))
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 8))
+    per_client = int(os.environ.get("BENCH_SERVE_QUERIES", 8))
+    floor_ms = float(os.environ.get(
+        "BENCH_SERVE_LAUNCH_FLOOR_MS",
+        "10" if device_kind == "cpu" else "0",
+    ))
+    log(f"  config concurrency: {clients} clients x {per_client} "
+        f"queries over {rows} rows, launch floor {floor_ms} ms")
+    _, src = bdata.groupby_batches(rows, groups, 1 << 15)
+    device = None if device_kind == "cpu" else device_kind
+
+    def q(lit: float) -> str:
+        return (f"SELECT k, SUM(v1), AVG(v2), COUNT(1) FROM t "
+                f"WHERE v2 < {lit:.6f} GROUP BY k")
+
+    lits = [0.1 + 0.8 * i / (clients * per_client)
+            for i in range(clients * per_client)]
+
+    # serialized baseline: the same workload back-to-back on one thread
+    ctx = ExecutionContext(
+        device="cpu" if device is None else device, result_cache=False
+    )
+    ctx.register_datasource("t", src)
+    collect(ctx.sql(q(0.95)))  # compile outside the timing
+    if floor_ms > 0:
+        faults.install(serve_load.launch_floor_plan(floor_ms))
+    try:
+        t0 = time.perf_counter()
+        serial_out = [collect(ctx.sql(q(lit))) for lit in lits]
+        serial_s = time.perf_counter() - t0
+    finally:
+        faults.clear()
+    qps_serial = len(lits) / serial_s
+
+    # served: closed-loop clients against the front door on a FRESH
+    # context (no shared device caches with the baseline leg).
+    # Megabatch cap = client count: a full closed-loop round flushes
+    # the window the moment every client's query is queued (the window
+    # is the MAX wait, size triggers early dispatch).
+    sctx = ExecutionContext(
+        device="cpu" if device is None else device, result_cache=False
+    )
+    sctx.register_datasource("t", bdata.groupby_batches(
+        rows, groups, 1 << 15)[1])
+    srv = sctx.serve(workers=2, window_s=0.01, megabatch_max=clients)
+    results: dict = {}
+    errors: list = []
+    try:
+        srv.submit(q(0.95)).result(timeout=300)  # pin + compile
+        # untimed warm-up: every megabatch rung + one closed-loop
+        # round, so the timed round is deterministically compile-free
+        # (warm steady state is the measurement, as in every config)
+        serve_load.warm_rungs(srv, q, clients)
+        serve_load.closed_loop(srv, q, clients, per_client,
+                               lambda i: 0.95 + 0.0005 * i, {}, errors)
+        assert not errors, f"warm-up failures: {errors[:3]}"
+        # timed-phase baselines (AFTER warm-up, like the smoke's, so
+        # the reported fusion count and launches/query cover the same
+        # phase)
+        warm_launches0 = METRICS.counts.get("device.launches", 0)
+        mega0 = METRICS.counts.get("serve.megabatch_launches", 0)
+        h_before = (HISTOGRAMS["serve.latency"].snapshot()
+                    if "serve.latency" in HISTOGRAMS else None)
+        if floor_ms > 0:
+            faults.install(serve_load.launch_floor_plan(floor_ms))
+        try:
+            served_s = serve_load.closed_loop(
+                srv, q, clients, per_client, lambda i: lits[i],
+                results, errors,
+            )
+        finally:
+            faults.clear()
+    finally:
+        srv.stop()
+    assert not errors, f"{len(errors)} served queries failed: {errors[:3]}"
+    qps_served = len(lits) / served_s
+    # correctness: every served answer matches its serialized twin
+    for i, lit in enumerate(lits):
+        _assert_tables_match(
+            results[divmod(i, per_client)], serial_out[i],
+            f"concurrency lit={lit}",
+        )
+    mega = METRICS.counts.get("serve.megabatch_launches", 0) - mega0
+    launches_per_query = (
+        METRICS.counts.get("device.launches", 0) - warm_launches0
+    ) / len(lits)
+    p50, p99 = serve_load.phase_quantiles(
+        HISTOGRAMS.get("serve.latency"), h_before
+    )
+    log(
+        f"    serialized {qps_serial:.1f} q/s -> served "
+        f"{qps_served:.1f} q/s ({qps_served / qps_serial:.2f}x), "
+        f"{mega} megabatch launches, "
+        f"{launches_per_query:.2f} launches/query, "
+        f"p50 {p50} p99 {p99}"
+    )
+    return {
+        "name": "concurrency",
+        "unit": "queries/s",
+        "value": round(qps_served, 2),
+        "qps_serialized": round(qps_serial, 2),
+        "vs_baseline": round(qps_served / qps_serial, 3),
+        "clients": clients,
+        "queries": len(lits),
+        "megabatch_launches": mega,
+        "launches_per_query": round(launches_per_query, 3),
+        "launch_floor_ms": floor_ms,
+        "p50_s": p50,
+        "p99_s": p99,
+    }
+
+
 # -- worker-on-the-chip smoke (part of the bench protocol) --
 def config_worker_smoke(device_kind: str):
     """Coordinator -> TPU-worker parity smoke on the attached chip

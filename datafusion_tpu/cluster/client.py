@@ -285,15 +285,41 @@ class _ClientApi:
     def events_since(self, since: int) -> dict:
         return self.request({"type": "events", "since": since})
 
+    # resumption token from the last watch answer ({"term", "rev"}):
+    # replayed on the next watch so the service — the SAME node or the
+    # one a failover sweep landed on — can prove the watcher missed
+    # nothing (`resumed: True`) or demand a resync (`resumed: False`)
+    _watch_resume = None
+
+    @property
+    def last_watch_resume(self):
+        return self._watch_resume
+
+    def _watch_msg(self, since: int, timeout_s: float) -> dict:
+        msg = {"type": "watch", "since": since, "timeout_s": timeout_s}
+        if self._watch_resume is not None:
+            msg["resume"] = self._watch_resume
+        return msg
+
+    def _note_watch_answer(self, out: dict) -> dict:
+        tok = out.get("resume")
+        if tok is not None:
+            self._watch_resume = tok
+        if out.get("resumed") is False:
+            METRICS.add("cluster.client_watch_resyncs")
+        return out
+
     def watch(self, since: int, timeout_s: float = 10.0) -> dict:
         """Long-poll push watch: the service answers on the next
         membership/invalidation event past `since`, or at `timeout_s`.
         The socket timeout is widened past the park interval so the
-        park itself never reads as a dead service."""
-        return self.request(
-            {"type": "watch", "since": since, "timeout_s": timeout_s},
-            timeout=timeout_s + 10.0,
-        )
+        park itself never reads as a dead service.  Answers carry a
+        resumption token this client replays automatically; after a
+        failover, ``resumed: False`` in the answer means events were
+        missed and derived state must resync."""
+        return self._note_watch_answer(self.request(
+            self._watch_msg(since, timeout_s), timeout=timeout_s + 10.0,
+        ))
 
     def invalidate(self, table: str) -> dict:
         return self.request({"type": "invalidate", "table": table})
@@ -552,10 +578,11 @@ class ClusterClient(_ClientApi):
             return out
 
     def watch(self, since: int, timeout_s: float = 10.0) -> dict:
-        msg = {"type": "watch", "since": since, "timeout_s": timeout_s}
         # reply timeout widened past the park interval: the park itself
         # must never read as a dead service
-        return self._channel_request("watch", msg, timeout_s + 10.0)
+        return self._note_watch_answer(self._channel_request(
+            "watch", self._watch_msg(since, timeout_s), timeout_s + 10.0,
+        ))
 
     def lease_refresh(self, lease: str, since: Optional[int] = None,
                       telemetry: Optional[dict] = None) -> dict:

@@ -413,12 +413,13 @@ class ClusterState:
             return self._events_since(since, kinds)
 
     def watch(self, since: int, timeout_s: float,
-              now: Optional[float] = None) -> dict:
+              now: Optional[float] = None, resume=None) -> dict:
         """Long-poll push watch: park until a client-visible event past
         `since` lands (or `timeout_s` lapses), then answer with the
         event tail AND the current membership in one response — a
         watcher learns of a join/leave one round trip after it happens
-        instead of one poll interval later."""
+        instead of one poll interval later.  `resume` is the previous
+        answer's resumption token (see `_stamp_resume`)."""
         timeout_s = max(0.0, min(float(timeout_s), _WATCH_TIMEOUT_CAP_S))
 
         def pending() -> bool:
@@ -437,21 +438,70 @@ class ClusterState:
             # notices silent deaths
             wake = time.monotonic() if now is None else now
             self._expire(wake)
-            out = self._events_since(since, CLIENT_EVENT_KINDS)
-            out.update(self._membership(wake))
+            out = self._watch_answer(since, wake, resume)
             out["fired"] = bool(fired or out["events"])
             return out
 
     # -- event-loop watches (no parked thread) --
-    def _watch_answer(self, since: int, now: float) -> dict:
+    def _watch_answer(self, since: int, now: float, resume=None) -> dict:
         # lock held: the same tail+membership payload `watch` builds
         out = self._events_since(since, CLIENT_EVENT_KINDS)
         out.update(self._membership(now))
         out["fired"] = bool(out["events"])
+        self._stamp_resume(out, resume)
         return out
 
+    def _stamp_resume(self, out: dict, resume) -> None:
+        """Resumption-token half of the watch protocol: every answer
+        carries ``resume = {term, rev}`` — the log position this answer
+        is complete up to.  A watcher that failed over mid-park replays
+        the token on its next watch; ``resumed: True`` is this node's
+        PROOF the watcher missed nothing (every revision past the
+        token is still in the retained log of a node whose log is at
+        least as new — quorum election guarantees the promoted log
+        holds every acked revision).  ``resumed: False`` means the
+        proof fails (token past our head, from a newer term than ours,
+        or truncated past the retained window): the watcher must
+        resync its derived state instead of silently continuing."""
+        out["resume"] = {"term": self.term, "rev": self._rev}
+        if resume is None:
+            return
+        ok = self._resume_ok(resume)
+        out["resumed"] = ok
+        METRICS.add("cluster.watch_resumed" if ok
+                    else "cluster.watch_resyncs")
+
+    def _resume_ok(self, resume) -> bool:
+        if not isinstance(resume, dict):
+            return False
+        try:
+            rev = int(resume.get("rev", -1))
+            term = int(resume.get("term", 0))
+        except (TypeError, ValueError):
+            return False
+        if rev < 0 or rev > self._rev:
+            return False  # we hold LESS history than the watcher saw
+        if term > self.term:
+            return False  # token minted under a newer leadership
+        if term < self.term:
+            # older-term token: provable only up to the revision this
+            # node contiguously held when IT last promoted — a lagging
+            # promoted log re-bumps the counter without ever holding
+            # the missed events, so a bare rev compare would lie
+            floor = getattr(self, "_resume_floor", None)
+            if floor is not None and rev > floor:
+                return False
+        if rev + 1 < self._events_floor:
+            # gap: events past the token truncated out of the window.
+            # Checked for rev 0 too — unlike `since=0` event reads
+            # (which MEAN "from scratch"), a rev-0 resume token claims
+            # "I have seen everything through revision 0", and events
+            # 1..floor-1 are unreplayable, so the proof fails
+            return False
+        return True
+
     def watch_async(self, since: int, notify,
-                    now: Optional[float] = None):
+                    now: Optional[float] = None, resume=None):
         """The selector server's watch half: answer immediately when a
         client-visible event past `since` (or a truncation) is already
         pending — returns ``(response, None)`` — else park by
@@ -467,17 +517,18 @@ class ClusterState:
             self._expire(now)
             if (since and since + 1 < self._events_floor) \
                     or self._last_client_rev > since:
-                return self._watch_answer(since, now), None
+                return self._watch_answer(since, now, resume), None
             token = self._waiter_seq()
             self._async_waiters[token] = (since, notify)
             return None, token
 
-    def watch_answer(self, since: int, now: Optional[float] = None) -> dict:
+    def watch_answer(self, since: int, now: Optional[float] = None,
+                     resume=None) -> dict:
         """The parked watch's answer (event fired or timeout lapsed)."""
         now = time.monotonic() if now is None else now
         with self._lock:
             self._expire(now)
-            return self._watch_answer(int(since), now)
+            return self._watch_answer(int(since), now, resume)
 
     def cancel_watch(self, token) -> None:
         if token is None:
@@ -758,6 +809,11 @@ class ClusterState:
         another whole TTL."""
         now = time.monotonic() if now is None else now
         with self._lock:
+            # resume-proof floor: everything at or below THIS revision
+            # is contiguously in our log from the pre-promotion
+            # lineage; an older-term watch token above it names events
+            # we cannot prove we hold (see `_resume_ok`)
+            self._resume_floor = self._rev
             self.term = max(self.term + 1, int(new_term))
             shipped = self._shipped_deadlines
             for lease in self._leases.values():
@@ -872,7 +928,8 @@ def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
         return {"type": "events", **state.events_since(int(msg.get("since", 0)))}
     if kind == "watch":
         out = state.watch(int(msg.get("since", 0)),
-                          float(msg.get("timeout_s", 10.0)))
+                          float(msg.get("timeout_s", 10.0)),
+                          resume=msg.get("resume"))
         return {"type": "watch", **out}
     if kind == "invalidate":
         return {"type": "ok", **state.invalidate(msg["table"])}
@@ -1760,6 +1817,7 @@ def _park_watch(node: ClusterNode, loop, conn, msg: dict) -> None:
     timeout} fires first replies; the other is a no-op."""
     state = node.state
     since = int(msg.get("since", 0))
+    resume = msg.get("resume")
     timeout_s = max(0.0, min(float(msg.get("timeout_s", 10.0)),
                              _WATCH_TIMEOUT_CAP_S))
     done = {"sent": False}
@@ -1775,10 +1833,11 @@ def _park_watch(node: ClusterNode, loop, conn, msg: dict) -> None:
         state.cancel_watch(holder["token"])
         if conn.closed:
             return  # the watcher hung up while parked
-        conn.reply(msg, {"type": "watch", **state.watch_answer(since)})
+        conn.reply(msg, {"type": "watch",
+                         **state.watch_answer(since, resume=resume)})
 
     resp, token = state.watch_async(
-        since, notify=lambda: loop.call_soon(finish)
+        since, notify=lambda: loop.call_soon(finish), resume=resume
     )
     if resp is not None:
         conn.reply(msg, {"type": "watch", **resp})

@@ -75,6 +75,19 @@ class QueryDeadlineError(ExecutionError):
     NOT transient: retrying cannot create time."""
 
 
+class QueryShedError(ExecutionError):
+    """The serving front door (datafusion_tpu/serve.py) refused to
+    admit a query — queue at depth, deadline infeasible, or no HBM
+    headroom even after eviction.  Deliberately NOT transient at this
+    layer: shedding IS the backpressure signal, and an in-process
+    retry loop would defeat it.  `reason` is one of "queue",
+    "deadline", "hbm", "shutdown"."""
+
+    def __init__(self, message: str, reason: str = "queue"):
+        super().__init__(message)
+        self.reason = reason
+
+
 class ClusterNotPrimaryError(TransientError, ExecutionError):
     """A cluster-service replica refused the request because it is not
     the primary.  Transient by construction — retrying against another

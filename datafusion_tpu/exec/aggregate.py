@@ -46,6 +46,8 @@ contract); MIN/MAX keep the argument dtype.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 from typing import Iterator, Optional
 
 import jax
@@ -72,6 +74,28 @@ DENSE_GROUP_MAX = 64
 
 # widen narrow wire-format group ids back to int32 on device
 _WIDEN_IDS_JIT = jax.jit(lambda w: w.astype(jnp.int32))
+
+# serving-path lowering mode (datafusion_tpu/serve.py): keep the
+# predicate IN the device core (as parameter slots) instead of routing
+# host-evaluable predicates to the host.  Cross-query megabatching
+# needs every query in a fused launch to share one device program and
+# one set of device inputs; per-query host masks would fork the inputs
+# per query.  Contextvar-scoped so a serving dispatch never changes how
+# a concurrent ordinary query lowers.
+_FORCE_CORE_PRED: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    "datafusion_tpu_force_core_pred", default=False
+)
+
+
+@contextlib.contextmanager
+def force_core_predicate():
+    """Scope in which AggregateRelation keeps predicates in the device
+    core (serving megabatch lowering — see comment above)."""
+    tok = _FORCE_CORE_PRED.set(True)
+    try:
+        yield
+    finally:
+        _FORCE_CORE_PRED.reset(tok)
 
 
 def _pallas_agg_max() -> int:
@@ -495,6 +519,12 @@ class _AggregateCore:
         # fused-pass batch-group fold (exec/fused.py): ONE launch per
         # shape-homogeneous group of prepared batches
         self.group_jit = jax.jit(self._fused_group)
+        # cross-QUERY megabatch fold (datafusion_tpu/serve.py): one
+        # launch runs the batch-group fold for N concurrent queries
+        # that share this core (same plan shape, different literal
+        # params) over ONE set of device inputs, returning one state
+        # per query — the launch/sync floor amortizes across clients
+        self.multi_group_jit = jax.jit(self._multi_fused_group)
 
     def _fused_kernel(self, chunk, state, params):
         """Fold `_kernel` over a chunk of prepared batches in ONE device
@@ -950,6 +980,17 @@ class _AggregateCore:
             counts, accs, batch_keys, cat, payload_of, str_aux
         )
 
+    def _multi_fused_group(self, entries, states, aux, str_aux, params_list):
+        """ONE device launch for N queries × one batch group: the
+        serving megabatch (serve.py).  Every query folds the SAME
+        stacked entries — XLA shares the input plumbing across the N
+        sub-folds — under its own literal params and accumulator state;
+        results de-multiplex per query as a tuple of states."""
+        return tuple(
+            self._fused_group(entries, st, aux, str_aux, ps)
+            for st, ps in zip(states, params_list)
+        )
+
     def _dense_update(self, env, capacity, mask, ids, counts, accs, str_aux=()):
         """Small-group path: segment reduction against a one-hot
         [rows, G] membership matrix.  Float sums and all counts stack
@@ -1167,6 +1208,7 @@ class AggregateRelation(Relation):
         host_pred = (
             predicate is not None
             and _is_accelerator(device)
+            and not _FORCE_CORE_PRED.get()
             and host_evaluable(predicate, {}, child.schema)
         )
         self._host_pred_expr = predicate if host_pred else None
@@ -1415,6 +1457,14 @@ class AggregateRelation(Relation):
 
         from datafusion_tpu.obs.stats import iter_stats
 
+        # serving megabatch (serve.py): the cross-query fused launch
+        # already produced this relation's state — consume it so the
+        # normal batches()/finalize path (result capture, telemetry)
+        # runs unchanged on top
+        injected = self.__dict__.pop("_injected_state", None)
+        if injected is not None:
+            return injected
+
         src = iter(iter_stats(self.child))
         first = next(src, None)
         if first is None:
@@ -1604,6 +1654,22 @@ class AggregateRelation(Relation):
             core = self.core
         if self._host_pred_expr is None and len(core.used_cols) == batch.num_columns:
             return batch
+        if self._host_pred_expr is None:
+            # no per-query mask in the view: it depends only on the
+            # core's used columns, so share it (and, downstream, the
+            # device copies device_inputs caches on it) across EVERY
+            # relation over this batch — a warm repeated or concurrent
+            # query re-uses the same pinned device buffers instead of
+            # re-shipping per-query arrays (the serving-path refactor;
+            # subset_view caches by column tuple, not by relation).
+            # Trade accepted: a long-lived batch now retains one view
+            # (and its device copies) per distinct used-column set —
+            # bounded by query-shape diversity, the same discipline
+            # PipelineRelation's subset_view has always had; pin
+            # eviction clears the whole cache when HBM needs the room
+            from datafusion_tpu.exec.batch import subset_view
+
+            return subset_view(batch, core.used_cols, tag="agg_subset")
         key = "agg_view"
         hit = batch.cache.get(key)
         if hit is not None and hit[0] is self and hit[1] is core:

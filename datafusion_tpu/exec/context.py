@@ -57,10 +57,12 @@ from datafusion_tpu.sql.planner import SqlToRel, convert_data_type
 from datafusion_tpu.utils.metrics import METRICS
 
 # admission/backpressure counter contract for the serving path
-# (ROADMAP item 2): `queries_admitted` counts here and now (every root
-# query that enters execute); `queries_queued`/`queries_shed` are
-# declared stubs the async front door will increment — dashboards and
-# the fleet aggregator bind to these names today.
+# (datafusion_tpu/serve.py): `queries_admitted` counts here (every
+# root query that enters execute); the serving front door increments
+# `queries_queued` on every admitted enqueue and `queries_shed` on
+# every refusal (queue depth, deadline infeasibility, HBM headroom),
+# so admitted + shed == submitted.  Declared so all three names render
+# in every scrape from process start, served or not.
 METRICS.declare("queries_admitted", "queries_queued", "queries_shed")
 
 
@@ -648,6 +650,15 @@ class ExecutionContext:
                 [None if v is None else v[: physical_plan.count] for v in table.validity],
             )
         raise ExecutionError(f"unknown physical plan kind {kind!r}")
+
+    def serve(self, **kwargs):
+        """A started serving front door over this context
+        (datafusion_tpu/serve.Server): bounded admission, HBM-pinned
+        resident tables, cross-query plan megabatching.  Keyword
+        arguments override the ``DATAFUSION_TPU_SERVE_*`` env knobs."""
+        from datafusion_tpu import serve as _serve
+
+        return _serve.Server(self, **kwargs).start()
 
     def metrics(self) -> dict:
         return METRICS.snapshot()

@@ -180,8 +180,40 @@ class _TopKCore:
         # the whole scan's merge is ONE launch, and the traced body is
         # one kernel, not one per batch (exec/fused.py)
         self.group_jit = jax.jit(self._fused_group, static_argnums=(0,))
+        # final-group fold + result-mask merge in ONE launch: the scan's
+        # last batch group folds AND the (live-mask, row-ids) result
+        # state collapses to a single int64 array inside the same
+        # program — the host then pulls ONE array, where the old tail
+        # paid a separate blob-pack launch just to ship the live mask
+        # beside the rows (the PR 6 follow-on: one fewer device launch
+        # per TopK pass)
+        self.group_final_jit = jax.jit(self._group_final,
+                                       static_argnums=(0,))
+        self.final_jit = jax.jit(self._final_merge)
         # per-column codec memory for put_compressed (see batch.py)
         self.wire_hints: dict = {}
+
+    def _final_merge(self, state):
+        """Fold the top-k state's (live mask, global row ids) — plus
+        the wide path's collision flag — into ONE int64 array:
+        [flag, row_id_or_-1 x k].  Dead slots merge to -1, so the host
+        recovers the mask as `merged >= 0` from a single transfer."""
+        if self.wide:
+            _, live, rows, flag = state
+            header = flag.astype(jnp.int64)[None]
+        else:
+            live, rows = state[-2], state[-1]
+            header = jnp.zeros(1, jnp.int64)
+        return jnp.concatenate(
+            [header, jnp.where(live, rows, jnp.int64(-1))]
+        )
+
+    def _group_final(self, k, state, entries, rank_tables):
+        """The scan's LAST group fold fused with the result merge (see
+        `_final_merge`) — one launch ends the pass."""
+        return self._final_merge(
+            self._fused_group(k, state, entries, rank_tables)
+        )
 
     def _fused_group(self, k, state, entries, rank_tables):
         from datafusion_tpu.exec.fused import stack_entries
@@ -865,33 +897,57 @@ class SortRelation(Relation):
             next_base += batch.capacity
             if len(chunk) >= fuse:
                 flush()
-        flush()
-        if state is None:
-            yield self._empty_result(in_schema, dicts)
-            return
         from datafusion_tpu.exec.batch import device_pull
 
-        if core.wide:
-            _, live, rows, flag = state
-            # ONE blob-packed transfer for the whole k-row result
-            live, rows, flag = device_pull((live, rows, flag))
-        else:
-            _, live, rows = state
-            live, rows = device_pull((live, rows))
-        if core.wide and bool(np.asarray(flag)):
-            # an integer key touched the sentinel ladder (values at the
-            # extreme two of the 2^64 range): replay the scan through
-            # the exact sort path — datasources are re-iterable
-            METRICS.add("sort.wide_fallbacks")
-            yield from self._topk_batches(
-                _TopKCore.build(self._key_plans, force_general=True)
-            )
+        if state is None and not chunk:
+            yield self._empty_result(in_schema, dicts)
             return
-        # the live bit separates real rows from dead-key padding when
-        # the scan produced fewer than k rows; the state is bucket-sized,
-        # so slice down to the actual LIMIT
-        take = np.nonzero(np.asarray(live))[0][: self.limit]
-        win = np.asarray(rows)[take]
+        if fused_mode:
+            # fused tail: the last batch group folds AND the result
+            # (live-mask, rows) merge happens inside ONE launch
+            # (`group_final_jit`) — the old path paid a separate
+            # blob-pack launch just to pull the mask beside the rows
+            packed = self._final_flush(core, chunk, state)
+            chunk.clear()
+            packed_h = np.asarray(device_pull(packed))
+            if core.wide and bool(packed_h[0]):
+                METRICS.add("sort.wide_fallbacks")
+                yield from self._topk_batches(
+                    _TopKCore.build(self._key_plans, force_general=True)
+                )
+                return
+            merged = packed_h[1:]
+            # dead slots merged to -1; live rows keep their (sorted)
+            # positions, so positional nonzero matches the old mask
+            take = np.nonzero(merged >= 0)[0][: self.limit]
+            win = merged[take]
+        else:
+            flush()
+            if state is None:
+                yield self._empty_result(in_schema, dicts)
+                return
+            if core.wide:
+                _, live, rows, flag = state
+                # ONE blob-packed transfer for the whole k-row result
+                live, rows, flag = device_pull((live, rows, flag))
+            else:
+                _, live, rows = state
+                live, rows = device_pull((live, rows))
+            if core.wide and bool(np.asarray(flag)):
+                # an integer key touched the sentinel ladder (values at
+                # the extreme two of the 2^64 range): replay the scan
+                # through the exact sort path — datasources are
+                # re-iterable
+                METRICS.add("sort.wide_fallbacks")
+                yield from self._topk_batches(
+                    _TopKCore.build(self._key_plans, force_general=True)
+                )
+                return
+            # the live bit separates real rows from dead-key padding
+            # when the scan produced fewer than k rows; the state is
+            # bucket-sized, so slice down to the actual LIMIT
+            take = np.nonzero(np.asarray(live))[0][: self.limit]
+            win = np.asarray(rows)[take]
         # host payload gather: global row id -> (source batch, local row)
         base_arr = np.asarray(bases, dtype=np.int64)
         b_idx = np.searchsorted(base_arr, win, side="right") - 1
@@ -918,6 +974,42 @@ class SortRelation(Relation):
             self._schema, out_cols, out_valid,
             [dicts[i] for i in self._out_cols],
         )
+
+    def _final_flush(self, core, chunk, state):
+        """Dispatch the scan's remaining batch groups, fusing the LAST
+        one with the result merge (`_TopKCore._group_final`) so the
+        pass ends in one launch whose single int64 output carries rows
+        and live mask together.  With an empty tail chunk the merge
+        alone dispatches (`final_jit`) — still one launch, replacing
+        the blob-pack launch the multi-array pull used to cost."""
+        from datafusion_tpu.exec.fused import iter_groups, pad_group
+        from datafusion_tpu.obs.stats import op_timer
+
+        k = self._kb
+        with METRICS.timer("execute.sort"), op_timer(self), \
+                _device_scope(self.device):
+            if not chunk:
+                return device_call(core.final_jit, state,
+                                   _tag="topk.final")
+            entries = [(c[0], c[1], c[2], c[3], c[4], c[6]) for c in chunk]
+            shareds = [c[5] for c in chunk]
+            groups = list(iter_groups(entries, shareds))
+            for gi, (idxs, ranks) in enumerate(groups):
+                group = pad_group(
+                    [entries[i] for i in idxs],
+                    lambda e: (e[0], e[1], e[2], np.int32(0), e[4], e[5]),
+                )
+                METRICS.add("fused.groups")
+                METRICS.add("fused.group_batches", len(idxs))
+                if gi == len(groups) - 1:
+                    return device_call(
+                        core.group_final_jit, k, state, tuple(group),
+                        ranks, _tag="topk.final",
+                    )
+                state = device_call(
+                    core.group_jit, k, state, tuple(group), ranks,
+                    _tag="topk.group",
+                )
 
     def _key_view(self, batch: RecordBatch, core) -> RecordBatch:
         """The batch as TopK kernels see it: only the key columns (the

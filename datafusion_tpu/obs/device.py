@@ -155,6 +155,25 @@ class _Entry:
         self.arr_id = arr_id
 
 
+class _PinEntry:
+    """One ledger-owned pinned resident (see the pin section below)."""
+
+    __slots__ = ("fingerprint", "owner", "priority", "on_evict", "artifact",
+                 "nbytes", "uses", "last_used", "pinned_at")
+
+    def __init__(self, fingerprint: str, owner: str, priority: int,
+                 on_evict, artifact):
+        self.fingerprint = fingerprint
+        self.owner = owner
+        self.priority = int(priority)
+        self.on_evict = on_evict
+        self.artifact = artifact
+        self.nbytes = 0
+        self.uses = 0
+        self.last_used = time.monotonic()
+        self.pinned_at = time.monotonic()
+
+
 class DeviceLedger:
     """Process-wide registry of live device buffers (see module doc).
 
@@ -347,6 +366,10 @@ class DeviceLedger:
         above the link baseline and mislead the overlap-vs-encoding
         diagnosis the events exist for."""
         METRICS.observe("h2d.dispatch", seconds)
+        # event COUNT beside the byte counter: the serving path's
+        # warm-pinned-table contract is "zero transfers", and a count
+        # is assertable where a ring of flight events is not
+        METRICS.add("device.h2d.transfers")
         from datafusion_tpu.obs.stats import record_h2d_time
 
         record_h2d_time(seconds)
@@ -435,6 +458,8 @@ class DeviceLedger:
             "owners": self.owners(),
             "devices": self.devices(),
             "leaks_reported": self.leaks_reported,
+            "pinned_bytes": self.pinned_bytes(),
+            "pins": self.pins_snapshot(),
         }
 
     # -- leak detection ------------------------------------------------
@@ -494,6 +519,168 @@ class DeviceLedger:
         self._peak = 0
         self._window_peak = None
         self.leaks_reported = 0
+        pins = getattr(self, "_pins", None)
+        if pins is not None:
+            pins.clear()
+            METRICS.gauge("device.hbm.pinned_bytes", 0)
+
+    # -- pinned residents: the ledger as ALLOCATOR ---------------------
+    # The serving path (datafusion_tpu/serve.py, ROADMAP item 2)
+    # promotes hot tables from per-query transients to first-class
+    # ledger-OWNED residents: a fingerprint -> pinned-artifact map whose
+    # entries survive across queries, are accounted as
+    # ``device.hbm.pinned_bytes``, and are evicted HERE — by owner
+    # priority, then least-recent use — when admission needs headroom.
+    # The artifact is opaque to the ledger (serve pins its resident
+    # batch list); ``on_evict`` is the owner's release hook: dropping
+    # the artifact reference lets the buffers' finalizers run, so
+    # live_bytes falls through the same weakref accounting every other
+    # buffer uses.  Pin mutations take a small lock (admission/eviction
+    # are control-plane paths, never inside the lock-free put/adopt
+    # hot path).
+
+    def _pin_lock(self):
+        lock = getattr(self, "_pins_lock", None)
+        if lock is None:
+            from datafusion_tpu.analysis import lockcheck
+
+            lock = self._pins_lock = lockcheck.make_lock("obs.device_pins")
+        return lock
+
+    def _pin_map(self) -> dict:
+        pins = getattr(self, "_pins", None)
+        if pins is None:
+            pins = self._pins = {}
+        return pins
+
+    def pin(self, fingerprint: str, nbytes: int = 0, owner: str = "pin",
+            priority: int = 0, on_evict=None, artifact: Any = None) -> None:
+        """Register (or refresh) a pinned resident under `fingerprint`.
+        Re-pinning an existing fingerprint updates its artifact/bytes
+        in place and keeps its use count."""
+        with self._pin_lock():
+            pins = self._pin_map()
+            e = pins.get(fingerprint)
+            if e is None:
+                e = pins[fingerprint] = _PinEntry(
+                    fingerprint, owner, priority, on_evict, artifact
+                )
+                METRICS.add("device.pins")
+                _flight_record("device.pin", fingerprint=fingerprint,
+                               owner=owner, bytes=int(nbytes))
+            else:
+                e.owner = owner
+                e.on_evict = on_evict if on_evict is not None else e.on_evict
+                e.artifact = artifact if artifact is not None else e.artifact
+            e.nbytes = int(nbytes)
+            e.priority = max(e.priority, int(priority))
+            self._pin_gauge(pins)
+
+    def pinned(self, fingerprint: str):
+        """The pinned artifact for `fingerprint` (None when absent).
+        Touches the entry: use count and recency feed eviction order."""
+        with self._pin_lock():
+            e = self._pin_map().get(fingerprint)
+            if e is None:
+                return None
+            e.uses += 1
+            e.priority = max(e.priority, e.uses)
+            e.last_used = time.monotonic()
+            return e.artifact
+
+    def set_pin_bytes(self, fingerprint: str, nbytes: int) -> None:
+        """Update a pin's measured byte accounting (serve re-measures
+        after the first query uploads the resident device copies)."""
+        with self._pin_lock():
+            pins = self._pin_map()
+            e = pins.get(fingerprint)
+            if e is not None:
+                e.nbytes = int(nbytes)
+                self._pin_gauge(pins)
+
+    def unpin(self, fingerprint: str, reason: str = "unpin") -> bool:
+        """Drop one pin (calling its owner's release hook)."""
+        with self._pin_lock():
+            pins = self._pin_map()
+            e = pins.pop(fingerprint, None)
+            self._pin_gauge(pins)
+        if e is None:
+            return False
+        self._evict_entry(e, reason)
+        return True
+
+    def _evict_entry(self, e: "_PinEntry", reason: str) -> None:
+        METRICS.add("device.pin_evictions")
+        _flight_record("device.pin_evict", fingerprint=e.fingerprint,
+                       owner=e.owner, bytes=e.nbytes, reason=reason)
+        cb = e.on_evict
+        e.artifact = None
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — owner cleanup must not break eviction
+                METRICS.add("device.pin_evict_errors")
+
+    def evict_pins(self, need_bytes: int, exclude=()) -> int:
+        """Free at least `need_bytes` of pinned residency by dropping
+        pins in (priority, least-recently-used) order.  `exclude`
+        names fingerprints that must survive (a query's OWN resident
+        tables — evicting them to admit that query would both overshoot
+        and force the cold re-scan pinning exists to avoid).  Returns
+        the accounted bytes freed (the buffers themselves release via
+        their finalizers once the owner drops its references)."""
+        victims: list[_PinEntry] = []
+        skip = frozenset(exclude)
+        with self._pin_lock():
+            pins = self._pin_map()
+            order = sorted(pins.values(),
+                           key=lambda e: (e.priority, e.last_used))
+            freed = 0
+            for e in order:
+                if freed >= need_bytes:
+                    break
+                if e.fingerprint in skip:
+                    continue
+                pins.pop(e.fingerprint, None)
+                victims.append(e)
+                freed += e.nbytes
+            self._pin_gauge(pins)
+        for e in victims:
+            self._evict_entry(e, "pressure")
+        return sum(e.nbytes for e in victims)
+
+    def pinned_bytes(self) -> int:
+        pins = getattr(self, "_pins", None)
+        if not pins:
+            return 0
+        return sum(e.nbytes for e in list(pins.values()))
+
+    def pins_snapshot(self) -> dict:
+        """{fingerprint: {owner, bytes, priority, uses}} for the debug
+        plane and the ``\\hbm`` console view."""
+        pins = getattr(self, "_pins", None)
+        if not pins:
+            return {}
+        return {
+            fp: {"owner": e.owner, "bytes": e.nbytes,
+                 "priority": e.priority, "uses": e.uses}
+            for fp, e in list(pins.items())
+        }
+
+    def _pin_gauge(self, pins: dict) -> None:
+        METRICS.gauge(
+            "device.hbm.pinned_bytes",
+            sum(e.nbytes for e in pins.values()),
+        )
+
+    def headroom(self) -> Optional[int]:
+        """HBM bytes available before the measured capacity is reached
+        (None when capacity is unknowable — admission then never sheds
+        on memory, matching the SLO's stay-dormant rule)."""
+        cap = hbm_capacity_bytes()
+        if cap is None:
+            return None
+        return cap - self.live_bytes()
 
     # -- rendering -----------------------------------------------------
     def report_text(self) -> str:
@@ -512,6 +699,12 @@ class DeviceLedger:
             lines.append(
                 f"  owner {owner}: {_fmt_bytes(d['bytes'])} "
                 f"in {d['buffers']} buffer(s)"
+            )
+        for fp, p in sorted(snap["pins"].items(),
+                            key=lambda kv: -kv[1]["bytes"]):
+            lines.append(
+                f"  pinned {fp}: {_fmt_bytes(p['bytes'])} "
+                f"(owner {p['owner']}, uses {p['uses']})"
             )
         if snap["leaks_reported"]:
             lines.append(f"  leaks reported: {snap['leaks_reported']}")

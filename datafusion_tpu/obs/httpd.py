@@ -196,6 +196,8 @@ _INDEX = """datafusion-tpu debug plane ({label})
 GET /debug/metrics            Prometheus text exposition (alias /metrics)
 GET /debug/flights[?trace_id=]  flight-recorder ring dump (JSON)
 GET /debug/hbm                HBM residency ledger breakdown (JSON)
+GET /debug/serve              serving front door: admission counters,
+                              pinned tables, megabatch stats (JSON)
 GET /debug/top                fleet/local top view (text)
 GET /debug/profile?seconds=N[&hz=H&format=speedscope|collapsed|json]
 GET /debug/bundle[?seconds=N&trace_id=]  one artifact: everything above
@@ -280,6 +282,32 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
         if _device.enabled():
             return _json_body({"enabled": True, **LEDGER.snapshot()})
         return _json_body({"enabled": False})
+    if path == "/debug/serve":
+        from datafusion_tpu.obs.aggregate import HISTOGRAMS
+        from datafusion_tpu.obs.device import LEDGER
+
+        counts = METRICS.snapshot()["counts"]
+        h = HISTOGRAMS.get("serve.latency")
+        return _json_body({
+            "node": srv.label,
+            "queries_admitted": counts.get("queries_admitted", 0),
+            "queries_queued": counts.get("queries_queued", 0),
+            "queries_shed": counts.get("queries_shed", 0),
+            "megabatch_launches": counts.get(
+                "serve.megabatch_launches", 0),
+            "megabatch_queries": counts.get(
+                "serve.megabatch_queries", 0),
+            "tables_pinned": counts.get("serve.tables_pinned", 0),
+            "tables_evicted": counts.get("serve.tables_evicted", 0),
+            "pin_evictions": counts.get("device.pin_evictions", 0),
+            "pinned_bytes": LEDGER.pinned_bytes(),
+            "pins": LEDGER.pins_snapshot(),
+            "latency": None if h is None else {
+                "count": h.count,
+                "p50_s": h.quantile(0.5),
+                "p99_s": h.quantile(0.99),
+            },
+        })
     if path == "/debug/top":
         return _text_body(srv.top())
     if path == "/debug/profile":

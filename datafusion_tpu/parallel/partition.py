@@ -602,15 +602,28 @@ def _partitioned_jits(core, mesh):
                 )
         return fin_counts, tuple(fin_accs)
 
-    stacked_jit = jax.jit(
-        shard_map(
-            stacked_update,
-            mesh=mesh,
-            in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh, spec_sh,
-                      spec_sh, spec_rep, spec_rep),
-            out_specs=spec_sh,
-        ),
+    stacked_sm = shard_map(
+        stacked_update,
+        mesh=mesh,
+        in_specs=(spec_sh, spec_sh, spec_rep, spec_sh, spec_sh, spec_sh,
+                  spec_sh, spec_rep, spec_rep),
+        out_specs=spec_sh,
     )
+    stacked_jit = jax.jit(stacked_sm)
+
+    # multi-ROUND fold (the PR 6 batch-group fold lifted to mesh
+    # rounds): consecutive warm rounds of one shape class — their
+    # padded shard stacks already device-resident in the round cache —
+    # fold through the shard_map'd update inside ONE jitted program,
+    # so a warm repeated mesh query pays one launch per shape class
+    # instead of one per round.
+    def multi_rounds(rounds, state, params):
+        for (cols, valids, aux, num_rows, masks, ids, str_aux) in rounds:
+            state = stacked_sm(cols, valids, aux, num_rows, masks, ids,
+                               state, str_aux, params)
+        return state
+
+    multi_jit = jax.jit(multi_rounds)
     combine_jit = jax.jit(
         shard_map(
             combine,
@@ -619,7 +632,7 @@ def _partitioned_jits(core, mesh):
             out_specs=spec_rep,
         )
     )
-    hit = cache[key] = (stacked_jit, combine_jit)
+    hit = cache[key] = (stacked_jit, combine_jit, multi_jit)
     return hit
 
 
@@ -674,9 +687,13 @@ class PartitionedAggregateRelation(AggregateRelation):
         # methods and re-trace + re-compile the whole mesh program
         # every run (~seconds per query — the round-4 mesh-aggregate
         # gap was mostly exactly this)
-        self._stacked_jit, self._combine_jit = _partitioned_jits(
-            self.core, mesh
+        self._stacked_jit, self._combine_jit, self._multi_jit = (
+            _partitioned_jits(self.core, mesh)
         )
+        # cached zero-rows vector for dead-round padding (multi-round
+        # fold pads to the group-size ladder; a zero row count makes a
+        # round's every shard an identity contribution)
+        self._zero_rows = None
 
     # -- stacked state management --
     def _init_stacked_state(self, capacity: int):
@@ -741,11 +758,65 @@ class PartitionedAggregateRelation(AggregateRelation):
         # the contextvar already being set) the device_call backoffs
         deadline = current_deadline()
 
+        # multi-round fold buffer (fused-pass mode): consecutive WARM
+        # rounds with one shape class collect here and dispatch as one
+        # launch through `self._multi_jit`; cold rounds, shape-class
+        # changes, and state growth flush first.
+        from datafusion_tpu.exec.fused import (
+            entry_signature,
+            fuse_group_max,
+            fusion_enabled,
+            pad_group,
+            shared_signature,
+        )
+
+        fused_mode = fusion_enabled()
+        round_fuse_max = fuse_group_max()
+        round_buf: list = []
+        round_sig = None
+
+        def flush_rounds():
+            nonlocal state
+            if not round_buf:
+                return
+            if len(round_buf) == 1:
+                (put_cols, put_valids, aux, rows_dev, put_mask, put_ids,
+                 str_aux) = round_buf[0]
+                with METRICS.timer("execute.partitioned_aggregate"), \
+                        op_timer(self):
+                    state = device_call(
+                        self._stacked_jit, put_cols, put_valids, aux,
+                        rows_dev, put_mask, put_ids, state, str_aux,
+                        self._params, _tag="mesh.stacked",
+                    )
+                round_buf.clear()
+                return
+            if self._zero_rows is None:
+                self._zero_rows = jnp.zeros(self.n_shards, jnp.int32)
+            zero = self._zero_rows
+            group = pad_group(
+                list(round_buf),
+                # dead round: the live round's stacks with a zero row
+                # count — every shard contributes identity
+                lambda r: (r[0], r[1], r[2], zero, r[4], r[5], r[6]),
+            )
+            rounds = tuple(group)
+            METRICS.add("mesh.fused_round_launches")
+            METRICS.add("mesh.fused_rounds", len(round_buf))
+            with METRICS.timer("execute.partitioned_aggregate"), \
+                    op_timer(self):
+                state = device_call(
+                    self._multi_jit, rounds, state, self._params,
+                    _tag="mesh.multi",
+                )
+            round_buf.clear()
+
         while True:
             if deadline is not None:
                 deadline.check("partitioned aggregate round")
             round_batches = [f.next_batch() for f in feeds]
             if all(b is None for b in round_batches):
+                flush_rounds()
                 break
             # one capacity for the whole round so shards stack
             cap = max(
@@ -768,7 +839,9 @@ class PartitionedAggregateRelation(AggregateRelation):
                 # warm round: the padded shard stacks are already on
                 # their mesh devices (and the group ids this relation's
                 # encoder assigned are append-stable, so they replay
-                # exactly); only the state update kernel runs
+                # exactly); only the state update kernel runs.  In
+                # fused-pass mode consecutive warm rounds of one shape
+                # class BUFFER and fold into one multi-round launch.
                 METRICS.add("mesh.round_cache_hits")
                 (_, put_cols, put_valids, aux, rows_dev, put_mask,
                  put_ids, str_aux) = hit
@@ -777,16 +850,33 @@ class PartitionedAggregateRelation(AggregateRelation):
                     group_cap = needed
                     state = self._init_stacked_state(group_cap)
                 elif needed > group_cap:
+                    flush_rounds()  # state is about to change shape
                     state = self._grow_stacked_state(state, needed)
                     group_cap = needed
-                with METRICS.timer("execute.partitioned_aggregate"), \
-                        op_timer(self):
-                    state = device_call(
-                        self._stacked_jit, put_cols, put_valids, aux,
-                        rows_dev, put_mask, put_ids, state, str_aux,
-                        self._params, _tag="mesh.stacked",
-                    )
+                entry = (put_cols, put_valids, aux, rows_dev, put_mask,
+                         put_ids, str_aux)
+                if not fused_mode:
+                    with METRICS.timer("execute.partitioned_aggregate"), \
+                            op_timer(self):
+                        state = device_call(
+                            self._stacked_jit, put_cols, put_valids, aux,
+                            rows_dev, put_mask, put_ids, state, str_aux,
+                            self._params, _tag="mesh.stacked",
+                        )
+                    continue
+                sig = (
+                    entry_signature((put_cols, put_valids, rows_dev,
+                                     put_mask, put_ids)),
+                    shared_signature((aux, str_aux)),
+                    group_cap,
+                )
+                if round_buf and (sig != round_sig
+                                  or len(round_buf) >= round_fuse_max):
+                    flush_rounds()
+                round_sig = sig
+                round_buf.append(entry)
                 continue
+            flush_rounds()  # cold round ahead: drain the warm buffer
             views = [
                 None if b is None else self._device_view(b)
                 for b in round_batches

@@ -1,0 +1,966 @@
+"""Concurrent-query serving front door (ROADMAP item 2).
+
+One ``ExecutionContext.execute`` call owning the device end-to-end caps
+the engine at the per-query sync floor (BENCH_r04 ``utilization``:
+~127 ms on tunneled transports).  This module is the path to "heavy
+traffic from millions of users": an async front door that admits,
+batches, and executes many clients' queries against one engine, built
+from three pieces the earlier PRs laid down as substrate:
+
+- **Admission control** — a bounded queue over the existing deadline
+  machinery, driven by the PR 11 selector event loop
+  (`utils/eventloop.ServerLoop`): every ``submit`` either enqueues
+  (``queries_queued``) or sheds (``queries_shed`` +
+  ``QueryShedError``) on queue depth, deadline infeasibility (the
+  remaining budget cannot cover the observed service EWMA), or HBM
+  headroom (capacity known, projected residency over it, eviction
+  could not make room).  Queries that reach ``ExecutionContext.execute``
+  count ``queries_admitted`` exactly as before, so
+  ``admitted + shed == submitted`` holds by construction — the
+  counters declared since PR 8 now record real decisions.
+
+- **HBM-pinned resident tables** — the PR 9 ledger promoted from
+  observer to allocator (`obs/device.DeviceLedger.pin/evict_pins`):
+  the first query over a table materializes it into a long-lived
+  resident batch list (``PinnedSource``), whose device copies —
+  uploaded once through the normal ``device_inputs`` caches — stay hot
+  across queries as a ledger-owned ``pin.<table>`` entry.  Warm
+  queries skip H2D entirely (``device.h2d.transfers`` stays flat);
+  admission checks ``LEDGER.headroom()`` and eviction runs by owner
+  priority, then least-recent use.
+
+- **Plan megabatching** — the PR 6 batch-group signature machinery
+  applied *across queries*: compatible concurrent plans (same compiled
+  core — i.e. same table, same shape class, literals parameterized
+  away) queued within one batching window fuse into ONE XLA launch
+  (`_AggregateCore.multi_group_jit`) over one set of pinned device
+  inputs, and the per-query accumulator states de-multiplex back to
+  their clients.  N users' queries pay one launch/sync floor, not N.
+
+Everything here is opt-in: nothing in the engine consults this module
+unless a ``Server`` is constructed (``DATAFUSION_TPU_SERVE=0`` is
+byte-identical to not importing it).  Env knobs, all prefixed
+``DATAFUSION_TPU_SERVE_``: ``QUEUE`` (pending-query depth, default
+64), ``WORKERS`` (executor width, default 2), ``WINDOW_MS`` (batching
+window, default 2), ``MEGABATCH`` (max queries fused per launch,
+default 16; 0 disables fusion), ``PIN`` (1 pins tables, 0 streams),
+``DEADLINE_S`` (default per-query budget; unset = none).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from datafusion_tpu.errors import QueryShedError
+from datafusion_tpu.exec.datasource import DataSource
+from datafusion_tpu.obs import recorder
+from datafusion_tpu.obs.device import LEDGER
+from datafusion_tpu.utils.deadline import Deadline, deadline_scope
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return default if not v else int(v)
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return default if not v else float(v)
+
+
+def enabled() -> bool:
+    """The master opt-in: ``DATAFUSION_TPU_SERVE=1``.  Consulted only
+    by conveniences (``ExecutionContext.serve``); the engine's own
+    paths never read it — serving is additive, not a mode switch."""
+    return os.environ.get("DATAFUSION_TPU_SERVE", "0") not in ("0", "")
+
+
+class Ticket:
+    """One submitted query's handle: ``result()`` blocks until the
+    server fulfills or fails it.  Exactly-once by construction — the
+    outcome slot is written exactly once, under the event."""
+
+    __slots__ = ("sql", "plan", "deadline", "submitted_mono", "_evt",
+                 "_table", "_error", "_rel", "signature")
+
+    def __init__(self, sql: str, plan, deadline: Optional[Deadline],
+                 signature):
+        self.sql = sql
+        self.plan = plan
+        self.deadline = deadline
+        self.signature = signature
+        self.submitted_mono = time.monotonic()
+        self._evt = threading.Event()
+        self._table = None
+        self._error: Optional[BaseException] = None
+        self._rel = None
+
+    @property
+    def done(self) -> bool:
+        return self._evt.is_set()
+
+    def _fulfill(self, table) -> None:
+        if not self._evt.is_set():
+            self._table = table
+            self._evt.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        if not self._evt.is_set():
+            self._error = exc
+            self._evt.set()
+
+    def result(self, timeout: Optional[float] = None):
+        """The materialized ``ResultTable`` (blocking), or raises the
+        query's error (``QueryShedError`` included)."""
+        if not self._evt.wait(timeout):
+            raise TimeoutError(f"query not done within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._table
+
+
+class PinnedSource(DataSource):
+    """A registered DataSource promoted to an HBM-pinnable resident.
+
+    Cold: streams the inner source.  ``ensure()`` materializes the
+    scan ONCE into a long-lived batch list and registers it with the
+    ledger (``LEDGER.pin``) under ``pin.<table>``; from then on every
+    query scans the SAME RecordBatch objects, so the device copies the
+    first query uploads (via the normal ``device_inputs`` per-batch
+    caches) serve every later query with zero H2D.  Eviction (ledger
+    pressure, ``unpin``) drops the resident list — buffers release
+    through their finalizers and the next query goes cold again.
+
+    Schema, wire meta, and therefore result-cache fingerprints all
+    delegate to the inner source: pinning is invisible to semantics.
+    """
+
+    def __init__(self, inner: DataSource, name: str):
+        from datafusion_tpu.analysis import lockcheck
+
+        self.inner = inner
+        self.name = name
+        self.fingerprint = f"table:{name}"
+        self._resident = None  # list[RecordBatch] | None
+        self._lock = lockcheck.make_lock("serve.pin_source")
+        # per-core shared execution state (group-key encoders, aux
+        # caches) so ids/aux computed by one query replay for every
+        # later or concurrent one; strong core refs keep id() stable
+        self._shared: dict = {}
+
+    @property
+    def schema(self):
+        return self.inner.schema
+
+    @property
+    def reusable_batches(self) -> bool:
+        # resident batches are the same objects every scan (the
+        # link-aware placement's "ship once, re-query forever" class)
+        return self._resident is not None or getattr(
+            self.inner, "reusable_batches", False
+        )
+
+    def to_meta(self) -> dict:
+        return self.inner.to_meta()
+
+    def with_projection(self, projection) -> "DataSource":
+        return _PinnedProjection(self, list(projection))
+
+    def estimated_bytes(self) -> int:
+        """Admission-time residency estimate: resident size when
+        materialized, else the backing file's size (0 when unknowable
+        — admission then never sheds for this table)."""
+        res = self._resident
+        if res is not None:
+            return _host_bytes(res)
+        path = getattr(self.inner, "path", None)
+        if path:
+            try:
+                return os.path.getsize(path)
+            except OSError:
+                return 0
+        batches = getattr(self.inner, "_batches", None)
+        if batches:
+            return _host_bytes(batches)
+        return 0
+
+    def ensure(self) -> bool:
+        """Materialize + pin (idempotent).  Returns True when resident."""
+        with self._lock:
+            if self._resident is not None:
+                LEDGER.pinned(self.fingerprint)  # touch: recency/priority
+                return True
+        # the scan runs OUTSIDE the lock (file-backed tables block on
+        # IO); a racing ensure may scan too — last writer loses, both
+        # results are equivalent
+        batches = list(self.inner.batches())
+        with self._lock:
+            if self._resident is None:
+                self._resident = batches
+            else:
+                batches = self._resident
+        nbytes = _host_bytes(batches)
+        LEDGER.pin(
+            self.fingerprint, nbytes=nbytes, owner=f"pin.{self.name}",
+            on_evict=self._drop, artifact=self,
+        )
+        METRICS.add("serve.tables_pinned")
+        recorder.record("serve.pin", table=self.name, bytes=nbytes,
+                        batches=len(batches))
+        return True
+
+    def _drop(self) -> None:
+        """Ledger eviction hook: release the resident batches and the
+        per-core shared state whose batch-keyed caches just became
+        unreachable.  The batches' derived-value caches are cleared
+        explicitly: an in-memory inner source holds the SAME batch
+        objects, so without the clear their device copies would stay
+        referenced (and resident) past the eviction."""
+        with self._lock:
+            res, self._resident = self._resident, None
+            self._shared.clear()
+        if res is not None:
+            for b in res:
+                b.cache.clear()
+        METRICS.add("serve.tables_evicted")
+        recorder.record("serve.evict", table=self.name)
+
+    @property
+    def resident(self) -> bool:
+        return self._resident is not None
+
+    def batches(self):
+        res = self._resident
+        if res is not None:
+            return iter(res)
+        return self.inner.batches()
+
+    def shared_state_for(self, core) -> dict:
+        """The cross-query execution state shared by every relation
+        compiled to `core` over this table: one append-only group-key
+        encoder (ids are stable, so per-batch id caches replay across
+        queries), shared aux/rank caches, and one lock serializing
+        encoder mutation across concurrently-executing relations."""
+        from datafusion_tpu.analysis import lockcheck
+        from datafusion_tpu.exec.aggregate import GroupKeyEncoder
+
+        with self._lock:
+            entry = self._shared.get(id(core))
+            if entry is None or entry["core"] is not core:
+                entry = self._shared[id(core)] = {
+                    "core": core,
+                    "encoder": GroupKeyEncoder(len(core.key_cols)),
+                    "aux": {},
+                    "str_aux": {},
+                    "lock": lockcheck.make_lock("serve.shared_ids"),
+                }
+            return entry
+
+
+class _PinnedProjection(DataSource):
+    """Column projection over a PinnedSource that PRESERVES batch
+    identity: projected views are built with ``subset_view`` and cached
+    on the parent batches, so the device copies uploaded against a
+    projection survive re-scans and other queries — a fresh
+    ``MemoryDataSource``-style copy per query would orphan them."""
+
+    def __init__(self, parent: PinnedSource, cols: list):
+        self.parent = parent
+        self.cols = cols
+        self._schema = parent.schema.select(cols)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    @property
+    def reusable_batches(self) -> bool:
+        return self.parent.reusable_batches
+
+    def with_projection(self, projection):
+        return _PinnedProjection(
+            self.parent, [self.cols[i] for i in projection]
+        )
+
+    def to_meta(self) -> dict:
+        return self.parent.inner.with_projection(self.cols).to_meta()
+
+    def batches(self):
+        from datafusion_tpu.exec.batch import subset_view
+
+        for b in self.parent.batches():
+            yield subset_view(b, self.cols, tag="pin_proj")
+
+
+def _host_bytes(batches) -> int:
+    total = 0
+    for b in batches:
+        for arr in list(b.data) + list(b.validity):
+            if isinstance(arr, np.ndarray):
+                total += arr.nbytes
+    return total
+
+
+def _pin_of(rel) -> Optional[PinnedSource]:
+    """The PinnedSource behind a relation's scan, if any."""
+    ds = getattr(getattr(rel, "child", None), "datasource", None)
+    if isinstance(ds, _PinnedProjection):
+        return ds.parent
+    if isinstance(ds, PinnedSource):
+        return ds
+    return None
+
+
+class Server:
+    """The serving front door over one ``ExecutionContext``.
+
+    Lifecycle: ``start()`` spins the dispatcher event loop on a daemon
+    thread; ``submit(sql)`` returns a `Ticket`; ``stop()`` drains (by
+    shedding) and shuts the loop down.  Also usable as a context
+    manager.  See the module docstring for the admission, pinning, and
+    megabatching semantics.
+    """
+
+    def __init__(self, ctx, workers: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 window_s: Optional[float] = None,
+                 megabatch_max: Optional[int] = None,
+                 pin: Optional[bool] = None,
+                 default_deadline_s: Optional[float] = None):
+        from datafusion_tpu.analysis import lockcheck
+        from datafusion_tpu.utils.eventloop import ServerLoop
+
+        self.ctx = ctx
+        self._workers = workers or _env_int("DATAFUSION_TPU_SERVE_WORKERS", 2)
+        self._queue_depth = queue_depth or _env_int(
+            "DATAFUSION_TPU_SERVE_QUEUE", 64
+        )
+        self._window_s = (
+            window_s if window_s is not None
+            else _env_float("DATAFUSION_TPU_SERVE_WINDOW_MS", 2.0) / 1e3
+        )
+        self._megabatch_max = (
+            megabatch_max if megabatch_max is not None
+            else _env_int("DATAFUSION_TPU_SERVE_MEGABATCH", 16)
+        )
+        if pin is None:
+            pin = os.environ.get("DATAFUSION_TPU_SERVE_PIN", "1") != "0"
+        self._pin_enabled = bool(pin)
+        if default_deadline_s is None:
+            default_deadline_s = _env_float(
+                "DATAFUSION_TPU_SERVE_DEADLINE_S", 0.0
+            ) or None
+        self._default_deadline_s = default_deadline_s
+        self._loop = ServerLoop(pool_size=self._workers,
+                                name="df-tpu-serve")
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._window: list[Ticket] = []          # loop thread only
+        self._window_timer = None                # loop thread only
+        self._lock = lockcheck.make_lock("serve.server")
+        self._pending = 0                        # queued, not yet executing
+        # queued-but-undispatched tickets, keyed by identity: stop()
+        # sheds these synchronously AFTER the loop thread is dead (a
+        # loop-side drain callback could be dropped by the shutdown
+        # race — the loop exits on its stop event before running
+        # pending callbacks)
+        self._queued_tickets: dict = {}
+        self._service_ewma_s: Optional[float] = None
+        # admission counters are process metrics; per-server totals
+        # make conservation (admitted + shed == submitted) assertable
+        # on one instance
+        self.submitted = 0
+        self.admitted = 0
+        self.shed = 0
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "Server":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop.run, name="df-tpu-serve", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._loop.stop()
+        if self._thread is not None:
+            self._loop.wait_stopped()
+            self._thread = None
+        # the loop thread is dead: every ticket still registered as
+        # queued (in the window, or in a dropped _enqueue callback)
+        # gets a prompt shutdown shed instead of hanging its client
+        with self._lock:
+            stranded = list(self._queued_tickets.values())
+            self._queued_tickets.clear()
+        for t in stranded:
+            if not t.done:
+                self._shed_ticket(t, "shutdown")
+        self._loop.close()
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- admission (caller thread) -------------------------------------
+    def submit(self, sql: str,
+               deadline_s: Optional[float] = None) -> Ticket:
+        """Admit one SQL query.  Returns a `Ticket`; raises
+        `QueryShedError` when admission refuses it (the counted,
+        flight-recorded backpressure decision)."""
+        from datafusion_tpu.errors import NotSupportedError
+        from datafusion_tpu.sql import ast
+        from datafusion_tpu.sql.parser import parse_sql
+
+        with METRICS.timer("parse"):
+            stmt = parse_sql(sql)
+        if isinstance(stmt, ast.SqlCreateExternalTable):
+            # DDL is control-plane work: run inline, fulfill instantly
+            # (not counted as submitted — only queries enter the
+            # admitted + shed == submitted conservation)
+            out = self.ctx._execute_ddl(stmt)
+            t = Ticket(sql, None, None, None)
+            t._fulfill(out)
+            return t
+        if isinstance(stmt, ast.SqlExplain):
+            raise NotSupportedError(
+                "EXPLAIN is an interactive statement; run it on the "
+                "context, not the serving front door"
+            )
+        # planning may raise (unknown table, unsupported SQL): a
+        # statement that never planned never entered admission, so it
+        # counts in NEITHER side of admitted + shed == submitted
+        plan = self.ctx._plan(stmt)
+        with self._lock:
+            self.submitted += 1
+        if self._closed:
+            raise self._shed_submit(sql, "shutdown")
+
+        # 1. deadline feasibility
+        deadline = None
+        budget = (deadline_s if deadline_s is not None
+                  else self._default_deadline_s)
+        if budget is not None:
+            ewma = self._service_ewma_s
+            if budget <= 0 or (ewma is not None and budget < 0.5 * ewma):
+                raise self._shed_submit(sql, "deadline")
+            deadline = Deadline.after(budget)
+        # 2. HBM headroom (capacity known, table not yet resident)
+        reason = self._check_hbm(plan)
+        if reason is not None:
+            raise self._shed_submit(sql, reason)
+
+        ticket = Ticket(sql, plan, deadline, self._mega_signature(plan))
+        # 3. queue depth — checked and RESERVED in one lock acquisition
+        # (a read-then-increment across two acquisitions would let N
+        # concurrent submitters all pass a depth-1 check), re-checking
+        # closed so a racing stop() can't strand a just-registered
+        # ticket after its shutdown drain ran
+        closed = False
+        with self._lock:
+            at_depth = self._pending >= self._queue_depth
+            if not at_depth:
+                self._pending += 1
+                self._queued_tickets[id(ticket)] = ticket
+                closed = self._closed
+        if at_depth:
+            raise self._shed_submit(sql, "queue")
+        if closed:
+            self._shed_ticket(ticket, "shutdown")
+            raise ticket._error
+        METRICS.add("queries_queued")
+        recorder.record("serve.queued", plan=type(plan).__name__)
+        self._loop.call_soon(partial(self._enqueue, ticket))
+        return ticket
+
+    def _shed_submit(self, sql: str, reason: str) -> QueryShedError:
+        with self._lock:
+            self.shed += 1
+        METRICS.add("queries_shed")
+        recorder.record("serve.shed", reason=reason)
+        return QueryShedError(
+            f"query shed at admission ({reason}): {sql[:80]!r}",
+            reason=reason,
+        )
+
+    def _shed_ticket(self, t: Ticket, reason: str) -> None:
+        with self._lock:
+            self.shed += 1
+            self._pending -= 1
+            self._queued_tickets.pop(id(t), None)
+        METRICS.add("queries_shed")
+        recorder.record("serve.shed", reason=reason, queued=True)
+        t._fail(QueryShedError(
+            f"query shed after queueing ({reason}): {t.sql[:80]!r}",
+            reason=reason,
+        ))
+
+    def _check_hbm(self, plan) -> Optional[str]:
+        """Shed reason "hbm" when a cold table cannot fit the measured
+        headroom even after priority eviction; None to admit.  The
+        plan's own already-resident tables are protected from the
+        eviction pass — evicting them to admit the query that scans
+        them would overshoot the cap AND force the cold re-scan
+        pinning exists to avoid."""
+        if not self._pin_enabled:
+            return None
+        headroom = LEDGER.headroom()
+        if headroom is None:
+            return None  # capacity unknown: stay dormant, never guess
+        from datafusion_tpu.cache import scan_tables
+
+        need = 0
+        protected: list[str] = []
+        for tbl in scan_tables(plan):
+            ds = self.ctx.datasources.get(tbl)
+            if ds is None:
+                continue
+            pin = ds.parent if isinstance(ds, _PinnedProjection) else ds
+            if isinstance(pin, PinnedSource) and pin.resident:
+                protected.append(pin.fingerprint)
+                continue  # already resident: no new bytes
+            est = (pin.estimated_bytes()
+                   if isinstance(pin, PinnedSource)
+                   else PinnedSource(ds, tbl).estimated_bytes())
+            need += est
+        if need == 0 or need <= headroom:
+            return None
+        freed = LEDGER.evict_pins(need - headroom, exclude=protected)
+        headroom = LEDGER.headroom()
+        if headroom is not None and need > headroom:
+            recorder.record("serve.hbm_pressure", need=need,
+                            headroom=headroom, freed=freed)
+            return "hbm"
+        return None
+
+    # -- dispatch (loop thread) ----------------------------------------
+    def _enqueue(self, t: Ticket) -> None:
+        self._window.append(t)
+        if len(self._window) >= max(self._megabatch_max, 1):
+            # size-triggered early flush: the window is a MAXIMUM wait,
+            # not a fixed tick — a full megabatch's worth of queries
+            # dispatches immediately, so closed-loop clients never idle
+            # against the timer
+            if self._window_timer is not None:
+                self._window_timer.cancel()
+            self._flush_window()
+            return
+        if self._window_timer is None:
+            self._window_timer = self._loop.call_later(
+                self._window_s, self._flush_window
+            )
+
+    def _flush_window(self) -> None:
+        self._window_timer = None
+        if not self._window:
+            return
+        batch, self._window = self._window, []
+        groups: dict = {}
+        singles: list[list[Ticket]] = []
+        for t in batch:
+            if t.signature is None:
+                singles.append([t])
+            else:
+                groups.setdefault(t.signature, []).append(t)
+        work = singles + list(groups.values())
+        METRICS.add("serve.windows")
+        for group in work:
+            self._loop.defer(partial(self._run_group, group),
+                             self._group_done)
+
+    @staticmethod
+    def _group_done(result, exc) -> None:
+        if exc is not None:
+            # _run_group fails tickets itself; an escape here is a bug
+            # in the dispatcher, not a query error
+            METRICS.add("serve.dispatch_errors")
+
+    def _mega_signature(self, plan):
+        """The cross-query shape class (the PR 6 ``entry_signature``
+        idea lifted to plans): same table, same plan shape with
+        literals parameterized away.  Queries sharing a signature lower
+        to the same compiled core and are megabatch candidates; None =
+        not a megabatchable shape (executes solo)."""
+        if self._megabatch_max < 2:
+            return None
+        from datafusion_tpu.exec.kernels import parameterize_exprs
+        from datafusion_tpu.plan.logical import (
+            Aggregate,
+            Selection,
+            TableScan,
+        )
+
+        if not isinstance(plan, Aggregate):
+            return None
+        inner = plan.input
+        pred = None
+        if isinstance(inner, Selection):
+            pred, inner = inner.expr, inner.input
+        if not isinstance(inner, TableScan):
+            return None
+        try:
+            exprs = ([pred] if pred is not None else []) + list(
+                plan.aggr_expr
+            )
+            fps, _, _ = parameterize_exprs(exprs)
+        except Exception:  # noqa: BLE001 — unparameterizable plan: solo lane
+            return None
+        proj = (None if inner.projection is None
+                else tuple(inner.projection))
+        return (
+            "agg", inner.table_name,
+            self.ctx.catalog_version(inner.table_name), proj,
+            tuple(repr(g) for g in plan.group_expr), tuple(fps),
+            pred is None,
+        )
+
+    # -- execution (executor threads) ----------------------------------
+    def _run_group(self, group: list[Ticket]) -> None:
+        from datafusion_tpu.cache import scan_tables
+        from datafusion_tpu.exec.aggregate import force_core_predicate
+
+        ready: list[Ticket] = []
+        for t in group:
+            if t.deadline is not None and t.deadline.expired:
+                self._shed_ticket(t, "deadline")
+                continue
+            ready.append(t)
+        if not ready:
+            return
+        if self._pin_enabled:
+            for t in ready:
+                for tbl in scan_tables(t.plan):
+                    self._ensure_resident(tbl)
+        # lower every plan to a relation (counts queries_admitted)
+        executed: list[Ticket] = []
+        megabatchable = any(t.signature is not None for t in ready)
+        for t in ready:
+            with self._lock:
+                self._pending -= 1
+                self._queued_tickets.pop(id(t), None)
+                # per-server mirror of the queries_admitted counter's
+                # semantics (counted at execute entry, errors included)
+                # so conservation is assertable on one instance
+                self.admitted += 1
+            try:
+                with deadline_scope(t.deadline):
+                    if megabatchable and t.signature is not None:
+                        with force_core_predicate():
+                            t._rel = self.ctx.execute(t.plan)
+                    else:
+                        t._rel = self.ctx.execute(t.plan)
+                executed.append(t)
+            except BaseException as e:  # noqa: BLE001 — delivered to the client
+                t._fail(e)
+        # split megabatch-eligible aggregates from the rest
+        mega_by_core: dict = {}
+        rest: list[Ticket] = []
+        for t in executed:
+            key = self._mega_key(t._rel)
+            if key is None:
+                rest.append(t)
+            else:
+                mega_by_core.setdefault(key, []).append(t)
+        for ts in mega_by_core.values():
+            while len(ts) > 1:
+                sub, ts = ts[: self._megabatch_max], ts[self._megabatch_max:]
+                if len(sub) < 2:
+                    rest.extend(sub)
+                    continue
+                try:
+                    self._run_megabatch([t._rel for t in sub])
+                except Exception:  # noqa: BLE001 — megabatch is an optimization; serial is the answer path
+                    METRICS.add("serve.megabatch_fallbacks")
+                    for t in sub:
+                        t._rel.__dict__.pop("_injected_state", None)
+                rest.extend(sub)
+            rest.extend(ts)
+        # per-ticket materialization fans back out over the executor
+        # pool: finalizes of THIS window overlap the next window's
+        # megabatch scan instead of serializing behind it, and each
+        # client unblocks as soon as ITS result is ready
+        for t in rest[1:]:
+            self._loop.defer(partial(self._finish, t), self._group_done)
+        if rest:
+            self._finish(rest[0])
+
+    def _mega_key(self, rel):
+        """Concrete megabatch grouping key for an already-lowered
+        relation — stricter than the plan signature: the relations must
+        share one compiled core (identity) over one table scan, with
+        the predicate in the core (no per-query host masks)."""
+        from datafusion_tpu.exec import fused
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+        from datafusion_tpu.exec.relation import DataSourceRelation
+
+        if self._megabatch_max < 2 or not fused.fusion_enabled():
+            return None
+        if type(rel) is not AggregateRelation:
+            return None
+        if rel._host_pred_expr is not None:
+            return None
+        child = rel.child
+        if not isinstance(child, DataSourceRelation):
+            return None
+        return (id(rel.core), child.table_name)
+
+    def _adopt_shared(self, rel) -> None:
+        """Swap a relation's per-query execution state for the pinned
+        table's cross-query one: the shared encoder keys the per-batch
+        group-id caches, so ids encoded (and uploaded) by ANY earlier
+        query replay for this one."""
+        pin = _pin_of(rel)
+        if pin is None or not pin.resident:
+            return
+        entry = pin.shared_state_for(rel.core)
+        rel.encoder = entry["encoder"]
+        rel._aux_cache = entry["aux"]
+        rel._str_aux_cache = entry["str_aux"]
+        rel._ids_lock = entry["lock"]
+
+    def _run_megabatch(self, rels: list) -> None:
+        """ONE scan, ONE launch per batch group, N queries' states: the
+        cross-query fused pass.  Preconditions (``_mega_key``): every
+        relation shares ``rels[0].core`` and scans the same table."""
+        from datafusion_tpu.exec.aggregate import group_capacity
+        from datafusion_tpu.exec.batch import device_inputs
+        from datafusion_tpu.exec.expression import compute_aux_values
+        from datafusion_tpu.exec.fused import (
+            bucket_group,
+            fuse_group_max,
+            iter_groups,
+            pad_group,
+        )
+        from datafusion_tpu.exec.relation import device_scope
+        from datafusion_tpu.obs.stats import iter_stats
+        from datafusion_tpu.utils.retry import device_call
+
+        leader = rels[0]
+        core = leader.core
+        for r in rels:
+            # placement decided here: megabatched states are device
+            # accumulators, never host-split partials
+            r._allow_host_split = False
+            self._adopt_shared(r)
+            if r is not leader:
+                # one encoder/caches for the whole group even when the
+                # table is not pinned (cold megabatch): ids must agree
+                r.encoder = leader.encoder
+                r._aux_cache = leader._aux_cache
+                r._str_aux_cache = leader._str_aux_cache
+                r._ids_lock = leader._ids_lock
+
+        n_live = len(rels)
+        n_q = bucket_group(n_live)
+        params = tuple(r._params for r in rels)
+        params += (params[0],) * (n_q - n_live)  # query-axis padding
+        device = leader.device
+        fuse = fuse_group_max()
+        states: Optional[list] = None
+        capacity = 0
+        chunk: list = []
+
+        def flush():
+            nonlocal states, capacity
+            if not chunk:
+                return
+            needed = leader._pick_capacity(capacity)
+            if states is None:
+                capacity = needed
+                init = core._init_state(capacity)
+                states = [init] * n_live
+            elif needed > capacity:
+                states = [core._grow_state(s, needed) for s in states]
+                capacity = needed
+            entries = [(c[0], c[1], c[3], c[4], c[5]) for c in chunk]
+            shareds = [(c[2], c[6]) for c in chunk]
+            for idxs, (aux, str_aux) in iter_groups(entries, shareds):
+                egroup = pad_group(
+                    [entries[i] for i in idxs],
+                    lambda e: (e[0], e[1], np.int32(0), e[3], e[4]),
+                )
+                st_in = tuple(states) + (states[0],) * (n_q - n_live)
+                with METRICS.timer("execute.serve_megabatch"), \
+                        device_scope(device):
+                    out = device_call(
+                        core.multi_group_jit, tuple(egroup), st_in, aux,
+                        str_aux, params, _tag="serve.megabatch",
+                    )
+                states = list(out[:n_live])
+                METRICS.add("serve.megabatch_launches")
+                METRICS.add("serve.megabatch_queries", n_live)
+                METRICS.add("serve.megabatch_batches", len(idxs))
+            chunk.clear()
+
+        for batch in iter_stats(leader.child):
+            for idx in core.key_cols:
+                if batch.dicts[idx] is not None:
+                    leader._key_dicts[idx] = batch.dicts[idx]
+            ids = leader._group_ids(batch)
+            staged = batch.cache.get("staged_aux")
+            if staged is not None and staged[0] is core:
+                aux = tuple(staged[1])
+                str_aux = staged[2] if len(staged) > 2 else \
+                    leader._compute_str_aux(batch, core.slots)
+            else:
+                aux = tuple(compute_aux_values(
+                    core.aux_specs, batch, leader._aux_cache
+                ))
+                str_aux = leader._compute_str_aux(batch, core.slots)
+            with device_scope(device):
+                data, validity, mask = device_inputs(
+                    leader._device_view(batch, core), device,
+                    core.wire_hints,
+                )
+            chunk.append((data, validity, aux, np.int32(batch.num_rows),
+                          mask, ids, str_aux))
+            if len(chunk) >= fuse:
+                flush()
+        flush()
+        if states is None:
+            states = [core._init_state(group_capacity(1))] * n_live
+        else:
+            # ONE blob-packed pull for every query's accumulator state:
+            # N separate finalize-time pulls would pay N pack launches
+            # and N link round trips — the de-multiplex ships as one
+            # transfer and finalize slices numpy
+            from datafusion_tpu.exec.batch import device_pull
+
+            states = list(device_pull(tuple(states)))
+        for r, s in zip(rels, states):
+            if r is not leader:
+                r._key_dicts.update(leader._key_dicts)
+                r._str_dicts.update(leader._str_dicts)
+            r._injected_state = s
+
+    def _finish(self, t: Ticket) -> None:
+        """Materialize one ticket's relation and fulfill it (the
+        per-client de-multiplex point for megabatched queries — each
+        relation finalizes its OWN state)."""
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.obs.aggregate import observe_latency
+
+        try:
+            rel = t._rel
+            if "_injected_state" not in getattr(rel, "__dict__", {}):
+                self._adopt_shared_if_aggregate(rel)
+            with deadline_scope(t.deadline):
+                table = collect(rel)
+            t._fulfill(table)
+            wall = time.monotonic() - t.submitted_mono
+            observe_latency("serve.latency", wall)
+            ewma = self._service_ewma_s
+            self._service_ewma_s = (
+                wall if ewma is None else 0.8 * ewma + 0.2 * wall
+            )
+            recorder.record("serve.done", ms=round(wall * 1e3, 3))
+        except BaseException as e:  # noqa: BLE001 — delivered to the client
+            METRICS.add("serve.query_errors")
+            t._fail(e)
+
+    def _adopt_shared_if_aggregate(self, rel) -> None:
+        from datafusion_tpu.exec.aggregate import AggregateRelation
+
+        if (type(rel) is AggregateRelation
+                and rel._host_pred_expr is None):
+            self._adopt_shared(rel)
+
+    # -- pinning -------------------------------------------------------
+    def _ensure_resident(self, table: str) -> None:
+        ds = self.ctx.datasources.get(table)
+        if ds is None:
+            return
+        if isinstance(ds, _PinnedProjection):
+            ds = ds.parent
+        if not isinstance(ds, PinnedSource):
+            pinned = PinnedSource(ds, table)
+            # direct slot swap, NOT register_datasource: the data is
+            # identical (schema/meta delegate), so catalog versions and
+            # cached results must survive the promotion
+            self.ctx.datasources[table] = pinned
+            ds = pinned
+        if not ds.resident:
+            # pin only when the measured headroom (if known) still
+            # covers the estimate — an admission decision made earlier
+            # in the window can be stale by dispatch time, and pinning
+            # past the cap would overshoot; a denied pin just streams
+            # this query cold
+            headroom = LEDGER.headroom()
+            if headroom is not None and ds.estimated_bytes() > headroom:
+                METRICS.add("serve.pin_denied")
+                return
+        ds.ensure()
+        # re-attribute the resident batches' cached device copies (and
+        # measure them) under the pin's owner tag
+        self._retag_pin(ds)
+
+    @staticmethod
+    def _retag_pin(pin: PinnedSource) -> None:
+        """Re-attribute the resident batches' cached device copies
+        under the pin's owner tag and re-measure the pin's accounted
+        bytes from what is ACTUALLY device-resident (the pin was
+        registered with a host-side estimate before any upload; once
+        the first query has populated the caches, eviction accounting
+        should reflect the measured residency it would free)."""
+        res = pin._resident
+        if res is None:
+            return
+        dev_leaves = []
+        for b in res:
+            for v in b.cache.values():
+                dev_leaves.append(v)
+        if not dev_leaves:
+            return
+        LEDGER.retag(dev_leaves, f"pin.{pin.name}")
+        import jax
+
+        measured = sum(
+            int(leaf.nbytes)
+            for leaf in jax.tree.leaves(dev_leaves)
+            if hasattr(leaf, "copy_to_host_async")
+        )
+        if measured:
+            LEDGER.set_pin_bytes(pin.fingerprint, measured)
+
+    # -- introspection -------------------------------------------------
+    def stats(self) -> dict:
+        from datafusion_tpu.obs.aggregate import HISTOGRAMS
+
+        counts = METRICS.snapshot()["counts"]
+        h = HISTOGRAMS.get("serve.latency")
+        with self._lock:
+            out = {
+                "submitted": self.submitted,
+                "shed": self.shed,
+                "pending": self._pending,
+                "service_ewma_s": self._service_ewma_s,
+            }
+        out.update({
+            "queries_admitted": counts.get("queries_admitted", 0),
+            "queries_queued": counts.get("queries_queued", 0),
+            "queries_shed": counts.get("queries_shed", 0),
+            "megabatch_launches": counts.get(
+                "serve.megabatch_launches", 0
+            ),
+            "megabatch_queries": counts.get("serve.megabatch_queries", 0),
+            "tables_pinned": counts.get("serve.tables_pinned", 0),
+            "pins": LEDGER.pins_snapshot(),
+            "pinned_bytes": LEDGER.pinned_bytes(),
+        })
+        if h is not None:
+            out["p50_s"] = h.quantile(0.5)
+            out["p99_s"] = h.quantile(0.99)
+            out["queries"] = h.count
+        return out

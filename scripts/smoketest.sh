@@ -51,6 +51,9 @@ python scripts/cluster_smoke.py
 echo "== scale smoke (3-replica quorum election under SIGKILL, lease-deadline shipping, parked-watch fan-out on the event loop) =="
 python scripts/scale_smoke.py
 
+echo "== serve smoke (closed-loop concurrent clients: admission control, pinned-table H2D skip, megabatched launches, 3x throughput gate) =="
+python scripts/serve_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

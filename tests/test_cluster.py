@@ -945,6 +945,60 @@ class TestReplication:
         assert any(e["kind"] == "join" for e in tail["events"])
 
 
+class TestWatchResume:
+    """Watch resumption tokens: every answer carries {term, rev}; a
+    watcher replaying it gets `resumed: True` iff the answering node
+    can PROVE no client-visible events were missed."""
+
+    def test_answer_carries_token_and_client_replays_it(self):
+        state = ClusterState()
+        client = LocalClusterClient(state)
+        out = client.watch(0, timeout_s=0)
+        tok = out["resume"]
+        assert tok["rev"] == state._rev and tok["term"] == state.term
+        assert "resumed" not in out  # first watch: nothing to prove
+        client.invalidate("t")
+        out2 = client.watch(tok["rev"], timeout_s=0)
+        assert out2["resumed"] is True  # proof: log covers the token
+        assert out2["fired"] and out2["events"]
+        assert client.last_watch_resume == out2["resume"]
+
+    def test_resume_proves_continuity_across_promotion(self):
+        a, b, client = _pair()
+        client.invalidate("warm")
+        out = client.watch(0, timeout_s=0)
+        assert out["resume"]["term"] == 1
+        b.replicate_once()  # promoted log holds every acked revision
+        a.partitioned = True
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        out2 = client.watch(out["resume"]["rev"], timeout_s=0)
+        # the failover sweep landed on b, which proves continuity
+        assert out2["resumed"] is True
+        assert out2["term"] == 2 and out2["resume"]["term"] == 2
+
+    def test_resume_fails_on_lagging_promoted_log(self):
+        a, b, client = _pair()
+        b.replicate_once()
+        client.invalidate("acked-but-unreplicated")
+        out = client.watch(0, timeout_s=0)
+        a.partitioned = True  # b never saw the last events
+        assert b.maybe_promote(now=time.monotonic() + 10.0)
+        out2 = client.watch(out["resume"]["rev"], timeout_s=0)
+        assert out2["resumed"] is False  # proof fails: must resync
+        assert METRICS.counts.get("cluster.client_watch_resyncs", 0) >= 1
+
+    def test_resume_fails_past_truncated_window(self):
+        state = ClusterState()
+        client = LocalClusterClient(state)
+        client.invalidate("t0")
+        out = client.watch(0, timeout_s=0)
+        for i in range(1200):  # blow past the 1024-event window
+            client.invalidate(f"t{i}")
+        out2 = client.watch(out["resume"]["rev"], timeout_s=0)
+        assert out2["resumed"] is False
+        assert out2.get("truncated")
+
+
 class TestBinaryPublish:
     def test_tcp_publish_uses_raw_segments_not_base64(self):
         """Satellite: shared-tier snapshots cross the wire as binary RAW

@@ -282,6 +282,92 @@ class TestMemoryPartitions:
         assert got == {("north",): (1003,), ("south",): (304,)}
 
 
+class TestFusedMeshRounds:
+    def test_warm_rounds_fold_into_one_launch(self):
+        """Multi-round mesh aggregates fold like the single-device
+        batch-group fold: consecutive WARM rounds (round cache hits)
+        of one shape class dispatch as ONE multi-round launch, with
+        parity against the per-round path."""
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.utils.metrics import METRICS
+
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+        ])
+        rng = np.random.default_rng(11)
+        parts = []
+        for _p in range(4):
+            batches = [
+                make_host_batch(schema, [
+                    rng.integers(0, 6, 512).astype(np.int64),
+                    rng.uniform(0, 10, 512),
+                ])
+                for _ in range(3)  # 3 rounds per scan
+            ]
+            parts.append(MemoryDataSource(schema, batches))
+        ctx = PartitionedContext(mesh=make_mesh(4), result_cache=False)
+        ctx.register_datasource("t", PartitionedDataSource(parts))
+        rel = ctx.sql("SELECT k, SUM(v), COUNT(1) FROM t GROUP BY k")
+        want = sorted(collect(rel).to_rows())
+        assert sorted(collect(rel).to_rows()) == want  # admit rounds
+        before = dict(METRICS.counts)
+        got = sorted(collect(rel).to_rows())  # warm: multi-round fold
+        delta = {
+            k: v - before.get(k, 0) for k, v in METRICS.counts.items()
+        }
+        assert got == want
+        assert delta.get("mesh.round_cache_hits", 0) >= 3
+        assert delta.get("mesh.fused_rounds", 0) >= 3
+        assert delta.get("mesh.fused_round_launches", 0) == 1
+        assert delta.get("device.launches.mesh.stacked", 0) == 0
+
+    def test_fuse_off_restores_per_round_dispatch(self):
+        """DATAFUSION_TPU_FUSE=0: warm rounds dispatch one launch each
+        (byte-identical escape hatch), same answers."""
+        import os
+
+        from datafusion_tpu.exec.batch import make_host_batch
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.utils.metrics import METRICS
+
+        schema = Schema([
+            Field("k", DataType.INT64, False),
+            Field("v", DataType.FLOAT64, False),
+        ])
+        rng = np.random.default_rng(12)
+        parts = [
+            MemoryDataSource(schema, [
+                make_host_batch(schema, [
+                    rng.integers(0, 6, 256).astype(np.int64),
+                    rng.uniform(0, 10, 256),
+                ])
+                for _ in range(2)
+            ])
+            for _p in range(2)
+        ]
+        ctx = PartitionedContext(mesh=make_mesh(2), result_cache=False)
+        ctx.register_datasource("t", PartitionedDataSource(parts))
+        rel = ctx.sql("SELECT k, SUM(v) FROM t GROUP BY k")
+        want = sorted(collect(rel).to_rows())
+        assert sorted(collect(rel).to_rows()) == want
+        os.environ["DATAFUSION_TPU_FUSE"] = "0"
+        try:
+            before = dict(METRICS.counts)
+            assert sorted(collect(rel).to_rows()) == want
+            delta = {
+                k: v - before.get(k, 0)
+                for k, v in METRICS.counts.items()
+            }
+            assert delta.get("mesh.fused_round_launches", 0) == 0
+            assert delta.get("device.launches.mesh.stacked", 0) == 2
+        finally:
+            os.environ.pop("DATAFUSION_TPU_FUSE", None)
+
+
 class TestPhysicalPlanParity:
     def test_physical_plan_json_round_trip(self):
         """Mirrors the reference's PhysicalPlan variants

@@ -1,0 +1,291 @@
+"""Serving front door (datafusion_tpu/serve): admission control,
+HBM-pinned resident tables, cross-query megabatching.
+
+The concurrency contract under test:
+- N client threads x mixed hot/cold tables -> exactly-once, correct
+  results per client;
+- admission-counter conservation: admitted + shed == submitted;
+- pinned-table H2D skip: warm queries move ZERO bytes host->device
+  (``device.h2d.transfers`` flat);
+- eviction under a small ``DATAFUSION_TPU_HBM_BYTES`` cap, by pin
+  priority/recency, with ``hbm`` sheds once nothing fits;
+- megabatching: compatible concurrent plans fuse into one launch and
+  de-multiplex per client;
+- default-off: no serving behavior engages unless a Server is built.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.errors import QueryShedError
+from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.datasource import MemoryDataSource
+from datafusion_tpu.exec.materialize import collect
+from datafusion_tpu.obs.device import LEDGER
+from datafusion_tpu.utils.metrics import METRICS
+
+
+def _table(seed: int, rows: int = 4096, batches: int = 4,
+           groups: int = 16):
+    rng = np.random.default_rng(seed)
+    schema = Schema([
+        Field("k", DataType.UTF8, False),
+        Field("v", DataType.FLOAT64, False),
+        Field("p", DataType.FLOAT64, False),
+    ])
+    d = StringDictionary()
+    out = []
+    for _ in range(batches):
+        codes = d.encode([f"g{j}" for j in rng.integers(0, groups, rows)])
+        v = np.round(rng.uniform(0, 100, rows), 2)
+        p = np.round(rng.uniform(0, 1, rows), 3)
+        out.append(make_host_batch(schema, [codes, v, p],
+                                   dicts=[d, None, None]))
+    return schema, MemoryDataSource(schema, out)
+
+
+def _ctx(tables: dict) -> ExecutionContext:
+    ctx = ExecutionContext(result_cache=False)
+    for name, (schema, ds) in tables.items():
+        ctx.register_datasource(name, ds)
+    return ctx
+
+
+def _q(table: str, lit: float) -> str:
+    return (f"SELECT k, SUM(v), COUNT(1) FROM {table} "
+            f"WHERE p < {lit} GROUP BY k")
+
+
+@pytest.fixture(autouse=True)
+def _no_hbm_cap():
+    """Each test owns the capacity knob; start clean, restore after."""
+    prior = os.environ.pop("DATAFUSION_TPU_HBM_BYTES", None)
+    yield
+    if prior is None:
+        os.environ.pop("DATAFUSION_TPU_HBM_BYTES", None)
+    else:
+        os.environ["DATAFUSION_TPU_HBM_BYTES"] = prior
+
+
+class TestServing:
+    def test_megabatched_answers_match_serialized(self):
+        ctx = _ctx({"t": _table(1)})
+        lits = [0.2 + 0.05 * i for i in range(6)]
+        want = {
+            lit: sorted(collect(ctx.sql(_q("t", lit))).to_rows())
+            for lit in lits
+        }
+        before = METRICS.counts.get("serve.megabatch_launches", 0)
+        srv = ctx.serve(workers=2, window_s=0.02, megabatch_max=16)
+        try:
+            tickets = [(lit, srv.submit(_q("t", lit))) for lit in lits]
+            for lit, t in tickets:
+                got = sorted(t.result(timeout=60).to_rows())
+                assert got == want[lit]
+        finally:
+            srv.stop()
+        assert METRICS.counts.get("serve.megabatch_launches", 0) > before
+        assert srv.admitted + srv.shed == srv.submitted
+
+    def test_concurrent_clients_mixed_tables_exactly_once(self):
+        ctx = _ctx({"hot": _table(2), "cold": _table(3)})
+        # warm the hot table's pin + device copies first
+        srv = ctx.serve(workers=2, window_s=0.005)
+        results: dict = {}
+        errors: list = []
+
+        def client(i: int):
+            table = "hot" if i % 3 else "cold"
+            lit = 0.25 + 0.01 * i
+            try:
+                t = srv.submit(_q(table, lit))
+                results[i] = sorted(t.result(timeout=120).to_rows())
+            except Exception as e:  # noqa: BLE001 — asserted below
+                errors.append((i, e))
+
+        try:
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(12)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120)
+        finally:
+            srv.stop()
+        assert not errors, errors
+        assert len(results) == 12  # exactly one result per client
+        for i, rows in results.items():
+            table = "hot" if i % 3 else "cold"
+            lit = 0.25 + 0.01 * i
+            assert rows == sorted(
+                collect(ctx.sql(_q(table, lit))).to_rows()
+            ), f"client {i}"
+        assert srv.admitted + srv.shed == srv.submitted
+        assert srv.admitted == 12
+
+    def test_warm_pinned_table_skips_h2d_entirely(self):
+        ctx = _ctx({"t": _table(4)})
+        srv = ctx.serve(workers=1, window_s=0.005)
+        try:
+            srv.submit(_q("t", 0.4)).result(timeout=60)  # cold: pins
+            srv.submit(_q("t", 0.45)).result(timeout=60)  # warms ids
+            before = METRICS.counts.get("device.h2d.transfers", 0)
+            bytes_before = METRICS.counts.get("h2d.bytes", 0)
+            for i in range(4):
+                srv.submit(_q("t", 0.5 + 0.01 * i)).result(timeout=60)
+            assert METRICS.counts.get("device.h2d.transfers", 0) == before
+            assert METRICS.counts.get("h2d.bytes", 0) == bytes_before
+        finally:
+            srv.stop()
+        assert "table:t" in LEDGER.pins_snapshot()
+
+    def test_eviction_under_small_hbm_cap(self):
+        from datafusion_tpu.serve import PinnedSource
+
+        ctx = _ctx({"a": _table(5), "b": _table(6)})
+        # drop pins left by earlier tests: eviction order must have
+        # exactly one candidate (a) for the assertion below
+        for fp in list(LEDGER.pins_snapshot()):
+            LEDGER.unpin(fp)
+        gc.collect()
+        srv = ctx.serve(workers=1, window_s=0.005)
+        try:
+            # no cap yet: headroom unknown -> admission stays dormant
+            srv.submit(_q("a", 0.4)).result(timeout=60)
+            assert "table:a" in LEDGER.pins_snapshot()
+            # cap sized so b cannot fit beside the current residency:
+            # admitting b REQUIRES evicting a (the LEDGER is process
+            # global, so the cap is measured relative to live bytes)
+            est_b = PinnedSource(ctx.datasources["b"],
+                                 "b").estimated_bytes()
+            os.environ["DATAFUSION_TPU_HBM_BYTES"] = str(
+                LEDGER.live_bytes() + est_b // 2
+            )
+            ev_before = METRICS.counts.get("device.pin_evictions", 0)
+            srv.submit(_q("b", 0.4)).result(timeout=60)
+            gc.collect()
+            pins = LEDGER.pins_snapshot()
+            assert "table:b" in pins and "table:a" not in pins
+            assert METRICS.counts.get("device.pin_evictions", 0) \
+                > ev_before
+            # and with a cap nothing fits under, admission sheds "hbm"
+            os.environ["DATAFUSION_TPU_HBM_BYTES"] = "1000"
+            schema, ds = _table(7)
+            ctx.register_datasource("c", ds)
+            with pytest.raises(QueryShedError) as ei:
+                srv.submit(_q("c", 0.4))
+            assert ei.value.reason == "hbm"
+        finally:
+            srv.stop()
+        assert srv.admitted + srv.shed == srv.submitted
+
+    def test_queue_depth_shed(self):
+        ctx = _ctx({"t": _table(8)})
+        srv = ctx.serve(workers=1, window_s=0.005, queue_depth=2)
+        try:
+            # fill the queue beyond depth without letting the window
+            # flush (submissions race the 5 ms window, so submit fast)
+            shed = 0
+            tickets = []
+            for i in range(12):
+                try:
+                    tickets.append(srv.submit(_q("t", 0.3 + 0.01 * i)))
+                except QueryShedError as e:
+                    assert e.reason == "queue"
+                    shed += 1
+            for t in tickets:
+                t.result(timeout=60)
+        finally:
+            srv.stop()
+        assert shed >= 1
+        assert srv.admitted + srv.shed == srv.submitted
+        assert METRICS.counts.get("queries_shed", 0) >= shed
+
+    def test_deadline_shed(self):
+        ctx = _ctx({"t": _table(9)})
+        srv = ctx.serve(workers=1, window_s=0.005)
+        try:
+            srv.submit(_q("t", 0.4)).result(timeout=60)  # seed the EWMA
+            with pytest.raises(QueryShedError) as ei:
+                srv.submit(_q("t", 0.41), deadline_s=0.0)
+            assert ei.value.reason == "deadline"
+        finally:
+            srv.stop()
+        assert srv.admitted + srv.shed == srv.submitted
+
+    def test_megabatch_counters_and_launch_amortization(self):
+        ctx = _ctx({"t": _table(10)})
+        srv = ctx.serve(workers=1, window_s=0.05, megabatch_max=16)
+        try:
+            srv.submit(_q("t", 0.3)).result(timeout=60)  # pin + compile
+            launches0 = METRICS.counts.get("device.launches", 0)
+            mega0 = METRICS.counts.get("serve.megabatch_launches", 0)
+            n = 8
+            tickets = [srv.submit(_q("t", 0.4 + 0.01 * i))
+                       for i in range(n)]
+            for t in tickets:
+                t.result(timeout=120)
+            launches = METRICS.counts.get("device.launches", 0) - launches0
+            assert METRICS.counts.get("serve.megabatch_launches", 0) \
+                > mega0
+            # the batched phase runs N queries in fewer than N launches
+            assert launches < n, f"{launches} launches for {n} queries"
+        finally:
+            srv.stop()
+
+    def test_stop_sheds_queued_tickets_promptly(self):
+        """A ticket still in the batching window when the server stops
+        must fail promptly with a shutdown shed, not hang its client
+        (the loop can exit before draining pending callbacks)."""
+        import time
+
+        ctx = _ctx({"t": _table(12)})
+        # a huge window keeps the ticket parked in the dispatcher
+        srv = ctx.serve(workers=1, window_s=30.0, megabatch_max=64)
+        t = srv.submit(_q("t", 0.4))
+        time.sleep(0.05)  # let the loop thread enqueue it
+        srv.stop()
+        with pytest.raises(QueryShedError) as ei:
+            t.result(timeout=5.0)
+        assert ei.value.reason == "shutdown"
+        assert srv.admitted + srv.shed == srv.submitted
+
+    def test_plan_error_keeps_conservation(self):
+        """A statement that never plans (unknown table) enters neither
+        side of admitted + shed == submitted."""
+        from datafusion_tpu.errors import DataFusionError
+
+        ctx = _ctx({"t": _table(13)})
+        srv = ctx.serve(workers=1, window_s=0.005)
+        try:
+            with pytest.raises(DataFusionError):
+                srv.submit("SELECT k FROM no_such_table GROUP BY k")
+            assert (srv.submitted, srv.admitted, srv.shed) == (0, 0, 0)
+            srv.submit(_q("t", 0.4)).result(timeout=60)
+            assert srv.admitted + srv.shed == srv.submitted == 1
+        finally:
+            srv.stop()
+
+    def test_default_off_path_untouched(self):
+        """Without a Server, nothing serving-related engages: no pins,
+        no serve counters, plain execution only."""
+        ctx = _ctx({"t": _table(11)})
+        pins0 = dict(LEDGER.pins_snapshot())
+        q0 = METRICS.counts.get("queries_queued", 0)
+        s0 = METRICS.counts.get("queries_shed", 0)
+        rows = collect(ctx.sql(_q("t", 0.4))).to_rows()
+        assert rows
+        assert LEDGER.pins_snapshot() == pins0
+        assert METRICS.counts.get("queries_queued", 0) == q0
+        assert METRICS.counts.get("queries_shed", 0) == s0
+        from datafusion_tpu.exec.datasource import MemoryDataSource
+
+        assert type(ctx.datasources["t"]) is MemoryDataSource

@@ -458,6 +458,89 @@ class TestSingleKeyFastPath:
         assert t.column_values(0) == [1.25, 2.0, 3.5, None, None]
 
 
+class TestTopKFinalFold:
+    """The TopK result's (live-mask, row-ids) pull is folded INTO the
+    fused group launch: a warm pass is ONE counted device launch
+    (`device.launches.topk.final`), with no separate blob-pack launch
+    for the mask — and parity against the unfused path holds."""
+
+    def _ctx(self):
+        rng = np.random.default_rng(21)
+        schema = Schema([
+            Field("a", DataType.INT32, False),
+            Field("b", DataType.FLOAT64, False),
+        ])
+        cols = [rng.integers(0, 100000, 5000).astype(np.int32),
+                rng.uniform(0, 1, 5000)]
+        batches = [
+            make_host_batch(schema, [c[i:i + 1000] for c in cols])
+            for i in range(0, 5000, 1000)
+        ]
+        # result cache OFF: the warm run must re-execute the pass (the
+        # launch count is the thing under test)
+        ctx = ExecutionContext(result_cache=False)
+        ctx.register_datasource("t", MemoryDataSource(schema, batches))
+        return ctx, "SELECT a, b FROM t ORDER BY a LIMIT 10"
+
+    def test_warm_pass_is_one_launch(self):
+        from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.utils.metrics import METRICS
+
+        ctx, q = self._ctx()
+        want = collect(ctx.sql(q)).to_rows()
+        collect(ctx.sql(q))  # warm device copies + compiled programs
+        before = dict(METRICS.counts)
+        got = collect(ctx.sql(q)).to_rows()
+        delta = {
+            k: v - before.get(k, 0) for k, v in METRICS.counts.items()
+        }
+        assert got == want
+        assert delta.get("device.launches.topk.final", 0) == 1
+        assert delta.get("device.launches", 0) == 1
+
+    def test_parity_with_fuse_off(self):
+        import os
+
+        from datafusion_tpu.exec.materialize import collect
+
+        ctx, q = self._ctx()
+        want = collect(ctx.sql(q)).to_rows()
+        os.environ["DATAFUSION_TPU_FUSE"] = "0"
+        try:
+            assert collect(ctx.sql(q)).to_rows() == want
+        finally:
+            os.environ.pop("DATAFUSION_TPU_FUSE", None)
+
+    def test_empty_scan_and_wide_keys_still_fold(self):
+        from datafusion_tpu.exec.materialize import collect
+
+        rng = np.random.default_rng(22)
+        schema = Schema([
+            Field("a", DataType.INT64, False),
+            Field("b", DataType.FLOAT64, False),
+        ])
+        ctx = _ctx_with(
+            "t", schema,
+            [rng.integers(-(2**60), 2**60, 3000).astype(np.int64),
+             rng.uniform(0, 1, 3000)],
+        )
+        # wide int64 key: the collision flag rides the folded header
+        got = collect(ctx.sql(
+            "SELECT a FROM t ORDER BY a DESC LIMIT 7"
+        )).to_rows()
+        want = sorted(
+            (int(v),) for v in
+            collect(ctx.sql("SELECT a FROM t")).columns[0]
+        )[-7:][::-1]
+        assert got == want
+        # LIMIT over an all-filtered scan: the empty path still answers
+        empty = collect(ctx.sql(
+            "SELECT a FROM t WHERE a > 4611686018427387904 "
+            "AND a < -4611686018427387904 ORDER BY a LIMIT 3"
+        ))
+        assert empty.num_rows == 0
+
+
 class TestTopKExactPayloads:
     """TopK carries global row indices, not payload columns: payloads
     gather host-side from the source batches, so ORDER BY ... LIMIT
