@@ -38,17 +38,24 @@ def launch_floor_plan(floor_ms: float) -> dict:
 def closed_loop(srv, q: Callable[[float], str], clients: int,
                 per_client: int, lit_of: Callable[[int], float],
                 sink: dict, errors: list,
-                timeout_s: float = 300.0) -> float:
+                timeout_s: float = 300.0,
+                client_prefix: str = "c") -> float:
     """One closed-loop round: `clients` threads each submit
     `per_client` queries (literal = `lit_of(global_index)`), blocking
     on each result.  Results land in `sink[(client, i)]`; failures
-    append to `errors`.  Returns the round's wall seconds."""
+    append to `errors`.  Returns the round's wall seconds.  Each
+    thread submits under its own ``client_id``
+    (``<client_prefix><index>``) so per-client metering
+    (obs/attribution.py) attributes the round's costs — the smoke's
+    conservation gate and the bench's metering record both read them
+    back."""
 
     def client(ci: int):
+        cid = f"{client_prefix}{ci}"
         for qi in range(per_client):
             try:
                 sink[(ci, qi)] = srv.submit(
-                    q(lit_of(ci * per_client + qi))
+                    q(lit_of(ci * per_client + qi)), client_id=cid,
                 ).result(timeout=timeout_s)
             except Exception as e:  # noqa: BLE001 — callers gate on `errors`
                 errors.append((ci, qi, e))
@@ -72,7 +79,8 @@ def warm_rungs(srv, q: Callable[[float], str], clients: int,
     from datafusion_tpu.exec.fused import bucket_group
 
     for sz in sorted({bucket_group(k) for k in range(1, clients + 1)}):
-        tickets = [srv.submit(q(0.84 + sz * 1e-3 + j * 1e-4))
+        tickets = [srv.submit(q(0.84 + sz * 1e-3 + j * 1e-4),
+                              client_id="warmup")
                    for j in range(sz)]
         for t in tickets:
             t.result(timeout=timeout_s)

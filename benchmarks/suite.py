@@ -767,6 +767,17 @@ def config_concurrency(device_kind: str):
         mega0 = METRICS.counts.get("serve.megabatch_launches", 0)
         h_before = (HISTOGRAMS["serve.latency"].snapshot()
                     if "serve.latency" in HISTOGRAMS else None)
+        # tail attribution: the timed round's per-segment critical-path
+        # decomposition (obs/attribution.py) is part of the bench
+        # record — a concurrency regression should name the segment
+        # that grew (queue wait vs window vs launch share vs demux),
+        # not just the headline q/s
+        from datafusion_tpu.obs import attribution
+
+        attribution.EXPLAINER.clear()
+        meter0 = {cid: dict(c) for cid, c in
+                  attribution.METER.snapshot().items()}
+        dispatch0 = METRICS.timings.get("device.dispatch", 0.0)
         if floor_ms > 0:
             faults.install(serve_load.launch_floor_plan(floor_ms))
         try:
@@ -793,12 +804,23 @@ def config_concurrency(device_kind: str):
     p50, p99 = serve_load.phase_quantiles(
         HISTOGRAMS.get("serve.latency"), h_before
     )
+    # the timed round's tail decomposition + metering conservation:
+    # per-segment p50/p99 contributions and the apportioned
+    # device-seconds against the measured launch wall
+    tail = attribution.EXPLAINER.explain()
+    meter1 = attribution.METER.snapshot()
+    dev_sum = sum(
+        c.get("device_seconds", 0.0)
+        - meter0.get(cid, {}).get("device_seconds", 0.0)
+        for cid, c in meter1.items()
+    )
+    launch_wall = METRICS.timings.get("device.dispatch", 0.0) - dispatch0
     log(
         f"    serialized {qps_serial:.1f} q/s -> served "
         f"{qps_served:.1f} q/s ({qps_served / qps_serial:.2f}x), "
         f"{mega} megabatch launches, "
         f"{launches_per_query:.2f} launches/query, "
-        f"p50 {p50} p99 {p99}"
+        f"p50 {p50} p99 {p99}, tail top {tail['top']}"
     )
     return {
         "name": "concurrency",
@@ -813,6 +835,19 @@ def config_concurrency(device_kind: str):
         "launch_floor_ms": floor_ms,
         "p50_s": p50,
         "p99_s": p99,
+        "critical_path": {
+            "top": tail["top"],
+            "segments": {
+                r["segment"]: {"p50_s": r["p50_s"], "p99_s": r["p99_s"],
+                               "share_of_wall": r["share_of_wall"]}
+                for r in tail["segments"]
+            },
+        },
+        "metering": {
+            "clients": sum(1 for cid in meter1 if cid.startswith("c")),
+            "device_seconds_sum": round(dev_sum, 6),
+            "launch_wall_s": round(launch_wall, 6),
+        },
     }
 
 

@@ -309,13 +309,27 @@ class LockInMetricsCallback(_Rule):
     # take a lock.  (The hedge BUDGET delegates to the internally-
     # locked utils/retry.TokenBucket — decision points, not evidence.)
     _HEDGE_FNS = ("observe", "threshold_s")
+    # the attribution observe/apportion path (obs/attribution.py):
+    # charge hooks run inside device_call dispatch, the ledger's H2D
+    # seam, and abandoned hedge-attempt threads; scope publication
+    # wraps whole query executions.  Lock-free is the contract that
+    # makes per-client metering safe to leave always-armed — enforced
+    # here, not just documented.  (Pin accrual and gauge folds are
+    # scrape-path and deliberately NOT listed.)
+    _ATTRIBUTION_FNS = ("charge", "charge_scope", "_entry",
+                        "note_launch", "charge_h2d",
+                        "charge_hedge_loss", "observe",
+                        "observe_path", "observe_phases",
+                        "current_scope", "current_client",
+                        "client_scope", "shared_scope")
 
     def applies(self, relpath: str) -> bool:
         p = relpath.replace(os.sep, "/")
         return p.endswith(("utils/metrics.py", "obs/stats.py",
                            "obs/recorder.py", "obs/aggregate.py",
                            "obs/slo.py", "obs/device.py",
-                           "obs/profiler.py", "utils/hedge.py"))
+                           "obs/profiler.py", "utils/hedge.py",
+                           "obs/attribution.py"))
 
     def _scan(self, node, relpath, where):
         out = []
@@ -365,6 +379,8 @@ class LockInMetricsCallback(_Rule):
             wanted = self._RECORDER_FNS
         elif p.endswith("utils/hedge.py"):
             wanted = self._HEDGE_FNS
+        elif p.endswith("obs/attribution.py"):
+            wanted = self._ATTRIBUTION_FNS
         else:
             wanted = self._STATS_FNS
         out = []

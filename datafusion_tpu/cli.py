@@ -74,10 +74,13 @@ def fleet_top_text(ctx=None) -> str:
 
 
 def run_top(workers: Optional[str], cluster: Optional[str],
-            watch_s: float, out=None) -> int:
+            watch_s: float, out=None, tenants: bool = False) -> int:
     """`datafusion-tpu top [--workers a:1,b:2 | --cluster host:p]
-    [--watch N]`: print the fleet telemetry view once, or every N
-    seconds until interrupted."""
+    [--watch N] [--tenants]`: print the fleet telemetry view once, or
+    every N seconds until interrupted.  ``--tenants`` appends the
+    per-client metering table (obs/attribution.py): device-seconds,
+    H2D bytes, pin byte-seconds, hedge duplicates per ``client_id``,
+    with the conservation line."""
     import os
 
     out = out if out is not None else sys.stdout
@@ -96,6 +99,18 @@ def run_top(workers: Optional[str], cluster: Optional[str],
     try:
         while True:
             print(fleet_top_text(ctx), file=out)
+            if tenants:
+                from datafusion_tpu.obs import attribution
+
+                agg = getattr(ctx, "telemetry", None)
+                if agg is not None:
+                    # fleet mode: THIS process served nothing — render
+                    # the node-summed tenant gauges the aggregator
+                    # already merges from worker heartbeats
+                    print(attribution.tenants_text_from_gauges(
+                        agg.fleet().get("tenants", {})), file=out)
+                else:
+                    print(attribution.tenants_text(), file=out)
             if not watch_s:
                 return 0
             print("", file=out)
@@ -109,15 +124,19 @@ def run_top(workers: Optional[str], cluster: Optional[str],
 
 def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
                      out_dir: Optional[str], seconds: float,
-                     out=None) -> int:
+                     out=None, fmt: str = "json") -> int:
     """`datafusion-tpu debug-bundle [--cluster host:p | --workers
-    h:debugport,...] [--out DIR] [--seconds N]`: pull one debug bundle
-    (obs/httpd.py `/debug/bundle` — config + metrics + flight ring +
-    HBM breakdown + host profile) from every live member and write
-    them under DIR.  With no target, bundles the local process
-    in-process.  Exits non-zero if any live member failed to produce a
-    bundle (a member without an advertised debug port counts as a
-    failure — the fleet is only debuggable if every node is)."""
+    h:debugport,...] [--out DIR] [--seconds N] [--format json|tar]`:
+    pull one debug bundle (obs/httpd.py `/debug/bundle` — config +
+    metrics + flight ring + HBM breakdown + host profile) from every
+    live member and write them under DIR.  ``--format tar`` requests
+    the TAR stream whose members carry the raw span/ring/profile
+    attachments (the very-large-fleet shape; one member file per
+    surface instead of one giant JSON).  With no target, bundles the
+    local process in-process.  Exits non-zero if any live member
+    failed to produce a bundle (a member without an advertised debug
+    port counts as a failure — the fleet is only debuggable if every
+    node is)."""
     import json
     import os
     import tempfile
@@ -125,6 +144,7 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
 
     out = out if out is not None else sys.stdout
     cluster = cluster or os.environ.get("DATAFUSION_TPU_CLUSTER")
+    tar = fmt == "tar"
     targets: list[tuple[str, Optional[str]]] = []  # (member, url|None)
     if workers:
         for addr in workers.split(","):
@@ -148,25 +168,51 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
         out_dir = tempfile.mkdtemp(prefix="datafusion_tpu_bundles_")
     os.makedirs(out_dir, exist_ok=True)
 
+    def _member_stem(member: str) -> str:
+        return f"bundle-{member.replace(':', '-').replace('/', '-')}"
+
     def _write(member: str, doc: dict) -> str:
-        path = os.path.join(
-            out_dir, f"bundle-{member.replace(':', '-').replace('/', '-')}.json"
-        )
+        path = os.path.join(out_dir, f"{_member_stem(member)}.json")
         with open(path, "w", encoding="utf-8") as f:
             json.dump(doc, f, default=str)
         return path
 
+    def _write_tar(member: str, blob: bytes) -> str:
+        path = os.path.join(out_dir, f"{_member_stem(member)}.tar")
+        with open(path, "wb") as f:
+            f.write(blob)
+        return path
+
+    def _tar_summary(blob: bytes) -> str:
+        import io
+        import tarfile
+
+        try:
+            with tarfile.open(fileobj=io.BytesIO(blob)) as tf:
+                names = tf.getnames()
+        except tarfile.TarError:
+            # a member that pre-dates tar support answers JSON; keep
+            # the artifact, flag the shape
+            return f"{len(blob)} bytes (not a tar stream)"
+        return f"{len(blob)} bytes, {len(names)} members: {', '.join(names)}"
+
     failures = 0
     if not targets:
         # no cluster, no workers: bundle THIS process
-        from datafusion_tpu.obs.httpd import build_bundle
+        from datafusion_tpu.obs.httpd import build_bundle, build_bundle_tar
 
-        doc = build_bundle(profile_seconds=seconds)
-        path = _write("local", doc)
-        n_samples = (doc.get("profile") or {}).get("samples", 0)
-        print(f"local: {path} "
-              f"({n_samples} profile samples, "
-              f"{len(doc['flights']['events'])} flight events)", file=out)
+        if tar:
+            blob = build_bundle_tar(profile_seconds=seconds)
+            path = _write_tar("local", blob)
+            print(f"local: {path} ({_tar_summary(blob)})", file=out)
+        else:
+            doc = build_bundle(profile_seconds=seconds)
+            path = _write("local", doc)
+            n_samples = (doc.get("profile") or {}).get("samples", 0)
+            print(f"local: {path} "
+                  f"({n_samples} profile samples, "
+                  f"{len(doc['flights']['events'])} flight events)",
+                  file=out)
     for member, url in targets:
         if url is None:
             print(f"{member}: NO debug port advertised in its lease "
@@ -182,10 +228,17 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
             headers["Authorization"] = f"Bearer {token}"
         try:
             req = urllib.request.Request(
-                f"{url}?seconds={seconds:g}", headers=headers
+                f"{url}?seconds={seconds:g}"
+                + ("&format=tar" if tar else ""),
+                headers=headers,
             )
             with urllib.request.urlopen(req, timeout=seconds + 15) as resp:
-                doc = json.loads(resp.read())
+                raw = resp.read()
+            if tar:
+                path = _write_tar(member, raw)
+                print(f"{member}: {path} ({_tar_summary(raw)})", file=out)
+                continue
+            doc = json.loads(raw)
         except (OSError, ValueError) as e:
             print(f"{member}: bundle pull failed: {e}", file=out)
             failures += 1
@@ -526,13 +579,26 @@ def main(argv=None) -> int:
         help="debug-bundle mode: on-demand profile capture length per "
              "member (default 0.5)",
     )
+    parser.add_argument(
+        "--format", default="json", choices=["json", "tar"],
+        help="debug-bundle mode: 'tar' pulls the raw-attachment tar "
+             "stream (span/ring/profile members) instead of one JSON "
+             "document per member",
+    )
+    parser.add_argument(
+        "--tenants", action="store_true",
+        help="top mode: append the per-client metering table "
+             "(device-seconds, H2D bytes, pin byte-seconds, hedge "
+             "duplicates per client_id)",
+    )
     args = parser.parse_args(argv)
 
     if args.mode == "top":
-        return run_top(args.workers, args.cluster, args.watch)
+        return run_top(args.workers, args.cluster, args.watch,
+                       tenants=args.tenants)
     if args.mode == "debug-bundle":
         return run_debug_bundle(args.cluster, args.workers, args.out,
-                                args.seconds)
+                                args.seconds, fmt=args.format)
 
     print("DataFusion Console")
     console = Console(make_context(args.device, args.batch_size), timing=args.timing)

@@ -669,10 +669,14 @@ class ExecutionContext:
         this process's histogram quantiles (query latency, per-table
         `scan.<t>.latency`/`scan.<t>.bytes`) and circuit-breaker state
         gauges (utils/breaker.py; empty when breakers are off)."""
+        from datafusion_tpu.obs import attribution
         from datafusion_tpu.obs.aggregate import histogram_gauges
         from datafusion_tpu.obs.export import prometheus_text
         from datafusion_tpu.utils import breaker as breaker_mod
 
+        # accrue pin byte-seconds and fold tenant.<id>.* metering
+        # gauges into the registry so the scrape carries them
+        attribution.refresh_tenant_gauges()
         gauges = histogram_gauges()
         gauges.update(breaker_mod.gauges())
         return prometheus_text(METRICS, extra_gauges=gauges)

@@ -326,6 +326,12 @@ def node_snapshot() -> dict:
     # way: measured at snapshot time, absent when the platform hides
     # them — the fleet sums only measured values
     refresh_host_gauges()
+    # per-client metering gauges (tenant.<id>.*): pin byte-seconds
+    # accrue at snapshot time, and the costs ride every scrape and
+    # heartbeat piggyback like the histograms do
+    from datafusion_tpu.obs import attribution
+
+    attribution.refresh_tenant_gauges()
     snap = METRICS.snapshot()
     gauges = snap["gauges"]
     if not _device.enabled():
@@ -375,7 +381,23 @@ def query_completed(wall_s: float, rows: Optional[int] = None,
         from datafusion_tpu.obs import trace as obs_trace
 
         observe_latency("query.latency", wall_s)
-        slo.WATCHDOG.observe(wall_s, error=error is not None)
+        # a SERVED query (this thread carries a client charge scope)
+        # reports to the SLO watchdog at the front door with its
+        # CLIENT-VISIBLE wall, queue wait included — feeding the inner
+        # materialization wall here too would put 2N samples in the
+        # window, diluting exactly the queueing tail serving SLOs
+        # exist to catch
+        from datafusion_tpu.obs import attribution
+
+        served = attribution.current_scope() is not None
+        if not served:
+            slo.WATCHDOG.observe(wall_s, error=error is not None)
+        # tail attribution fallback: a NON-served query's wall
+        # decomposes by the PR 9 phase set into the same tail
+        # explainer the serving segments feed (a served query observes
+        # its richer serving chain at the front door instead;
+        # obs/attribution.py skips under a client scope)
+        attribution.observe_phases(wall_s, phases)
         recorder.record(
             "query.done" if error is None else "query.error",
             wall_s=round(wall_s, 6), rows=rows, label=label, error=error,
@@ -478,8 +500,14 @@ class FleetAggregator:
             for name in _SUMMED_GAUGES:
                 if name in g:
                     sums[name] = sums.get(name, 0) + float(g[name])
+            # per-client metering gauges are extensive too: a client's
+            # fleet-wide cost is the sum of what every node charged it
+            for name, v in g.items():
+                if name.startswith("tenant."):
+                    sums[name] = sums.get(name, 0) + float(v)
         hbm = {k: v for k, v in sums.items() if k.startswith("device.hbm.")}
         host = {k: v for k, v in sums.items() if k.startswith("host.")}
+        tenants = {k: v for k, v in sums.items() if k.startswith("tenant.")}
         derived = {
             "result_cache_hit_rate": _rate(
                 counts.get("cache.result.hits", 0),
@@ -497,7 +525,7 @@ class FleetAggregator:
         }
         return {"nodes": len(nodes), "node_names": sorted(nodes),
                 "histograms": hists, "counts": counts, "derived": derived,
-                "hbm": hbm, "host": host}
+                "hbm": hbm, "host": host, "tenants": tenants}
 
     def gauges(self) -> dict:
         """Fleet gauges for ``prometheus_text(extra_gauges=...)``."""
@@ -514,6 +542,10 @@ class FleetAggregator:
         # (absent off-Linux — only measured nodes contribute)
         for name, v in f["host"].items():
             out[f"fleet.{name}"] = int(v)
+        # fleet per-client metering: each client's node-wise summed
+        # costs (serve_smoke's conservation gate reads these)
+        for name, v in f.get("tenants", {}).items():
+            out[f"fleet.{name}"] = round(v, 6)
         for name, v in f["derived"].items():
             if v is not None:
                 out[f"fleet.{name}"] = round(v, 4)

@@ -1,0 +1,677 @@
+"""Tail-latency attribution: per-query critical paths and per-client
+metering across the shared serving plane.
+
+PR 13 deliberately *blurred* every per-query signal the earlier
+observability layers report: N concurrent queries fuse into one
+megabatched XLA launch, hot tables are shared HBM pins, and hedged
+dispatch duplicates work whose loser still burns a worker.  On a
+shared device, ``fleet.*`` p99 can burn an SLO while no gauge says
+whether queue wait, the batching window, a shared launch, or demux
+grew — and nobody can answer "whose latency is whose, and whose HBM
+is whose".  This module is the un-blurring layer, in two halves:
+
+**Critical paths.**  Every query's end-to-end wall decomposes into a
+canonical segment chain.  Served queries (datafusion_tpu/serve.py)
+observe the serving chain directly from their ticket timestamps and
+apportioned launch shares::
+
+    queue_wait -> admission -> megabatch_window -> shared_launch_share
+        -> demux_pull -> merge
+
+Non-served queries fall back to the PR 9 phase set (decode -> h2d ->
+compile -> execute -> d2h -> other) via the ``query_completed``
+telemetry funnel.  Distributed traced queries additionally get a
+span-tree decomposition (`critical_path_from_spans`): the merged
+coordinator + worker span tree is walked with **hedge losers
+excluded** — a lost speculative attempt's wall is duplicate work, not
+critical-path time — and the root wall splits into per-name interval
+unions.  A windowed `TailExplainer` aggregates observed paths into
+per-segment p50/p95/p99 *contributions*, ranked so an SLO breach
+names the guilty segment; the explainer report auto-attaches to SLO
+breach artifacts and slow-query flight dumps (obs/slo.py,
+obs/recorder.py).
+
+**Per-client metering.**  ``Server.submit`` carries a ``client_id``
+and the shared costs apportion back to it:
+
+- device-seconds of a megabatched launch split across member queries
+  by row weight (`shared_scope`; today's megabatch members share one
+  scan, so row weights degenerate to an even split — the formula
+  stays general);
+- H2D bytes charged at the ledger seam (``note_h2d`` ->
+  `charge_h2d`);
+- HBM pin byte-seconds accrued to the client whose query pinned the
+  table (`register_pin_client` + `accrue_pins`, read off the PR 9
+  ledger's pin table on every scrape);
+- a hedge loser's duplicate wall charged to the hedging query's
+  client (`charge_hedge_loss`, fed from the coordinator's abandoned
+  attempt threads).
+
+Costs surface as ``tenant.<id>.*`` gauges in every scrape
+(`refresh_tenant_gauges`), the ``/debug/tenants`` route, and
+``datafusion-tpu top --tenants``; conservation is assertable — the
+sum of per-client device-seconds tracks the measured launch wall
+(``device.dispatch`` stage timing) because both derive from the same
+per-launch measurement in ``utils/retry.device_call``.
+
+Cost model: the observe/apportion path is **lock-free** (DF005
+territory, enforced by the linter like the flight recorder's emit
+path): `Meter.charge` is a dict-setdefault plus float adds,
+`TailExplainer.observe` is one bounded-deque append, scope
+publication is a plain dict store in `utils/metrics.CLIENT_SCOPES`.
+Concurrent writers may lose the occasional increment — the statsd
+trade the latency histograms already make.  Aggregation (quantiles,
+gauge folds, pin accrual) happens on scrape paths only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Iterable, Optional
+
+from datafusion_tpu.utils import metrics as _metrics
+from datafusion_tpu.utils.metrics import METRICS
+
+# the canonical serving-chain segments, in causal order (the vocabulary
+# the serve.py ticket path observes); non-served queries fall back to
+# obs/device.PHASE_ORDER
+SERVED_SEGMENTS = (
+    "queue_wait", "admission", "megabatch_window",
+    "shared_launch_share", "demux_pull", "merge", "other",
+)
+
+# per-client cost dimensions (all extensive: they sum across queries,
+# scrapes, and — merged node-wise — the fleet)
+COST_KEYS = (
+    "device_seconds", "h2d_bytes", "pin_byte_seconds",
+    "hedge_duplicate_seconds", "queries", "shed",
+)
+
+_UNTENANTED = "default"
+
+# cardinality bound on distinct metered clients: a serving plane built
+# for "millions of users" must not let per-user client_ids grow the
+# meter — and the tenant.<id>.* gauges that ride EVERY scrape and
+# heartbeat piggyback — without bound.  Past the cap, new clients'
+# costs fold into one overflow bucket (totals and conservation stay
+# exact; only per-client resolution for the long tail is sacrificed).
+_OVERFLOW = "~overflow"
+_MAX_CLIENTS = max(
+    int(os.environ.get("DATAFUSION_TPU_TENANT_MAX", "256") or 256), 2
+)
+
+
+# -- client scopes ------------------------------------------------------
+# Which client's work is this thread doing right now?  Published into
+# utils/metrics.CLIENT_SCOPES (the same cross-thread-table pattern as
+# the profiler's PROFILE_STAGES/PROFILE_TRACES: a hook on another
+# subsystem's hot path pays one module-global dict read, no imports of
+# this module needed to publish).  Two scope shapes:
+#
+#   ("solo", client_id, [acc])            one client owns the work
+#   ("shared", ((cid, weight), ...), [acc])   a megabatched launch's
+#                                         members, weights summing ~1
+#
+# `acc[0]` accumulates the launch wall charged under the scope so the
+# serving path can read back its own apportioned share (the
+# shared_launch_share segment) without re-measuring.
+
+
+def current_scope():
+    """This thread's published charge scope (None = untenanted work)."""
+    return _metrics.CLIENT_SCOPES.get(threading.get_ident())
+
+
+def current_client() -> Optional[str]:
+    """This thread's client id (None when untenanted or shared)."""
+    scope = _metrics.CLIENT_SCOPES.get(threading.get_ident())
+    if scope is not None and scope[0] == "solo":
+        return scope[1]
+    return None
+
+
+@contextmanager
+def client_scope(client_id: str):
+    """Publish `client_id` as this thread's cost owner for the block.
+    Yields the scope's launch-wall accumulator (a one-slot list)."""
+    tbl = _metrics.CLIENT_SCOPES
+    tid = threading.get_ident()
+    prev = tbl.get(tid)
+    acc = [0.0]
+    tbl[tid] = ("solo", str(client_id), acc)
+    try:
+        yield acc
+    finally:
+        if prev is None:
+            tbl.pop(tid, None)
+        else:
+            tbl[tid] = prev
+
+
+@contextmanager
+def shared_scope(members: Iterable[tuple[str, float]]):
+    """Publish a weighted member set as this thread's cost owners (a
+    megabatched launch: every charge under the scope splits by
+    weight).  Yields the launch-wall accumulator."""
+    tbl = _metrics.CLIENT_SCOPES
+    tid = threading.get_ident()
+    prev = tbl.get(tid)
+    acc = [0.0]
+    tbl[tid] = ("shared", tuple(members), acc)
+    try:
+        yield acc
+    finally:
+        if prev is None:
+            tbl.pop(tid, None)
+        else:
+            tbl[tid] = prev
+
+
+# -- the meter ----------------------------------------------------------
+class Meter:
+    """Per-client cost accumulators.  `charge` is the lock-free hot
+    path (dict setdefault + float add — DF005 enforced); snapshot /
+    clear are scrape-path operations."""
+
+    def __init__(self):
+        self._clients: dict[str, dict[str, float]] = {}
+
+    def _entry(self, client: str) -> dict[str, float]:
+        e = self._clients.get(client)
+        if e is None:
+            if len(self._clients) >= _MAX_CLIENTS \
+                    and client != _OVERFLOW:
+                # cardinality cap: the long tail of client ids folds
+                # into one bucket (a racing pair of creators may
+                # briefly overshoot the cap by one — the statsd trade,
+                # never unbounded growth)
+                METRICS.add("tenant.overflow_charges")
+                return self._entry(_OVERFLOW)
+            # setdefault keeps a racing creator's entry (and charges)
+            e = self._clients.setdefault(
+                client, {k: 0.0 for k in COST_KEYS}
+            )
+        return e
+
+    def charge(self, client: str, key: str, amount: float) -> None:
+        e = self._entry(client)
+        e[key] = e.get(key, 0.0) + amount
+
+    def charge_scope(self, scope, key: str, amount: float) -> None:
+        """Charge under a published scope: solo charges one client,
+        shared splits by weight; None scopes charge nobody (untenanted
+        engine work stays unmetered rather than guessed)."""
+        if scope is None:
+            return
+        if scope[0] == "solo":
+            self.charge(scope[1], key, amount)
+        else:
+            for cid, w in scope[1]:
+                self.charge(cid, key, amount * w)
+
+    def clients(self) -> list[str]:
+        return sorted(self._clients)
+
+    def snapshot(self) -> dict[str, dict[str, float]]:
+        return {
+            cid: dict(costs)
+            for cid, costs in list(self._clients.items())
+        }
+
+    def totals(self) -> dict[str, float]:
+        out = {k: 0.0 for k in COST_KEYS}
+        for costs in list(self._clients.values()):
+            for k, v in costs.items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def clear(self) -> None:
+        self._clients.clear()
+
+
+METER = Meter()
+
+
+# -- charge hooks (other subsystems' hot paths) -------------------------
+def note_launch(seconds: float) -> None:
+    """One device launch's wall, from ``utils/retry.device_call`` —
+    charged to this thread's published scope (split by weight when the
+    launch is a megabatch serving several clients).  Untenanted
+    launches charge nobody.  Lock-free."""
+    scope = _metrics.CLIENT_SCOPES.get(threading.get_ident())
+    if scope is None:
+        return
+    METER.charge_scope(scope, "device_seconds", seconds)
+    scope[2][0] += seconds
+
+
+def charge_h2d(nbytes: int) -> None:
+    """One H2D transfer's bytes, from the ledger seam
+    (``obs/device.DeviceLedger.note_h2d``).  Lock-free."""
+    scope = _metrics.CLIENT_SCOPES.get(threading.get_ident())
+    if scope is not None:
+        METER.charge_scope(scope, "h2d_bytes", float(nbytes))
+
+
+def charge_hedge_loss(scope, seconds: float) -> None:
+    """A hedge loser's duplicate wall — the speculative attempt that
+    did NOT win still burned a worker for `seconds`; the *hedging
+    query's* client pays for it (`scope` is captured at dispatch time:
+    the loser reports from its own attempt thread, where no scope is
+    ambient).  Lock-free."""
+    if scope is None:
+        return
+    METER.charge_scope(scope, "hedge_duplicate_seconds", seconds)
+    METRICS.add("tenant.hedge_losses")
+
+
+# -- HBM pin byte-seconds -----------------------------------------------
+# The ledger's pin table (obs/device.py) knows bytes and owner tag
+# (pin.<table>); THIS map knows which client's query pinned it.
+# Accrual is integral-of-residency: on every scrape, each registered
+# pin charges bytes x elapsed-since-last-accrual to its client.
+_PIN_CLIENTS: dict[str, str] = {}      # fingerprint -> client_id
+_PIN_ACCRUED_AT: dict[str, float] = {}  # fingerprint -> monotonic
+
+
+def register_pin_client(fingerprint: str, client_id: str) -> None:
+    """Attribute a pinned resident to the client whose query
+    materialized it (serve.Server._ensure_resident)."""
+    _PIN_CLIENTS[fingerprint] = str(client_id)
+    _PIN_ACCRUED_AT[fingerprint] = time.monotonic()
+
+
+def forget_pin(fingerprint: str) -> None:
+    """Eviction hook: stop accruing for a dropped pin."""
+    _PIN_CLIENTS.pop(fingerprint, None)
+    _PIN_ACCRUED_AT.pop(fingerprint, None)
+
+
+def accrue_pins(now: Optional[float] = None) -> None:
+    """Charge pin byte-seconds accrued since the last accrual (called
+    from scrape paths — `refresh_tenant_gauges`, `/debug/tenants`).
+    Pins that left the ledger stop accruing and are pruned."""
+    from datafusion_tpu.obs.device import LEDGER
+
+    now = time.monotonic() if now is None else now
+    pins = LEDGER.pins_snapshot()
+    for fp in list(_PIN_CLIENTS):
+        info = pins.get(fp)
+        if info is None:
+            forget_pin(fp)
+            continue
+        last = _PIN_ACCRUED_AT.get(fp, now)
+        dt = max(now - last, 0.0)
+        _PIN_ACCRUED_AT[fp] = now
+        if dt > 0:
+            METER.charge(_PIN_CLIENTS[fp], "pin_byte_seconds",
+                         float(info.get("bytes", 0)) * dt)
+
+
+# -- the tail explainer -------------------------------------------------
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank quantile over a sorted sample list."""
+    if not sorted_vals:
+        return 0.0
+    i = min(max(int(q * len(sorted_vals) + 0.5) - 1, 0),
+            len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+class TailExplainer:
+    """Windowed per-segment tail aggregation: every observed query
+    path (served segments or phase fallback) appends to a bounded
+    deque; `explain()` ranks segments by their p99 *contribution* to
+    query wall so a breach names the guilty segment.
+
+    ``observe`` is one deque append (lock-free, DF005); ``explain``
+    sorts on the scrape path only."""
+
+    def __init__(self, maxlen: int = 4096, window_s: float = 600.0):
+        self.window_s = float(window_s)
+        # (monotonic_ts, kind, wall_s, {segment: seconds})
+        self._paths: deque = deque(maxlen=maxlen)
+
+    def observe(self, wall_s: float, segments: dict[str, float],
+                kind: str = "served") -> None:
+        self._paths.append(
+            (time.monotonic(), kind, float(wall_s), segments)
+        )
+
+    def clear(self) -> None:
+        self._paths.clear()
+
+    def __len__(self) -> int:
+        return len(self._paths)
+
+    def explain(self, window_s: Optional[float] = None) -> dict:
+        """The tail report: per-segment p50/p95/p99 contribution
+        seconds plus each segment's share of total observed wall,
+        ranked by p99 contribution (ties to share).  ``top`` names
+        the ranked-first segment — the breach's suspect."""
+        window = self.window_s if window_s is None else float(window_s)
+        cutoff = time.monotonic() - window
+        rows = [p for p in list(self._paths) if p[0] >= cutoff]
+        per_seg: dict[str, list[float]] = {}
+        total_wall = 0.0
+        kinds: dict[str, int] = {}
+        for _, kind, wall, segments in rows:
+            total_wall += wall
+            kinds[kind] = kinds.get(kind, 0) + 1
+            for name, v in segments.items():
+                per_seg.setdefault(name, []).append(float(v))
+        out_rows = []
+        for name, vals in per_seg.items():
+            vals.sort()
+            seg_sum = sum(vals)
+            out_rows.append({
+                "segment": name,
+                "count": len(vals),
+                "p50_s": round(_quantile(vals, 0.50), 6),
+                "p95_s": round(_quantile(vals, 0.95), 6),
+                "p99_s": round(_quantile(vals, 0.99), 6),
+                "share_of_wall": round(
+                    seg_sum / total_wall, 4) if total_wall > 0 else 0.0,
+            })
+        out_rows.sort(
+            key=lambda r: (r["p99_s"], r["share_of_wall"]), reverse=True
+        )
+        return {
+            "queries": len(rows),
+            "window_s": window,
+            "kinds": kinds,
+            "top": out_rows[0]["segment"] if out_rows else None,
+            "segments": out_rows,
+        }
+
+
+EXPLAINER = TailExplainer()
+
+
+def observe_path(client_id: str, wall_s: float,
+                 segments: dict[str, float]) -> None:
+    """One served query's decomposed critical path (serve.Server's
+    finish point): feeds the tail explainer and counts the client's
+    query.  Lock-free."""
+    EXPLAINER.observe(wall_s, segments, kind="served")
+    METER.charge(client_id, "queries", 1.0)
+
+
+def observe_phases(wall_s: float,
+                   phases: Optional[dict[str, float]]) -> None:
+    """The non-served fallback, fed from the ``query_completed``
+    funnel: the PR 9 phase set stands in for the serving chain.  A
+    thread running under a client scope is a *served* query finishing
+    its materialization — it observes its own richer path, so the
+    fallback skips to avoid double counting.  Lock-free."""
+    if _metrics.CLIENT_SCOPES.get(threading.get_ident()) is not None:
+        return
+    EXPLAINER.observe(
+        wall_s, dict(phases) if phases else {"other": float(wall_s)},
+        kind="phases",
+    )
+
+
+# -- span-tree critical path (distributed traced queries) ---------------
+def _interval_union_s(intervals: list[tuple[int, int]]) -> float:
+    """Total seconds covered by a set of [start_ns, end_ns) intervals
+    (overlaps counted once: two shards dispatched in parallel
+    contribute their envelope, not their sum — this is the *critical
+    path*, not CPU time)."""
+    if not intervals:
+        return 0.0
+    intervals.sort()
+    total = 0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    total += cur_e - cur_s
+    return total / 1e9
+
+
+def hedge_loser_span_ids(span_dicts: list[dict]) -> set[str]:
+    """Span ids of hedge-LOSER dispatch attempts (and their
+    descendants) in a merged trace, matching what the coordinator
+    actually emits (parallel/coordinator.py ``hedged_request``):
+
+    - the PRIMARY dispatch span is the *request record* — it always
+      ends when the first valid response returns, gets ``hedged``
+      when a hedge launched and ``hedge_won`` when the hedge won;
+    - the speculative attempt's own span carries ``hedge_attempt``
+      and, when it LOSES, outlives the request record (the abandoned
+      thread finishes whenever its worker answers).
+
+    So: only ``hedge_attempt`` spans are ever losers, and only in
+    groups whose request record does NOT carry ``hedge_won`` — when
+    the hedge won, the attempt span IS the answer's provenance (the
+    winner's worker spans parent under it) and the abandoned primary
+    request has no span of its own to exclude.  Crucially, plain
+    failover retries (multiple dispatch spans for one shard with
+    ``attempt=N``/``failed_over`` markers, no hedge attrs) are NOT
+    hedge pairs: the successful retry is real critical-path time.
+    Everything parented under a loser is excluded with it."""
+    groups: dict[tuple, list[dict]] = {}
+    for s in span_dicts:
+        if s.get("name") == "coord.dispatch":
+            attrs = s.get("attrs") or {}
+            groups.setdefault(
+                (s.get("trace_id"), attrs.get("shard")), []
+            ).append(s)
+    losers: set[str] = set()
+    for group in groups.values():
+        attempts = [s for s in group
+                    if (s.get("attrs") or {}).get("hedge_attempt")]
+        if not attempts:
+            continue  # no hedge here (failover retries stay counted)
+        if any((s.get("attrs") or {}).get("hedge_won") for s in group):
+            # the hedge WON: its attempt span is the winner's
+            # provenance; the abandoned primary request has no span
+            continue
+        for s in attempts:
+            losers.add(s["span_id"])
+    if losers:
+        # transitive closure: worker spans parent under the loser's
+        # dispatch span and must go with it
+        children: dict[Optional[str], list[dict]] = {}
+        for s in span_dicts:
+            children.setdefault(s.get("parent_id"), []).append(s)
+        frontier = list(losers)
+        while frontier:
+            pid = frontier.pop()
+            for child in children.get(pid, ()):
+                if child["span_id"] not in losers:
+                    losers.add(child["span_id"])
+                    frontier.append(child["span_id"])
+    return losers
+
+
+def critical_path_from_spans(span_dicts: list[dict]) -> dict:
+    """Decompose a merged span tree's end-to-end wall into per-name
+    segments: the root span's wall splits by the interval *union* of
+    its direct children grouped by name (parallel same-name spans
+    count once — critical path, not CPU time), with hedge losers
+    excluded first; the unaccounted remainder reports as ``other``.
+    The excluded losers' summed wall reports separately as
+    ``hedge_loser_s`` — it is duplicate cost, metered to the hedging
+    client, never critical-path time."""
+    spans = [s for s in span_dicts if s.get("end_ns")]
+    if not spans:
+        return {"wall_s": 0.0, "segments": {}, "excluded_spans": 0,
+                "hedge_loser_s": 0.0}
+    losers = hedge_loser_span_ids(spans)
+    loser_wall = sum(
+        max(int(s["end_ns"]) - int(s["start_ns"]), 0)
+        for s in spans if s["span_id"] in losers
+        and s.get("name") == "coord.dispatch"
+    ) / 1e9
+    live = [s for s in spans if s["span_id"] not in losers]
+    ids = {s["span_id"] for s in live}
+    roots = [s for s in live if s.get("parent_id") not in ids]
+    root = max(
+        roots or live,
+        key=lambda s: int(s["end_ns"]) - int(s["start_ns"]),
+    )
+    r_start, r_end = int(root["start_ns"]), int(root["end_ns"])
+    by_name: dict[str, list[tuple[int, int]]] = {}
+    for s in live:
+        if s.get("parent_id") != root["span_id"]:
+            continue
+        start = max(int(s["start_ns"]), r_start)
+        end = min(int(s["end_ns"]), r_end)
+        if end > start:
+            by_name.setdefault(s["name"], []).append((start, end))
+    wall_s = max(r_end - r_start, 0) / 1e9
+    segments = {
+        name: round(_interval_union_s(iv), 6)
+        for name, iv in by_name.items()
+    }
+    all_iv = [iv for ivs in by_name.values() for iv in ivs]
+    covered = _interval_union_s(all_iv)
+    segments["other"] = round(max(wall_s - covered, 0.0), 6)
+    return {
+        "root": root.get("name"),
+        "wall_s": round(wall_s, 6),
+        "segments": segments,
+        "excluded_spans": len(losers),
+        "hedge_loser_s": round(loser_wall, 6),
+    }
+
+
+# -- surfacing ----------------------------------------------------------
+def tenant_gauges() -> dict[str, float]:
+    """Flat ``tenant.<id>.<cost>`` gauges for the scrape (pin
+    byte-seconds accrued first so residency time is current)."""
+    out: dict[str, float] = {}
+    for cid, costs in METER.snapshot().items():
+        for key, v in costs.items():
+            out[f"tenant.{cid}.{key}"] = round(v, 6)
+    return out
+
+
+def refresh_tenant_gauges() -> dict[str, float]:
+    """Accrue pin residency and fold the per-client gauges into the
+    METRICS registry so every scrape path (worker status,
+    /debug/metrics, heartbeat snapshot) carries them."""
+    try:
+        accrue_pins()
+    except Exception:  # noqa: BLE001 — a ledger hiccup must not break the scrape
+        METRICS.add("obs.telemetry_errors")
+    g = tenant_gauges()
+    for name, v in g.items():
+        METRICS.gauge(name, v)
+    return g
+
+
+def tenants_snapshot() -> dict:
+    """The ``/debug/tenants`` document: per-client costs, totals, and
+    the conservation check — summed per-client device-seconds against
+    the measured total launch wall (the ``device.dispatch`` stage
+    timing both derive from)."""
+    try:
+        accrue_pins()
+    except Exception:  # noqa: BLE001 — best-effort accrual, like the scrape path
+        METRICS.add("obs.telemetry_errors")
+    clients = METER.snapshot()
+    totals = METER.totals()
+    launch_wall = float(METRICS.timings.get("device.dispatch", 0.0))
+    metered = totals.get("device_seconds", 0.0)
+    return {
+        "clients": clients,
+        "totals": totals,
+        "conservation": {
+            "device_seconds_sum": round(metered, 6),
+            "launch_wall_s": round(launch_wall, 6),
+            # < 1.0 means untenanted launches ran too (work outside
+            # any serving scope is deliberately unmetered, not guessed)
+            "coverage": round(metered / launch_wall, 4)
+            if launch_wall > 0 else None,
+        },
+    }
+
+
+def clients_from_gauges(gauges: dict) -> dict[str, dict[str, float]]:
+    """Reconstruct {client: {cost: value}} from flat
+    ``[fleet.]tenant.<id>.<cost>`` gauge names (the cost key never
+    contains a dot, so rsplit is safe even for dotted client ids) —
+    how a coordinator renders a REMOTE fleet's metering from the
+    node-summed gauges it already aggregates."""
+    out: dict[str, dict[str, float]] = {}
+    for name, v in gauges.items():
+        if name.startswith("fleet."):
+            name = name[len("fleet."):]
+        if not name.startswith("tenant."):
+            continue
+        rest = name[len("tenant."):]
+        cid, _, key = rest.rpartition(".")
+        if cid:
+            out.setdefault(cid, {})[key] = float(v)
+    return out
+
+
+def _client_rows(clients: dict[str, dict[str, float]]) -> list[str]:
+    lines = []
+    if clients:
+        lines.append(
+            f"  {'client':<16} {'queries':>8} {'dev_s':>10} "
+            f"{'h2d_MB':>9} {'pin_GBs':>9} {'hedge_s':>8} {'shed':>5}"
+        )
+    else:
+        lines.append("  (no metered clients — serve with client_id "
+                     "to attribute costs)")
+    for cid in sorted(clients):
+        c = clients[cid]
+        lines.append(
+            f"  {cid:<16} {int(c.get('queries', 0)):>8} "
+            f"{c.get('device_seconds', 0.0):>10.4f} "
+            f"{c.get('h2d_bytes', 0.0) / 1e6:>9.2f} "
+            f"{c.get('pin_byte_seconds', 0.0) / 1e9:>9.3f} "
+            f"{c.get('hedge_duplicate_seconds', 0.0):>8.3f} "
+            f"{int(c.get('shed', 0)):>5}"
+        )
+    return lines
+
+
+def tenants_text() -> str:
+    """The ``datafusion-tpu top --tenants`` table for THIS process's
+    meter, with the conservation line."""
+    doc = tenants_snapshot()
+    lines = ["tenants:"] + _client_rows(doc["clients"])
+    cons = doc["conservation"]
+    cov = cons["coverage"]
+    lines.append(
+        f"  conservation: sum(device_seconds)="
+        f"{cons['device_seconds_sum']:.4f}s vs launch wall "
+        f"{cons['launch_wall_s']:.4f}s"
+        + (f" (coverage {cov * 100:.1f}%)" if cov is not None else "")
+    )
+    return "\n".join(lines)
+
+
+def tenants_text_from_gauges(gauges: dict) -> str:
+    """The ``--tenants`` table for a REMOTE fleet, rendered from the
+    coordinator's node-summed ``tenant.<id>.*`` gauges (a fresh CLI
+    process's own meter is empty — the fleet's is not)."""
+    lines = ["tenants (fleet sums):"]
+    lines += _client_rows(clients_from_gauges(gauges))
+    return "\n".join(lines)
+
+
+def reset_for_tests() -> None:
+    """Drop every accumulator (tests own the process-global state)."""
+    METER.clear()
+    EXPLAINER.clear()
+    _PIN_CLIENTS.clear()
+    _PIN_ACCRUED_AT.clear()
+    _metrics.CLIENT_SCOPES.clear()
+
+
+# typing helper for embedders wiring custom scopes
+Scope = Any

@@ -370,9 +370,15 @@ class DeviceLedger:
         # warm-pinned-table contract is "zero transfers", and a count
         # is assertable where a ring of flight events is not
         METRICS.add("device.h2d.transfers")
+        from datafusion_tpu.obs.attribution import charge_h2d
         from datafusion_tpu.obs.stats import record_h2d_time
 
         record_h2d_time(seconds)
+        # per-client metering: the transferred bytes charge this
+        # thread's published charge scope (lock-free, like the rest of
+        # this path — obs/attribution.py carries the same DF005
+        # contract)
+        charge_h2d(nbytes)
         attrs = {
             "bytes": nbytes,
             "ms": round(seconds * 1e3, 3),

@@ -151,6 +151,67 @@ def build_bundle(*, label: Optional[str] = None,
     return doc
 
 
+def build_bundle_tar(*, label: Optional[str] = None,
+                     gauges_fn: Optional[Callable[[], dict]] = None,
+                     status_fn: Optional[Callable[[], dict]] = None,
+                     profile_seconds: float = _BUNDLE_PROFILE_S_DEFAULT,
+                     trace_id: Optional[str] = None) -> bytes:
+    """The bundle as a TAR stream (``/debug/bundle?format=tar``): raw
+    span/ring/profile attachments ship as their own members instead of
+    being inlined into one giant JSON document — on a very large fleet
+    the ring alone can run to tens of MB per node, and members stream,
+    diff, and grep where a monolithic JSON blob only loads.
+
+    Members: ``bundle.json`` (the core document, heavy attachments
+    replaced by member references), ``flights.jsonl`` (one flight
+    event per line), ``spans.jsonl`` (the raw span buffer, one span
+    per line), ``metrics.prom`` (the Prometheus exposition),
+    ``profile.json`` / ``profile_continuous.json`` (host profiles),
+    ``tenants.json`` (per-client metering), ``tail.json`` (the tail
+    explainer report)."""
+    import io
+    import tarfile
+
+    from datafusion_tpu.obs import attribution
+    from datafusion_tpu.obs import trace as obs_trace
+
+    doc = build_bundle(label=label, gauges_fn=gauges_fn,
+                       status_fn=status_fn,
+                       profile_seconds=profile_seconds,
+                       trace_id=trace_id)
+    members: dict[str, bytes] = {}
+    flights = doc.pop("flights", {}) or {}
+    members["flights.jsonl"] = "\n".join(
+        json.dumps(e, default=str) for e in flights.get("events", [])
+    ).encode()
+    members["metrics.prom"] = str(doc.pop("metrics", "")).encode()
+    members["spans.jsonl"] = "\n".join(
+        json.dumps(s, default=str) for s in obs_trace.spans(trace_id)
+    ).encode()
+    for key, name in (("profile", "profile.json"),
+                      ("profile_continuous", "profile_continuous.json")):
+        attachment = doc.pop(key, None)
+        if attachment is not None:
+            members[name] = json.dumps(attachment, default=str).encode()
+    members["tenants.json"] = json.dumps(
+        attribution.tenants_snapshot(), default=str).encode()
+    members["tail.json"] = json.dumps(
+        attribution.EXPLAINER.explain(), default=str).encode()
+    doc["flights"] = {"events_emitted": flights.get("events_emitted"),
+                      "member": "flights.jsonl"}
+    doc["attachments"] = sorted(members)
+    members["bundle.json"] = json.dumps(doc, default=str).encode()
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tf:
+        now = int(time.time())
+        for name in sorted(members):
+            info = tarfile.TarInfo(name=name)
+            info.size = len(members[name])
+            info.mtime = now
+            tf.addfile(info, io.BytesIO(members[name]))
+    return buf.getvalue()
+
+
 def write_local_bundle(directory: str, reason: str = "manual",
                        profile_seconds: float = _BUNDLE_PROFILE_S_DEFAULT,
                        ) -> str:
@@ -198,9 +259,16 @@ GET /debug/flights[?trace_id=]  flight-recorder ring dump (JSON)
 GET /debug/hbm                HBM residency ledger breakdown (JSON)
 GET /debug/serve              serving front door: admission counters,
                               pinned tables, megabatch stats (JSON)
+GET /debug/tenants            per-client metering: device-seconds,
+                              H2D bytes, pin byte-seconds, hedge
+                              duplicates + conservation check (JSON)
+GET /debug/tail[?window_s=N]  tail explainer: per-segment p50/p95/p99
+                              contributions, ranked (JSON)
 GET /debug/top                fleet/local top view (text)
 GET /debug/profile?seconds=N[&hz=H&format=speedscope|collapsed|json]
-GET /debug/bundle[?seconds=N&trace_id=]  one artifact: everything above
+GET /debug/bundle[?seconds=N&trace_id=&format=tar]  one artifact:
+                              everything above (format=tar streams raw
+                              span/ring/profile attachments as members)
 GET /status | /healthz        node status (JSON)
 
 Auth: when DATAFUSION_TPU_DEBUG_TOKEN is set, every /debug/* and
@@ -261,10 +329,12 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
     if path in ("/", "/debug"):
         return _text_body(_INDEX.format(label=srv.label))
     if path in ("/debug/metrics", "/metrics"):
+        from datafusion_tpu.obs import attribution
         from datafusion_tpu.obs.aggregate import refresh_host_gauges
         from datafusion_tpu.obs.export import prometheus_text
 
         refresh_host_gauges()
+        attribution.refresh_tenant_gauges()
         return (200, "text/plain; version=0.0.4",
                 prometheus_text(METRICS, extra_gauges=srv.gauges()).encode())
     if path == "/debug/flights":
@@ -308,6 +378,21 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
                 "p99_s": h.quantile(0.99),
             },
         })
+    if path == "/debug/tenants":
+        from datafusion_tpu.obs import attribution
+
+        return _json_body({
+            "node": srv.label,
+            **attribution.tenants_snapshot(),
+        })
+    if path == "/debug/tail":
+        from datafusion_tpu.obs import attribution
+
+        window = float(q["window_s"]) if q.get("window_s") else None
+        return _json_body({
+            "node": srv.label,
+            **attribution.EXPLAINER.explain(window),
+        })
     if path == "/debug/top":
         return _text_body(srv.top())
     if path == "/debug/profile":
@@ -325,6 +410,15 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
             return _json_body(rep.to_json())
         return _json_body(rep.speedscope())
     if path == "/debug/bundle":
+        if q.get("format") == "tar":
+            return (200, "application/x-tar", build_bundle_tar(
+                label=srv.label,
+                gauges_fn=srv.gauges,
+                status_fn=srv.status_fn,
+                profile_seconds=float(
+                    q.get("seconds", _BUNDLE_PROFILE_S_DEFAULT)),
+                trace_id=q.get("trace_id") or None,
+            ))
         return _json_body(build_bundle(
             label=srv.label,
             gauges_fn=srv.gauges,

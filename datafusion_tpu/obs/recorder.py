@@ -298,6 +298,20 @@ def capture_query_artifacts(reason: str, *, wall_s: Optional[float] = None,
         prof = _profiler.continuous_report()
         if prof is not None and prof.samples:
             extra["profile"] = prof.to_json()
+        # the tail explainer's ranked segment report rides every slow/
+        # failed-query artifact, and a traced query also gets its own
+        # span-tree critical path (hedge losers excluded) — the
+        # artifact names the guilty segment, not just the guilty query
+        try:
+            from datafusion_tpu.obs import attribution
+
+            extra["tail"] = attribution.EXPLAINER.explain()
+            if spans:
+                extra["critical_path"] = (
+                    attribution.critical_path_from_spans(spans)
+                )
+        except Exception:  # noqa: BLE001 — attribution must not block the dump
+            pass
         if spans:
             extra["otlp"] = spans_to_otlp(spans)
         if node_dumps_fn is not None:

@@ -196,7 +196,7 @@ class SloWatchdog:
                 if self.capture_on_breach:
                     recorder.auto_capture(
                         "slo_breach",
-                        lambda row=row: {"slo": row},
+                        lambda row=row: _breach_extra(row),
                     )
             elif not row["breached"]:
                 self._breached.discard(obj.name)
@@ -209,6 +209,22 @@ class SloWatchdog:
             self._burn(obj, self._samples(obj.window_s or self.window_s))
             for obj in self.objectives
         ]
+
+
+def _breach_extra(row: dict) -> dict:
+    """The breach artifact's context: the burn-rate row PLUS the tail
+    explainer's ranked per-segment report (obs/attribution.py) — the
+    artifact an operator reads after the page should already name the
+    guilty segment (queue wait vs batching window vs shared launch vs
+    demux), not just say "p99 burned"."""
+    out = {"slo": row}
+    try:
+        from datafusion_tpu.obs import attribution
+
+        out["tail"] = attribution.EXPLAINER.explain()
+    except Exception:  # noqa: BLE001 — the breach artifact must survive a broken explainer
+        pass
+    return out
 
 
 def objectives_from_env(environ=None) -> list[Objective]:

@@ -431,6 +431,8 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
     from datafusion_tpu.utils import breaker as breaker_mod
     from datafusion_tpu.utils.retry import retry_budget
 
+    from datafusion_tpu.obs import attribution as _attribution
+
     if not workers:
         raise ExecutionError("no workers configured")
     rr = itertools.count()
@@ -440,6 +442,11 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
     # the wire context makes worker-side spans chain under those
     trace_parent = obs_trace.current_span()
     trace_wire = obs_trace.wire_context()
+    # the metering scope is thread-published like the profiler tables,
+    # so it too is captured at the dispatch boundary: a hedge LOSER's
+    # duplicate wall — reported from its own attempt thread, possibly
+    # minutes later — must charge the hedging query's client
+    meter_scope = _attribution.current_scope()
 
     def _breaker(w):
         return breaker_mod.breaker_for(f"worker:{w.host}:{w.port}")
@@ -477,6 +484,12 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
         abandoned loser still delivers its evidence when it eventually
         finishes, minutes after the winner returned."""
         results: _queue.Queue = _queue.Queue()
+        # the winning worker's handle, written by the chooser the
+        # moment a first valid response is accepted: an attempt that
+        # finishes AFTER that and is not the winner is a hedge LOSER —
+        # its wall was pure duplicate cost, metered to the hedging
+        # query's client (never to the critical path)
+        won: list = [None]
 
         def attempt(worker, a_msg, hedged, a_sp, a_timeout):
             t0 = time.perf_counter()
@@ -511,6 +524,14 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                     obs_trace.finish_span(a_sp)
             finally:
                 results.put((worker, hedged, r, err))
+                if won[0] is not None and won[0] is not worker:
+                    # abandoned loser finishing late: its whole wall
+                    # is duplicate work the hedging client pays for
+                    # (a loser that finished BEFORE any winner failed
+                    # — an error, not duplicate device time)
+                    _attribution.charge_hedge_loss(
+                        meter_scope, time.perf_counter() - t0
+                    )
 
         hedge.observe_dispatch()
         threading.Thread(
@@ -560,10 +581,17 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 h_msg["deadline_s"] = max(deadline.remaining(), 0.001)
             h_sp = None
             if trace_wire is not None:
+                # "hedge_attempt" distinguishes the speculative
+                # attempt's own span from the primary request-record
+                # span (which gets a mutated "hedged" marker): the
+                # critical-path walk (obs/attribution.py) excludes a
+                # still-running attempt as a loser ONLY when the
+                # primary record lacks hedge_won
                 h_sp = obs_trace.begin_span(
                     "coord.dispatch", parent=trace_parent,
                     trace_id=trace_wire["trace_id"],
                     attrs={**frag.span_attrs(), "hedged": True,
+                           "hedge_attempt": True,
                            "worker": f"{alt.host}:{alt.port}"},
                 )
                 h_msg["trace"] = {**trace_wire,
@@ -599,6 +627,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
             first = None
             inflight -= 1
             if err is None:
+                won[0] = worker  # late-finishing losers self-report
                 if hedged:
                     METRICS.add("coord.hedges_won")
                     flight.record("query.hedge_won", shard=frag.shard,

@@ -85,18 +85,39 @@ def enabled() -> bool:
 class Ticket:
     """One submitted query's handle: ``result()`` blocks until the
     server fulfills or fails it.  Exactly-once by construction — the
-    outcome slot is written exactly once, under the event."""
+    outcome slot is written exactly once, under the event.
+
+    Beyond the outcome, the ticket is the query's critical-path
+    record: monotonic stamps at every serving-chain boundary (submit
+    entry, admission, window enqueue, window flush, execution start)
+    plus the apportioned launch/demux shares the megabatch path
+    charges back, so ``_finish`` can decompose the end-to-end wall
+    into the canonical segment chain (obs/attribution.py) without a
+    single extra measurement on the hot path."""
 
     __slots__ = ("sql", "plan", "deadline", "submitted_mono", "_evt",
-                 "_table", "_error", "_rel", "signature")
+                 "_table", "_error", "_rel", "signature", "client_id",
+                 "entry_mono", "admitted_mono", "enqueued_mono",
+                 "flushed_mono", "exec_start_mono", "launch_share_s",
+                 "demux_share_s")
 
     def __init__(self, sql: str, plan, deadline: Optional[Deadline],
-                 signature):
+                 signature, client_id: str = "default",
+                 entry_mono: Optional[float] = None):
         self.sql = sql
         self.plan = plan
         self.deadline = deadline
         self.signature = signature
+        self.client_id = client_id
         self.submitted_mono = time.monotonic()
+        self.entry_mono = (entry_mono if entry_mono is not None
+                           else self.submitted_mono)
+        self.admitted_mono: Optional[float] = None
+        self.enqueued_mono: Optional[float] = None
+        self.flushed_mono: Optional[float] = None
+        self.exec_start_mono: Optional[float] = None
+        self.launch_share_s = 0.0   # apportioned megabatch launch wall
+        self.demux_share_s = 0.0    # apportioned blob-pull wall
         self._evt = threading.Event()
         self._table = None
         self._error: Optional[BaseException] = None
@@ -229,6 +250,9 @@ class PinnedSource(DataSource):
         if res is not None:
             for b in res:
                 b.cache.clear()
+        from datafusion_tpu.obs.attribution import forget_pin
+
+        forget_pin(self.fingerprint)
         METRICS.add("serve.tables_evicted")
         recorder.record("serve.evict", table=self.name)
 
@@ -399,10 +423,13 @@ class Server:
             self._thread = None
         # the loop thread is dead: every ticket still registered as
         # queued (in the window, or in a dropped _enqueue callback)
-        # gets a prompt shutdown shed instead of hanging its client
+        # gets a prompt shutdown shed instead of hanging its client.
+        # The registration map is NOT cleared here — _shed_ticket's
+        # pop is the exactly-once guard, and an executor thread
+        # (shut down with wait=False) may still be admitting or
+        # deadline-shedding the same tickets concurrently
         with self._lock:
             stranded = list(self._queued_tickets.values())
-            self._queued_tickets.clear()
         for t in stranded:
             if not t.done:
                 self._shed_ticket(t, "shutdown")
@@ -415,15 +442,21 @@ class Server:
         self.stop()
 
     # -- admission (caller thread) -------------------------------------
-    def submit(self, sql: str,
-               deadline_s: Optional[float] = None) -> Ticket:
+    def submit(self, sql: str, deadline_s: Optional[float] = None,
+               client_id: Optional[str] = None) -> Ticket:
         """Admit one SQL query.  Returns a `Ticket`; raises
         `QueryShedError` when admission refuses it (the counted,
-        flight-recorded backpressure decision)."""
+        flight-recorded backpressure decision).  ``client_id`` is the
+        metering identity: every shared cost this query incurs —
+        launch shares, H2D bytes, pin residency, hedge duplicates —
+        apportions back to it (``tenant.<id>.*`` gauges,
+        ``/debug/tenants``); unset, costs pool under ``"default"``."""
         from datafusion_tpu.errors import NotSupportedError
         from datafusion_tpu.sql import ast
         from datafusion_tpu.sql.parser import parse_sql
 
+        entry_mono = time.monotonic()
+        client = str(client_id) if client_id else "default"
         with METRICS.timer("parse"):
             stmt = parse_sql(sql)
         if isinstance(stmt, ast.SqlCreateExternalTable):
@@ -431,7 +464,7 @@ class Server:
             # (not counted as submitted — only queries enter the
             # admitted + shed == submitted conservation)
             out = self.ctx._execute_ddl(stmt)
-            t = Ticket(sql, None, None, None)
+            t = Ticket(sql, None, None, None, client_id=client)
             t._fulfill(out)
             return t
         if isinstance(stmt, ast.SqlExplain):
@@ -446,7 +479,7 @@ class Server:
         with self._lock:
             self.submitted += 1
         if self._closed:
-            raise self._shed_submit(sql, "shutdown")
+            raise self._shed_submit(sql, "shutdown", client)
 
         # 1. deadline feasibility
         deadline = None
@@ -455,14 +488,15 @@ class Server:
         if budget is not None:
             ewma = self._service_ewma_s
             if budget <= 0 or (ewma is not None and budget < 0.5 * ewma):
-                raise self._shed_submit(sql, "deadline")
+                raise self._shed_submit(sql, "deadline", client)
             deadline = Deadline.after(budget)
         # 2. HBM headroom (capacity known, table not yet resident)
         reason = self._check_hbm(plan)
         if reason is not None:
-            raise self._shed_submit(sql, reason)
+            raise self._shed_submit(sql, reason, client)
 
-        ticket = Ticket(sql, plan, deadline, self._mega_signature(plan))
+        ticket = Ticket(sql, plan, deadline, self._mega_signature(plan),
+                        client_id=client, entry_mono=entry_mono)
         # 3. queue depth — checked and RESERVED in one lock acquisition
         # (a read-then-increment across two acquisitions would let N
         # concurrent submitters all pass a depth-1 check), re-checking
@@ -475,33 +509,61 @@ class Server:
                 self._pending += 1
                 self._queued_tickets[id(ticket)] = ticket
                 closed = self._closed
+                METRICS.gauge("serve.queue_depth", self._pending)
         if at_depth:
-            raise self._shed_submit(sql, "queue")
+            raise self._shed_submit(sql, "queue", client)
         if closed:
             self._shed_ticket(ticket, "shutdown")
-            raise ticket._error
+            # a racing stop() drain may have won the shed (the pop is
+            # the exactly-once guard) and not yet written the error —
+            # the refusal itself must not depend on who shed first
+            raise ticket._error if ticket._error is not None else \
+                QueryShedError(
+                    f"query shed at admission (shutdown): {sql[:80]!r}",
+                    reason="shutdown",
+                )
+        ticket.admitted_mono = time.monotonic()
         METRICS.add("queries_queued")
-        recorder.record("serve.queued", plan=type(plan).__name__)
+        recorder.record("serve.queued", plan=type(plan).__name__,
+                        client=client)
         self._loop.call_soon(partial(self._enqueue, ticket))
         return ticket
 
-    def _shed_submit(self, sql: str, reason: str) -> QueryShedError:
+    def _shed_submit(self, sql: str, reason: str,
+                     client: str = "default") -> QueryShedError:
+        from datafusion_tpu.obs.attribution import METER
+
         with self._lock:
             self.shed += 1
         METRICS.add("queries_shed")
-        recorder.record("serve.shed", reason=reason)
+        METER.charge(client, "shed", 1.0)
+        recorder.record("serve.shed", reason=reason, client=client)
         return QueryShedError(
             f"query shed at admission ({reason}): {sql[:80]!r}",
             reason=reason,
         )
 
     def _shed_ticket(self, t: Ticket, reason: str) -> None:
+        """Shed a ticket that already passed queue-depth reservation.
+        IDEMPOTENT per ticket: the registration pop is the guard — a
+        stop()-time drain racing an executor-side deadline shed (the
+        loop's executor shuts down with wait=False, so _run_group can
+        still be running) must count the shed and release the queue
+        slot exactly ONCE, or ``self._pending`` (the live queue-depth
+        gauge ``queries_queued`` feeds) goes negative and conservation
+        breaks."""
+        from datafusion_tpu.obs.attribution import METER
+
         with self._lock:
+            if self._queued_tickets.pop(id(t), None) is None:
+                return  # already shed or already admitted elsewhere
             self.shed += 1
             self._pending -= 1
-            self._queued_tickets.pop(id(t), None)
+            METRICS.gauge("serve.queue_depth", self._pending)
         METRICS.add("queries_shed")
-        recorder.record("serve.shed", reason=reason, queued=True)
+        METER.charge(t.client_id, "shed", 1.0)
+        recorder.record("serve.shed", reason=reason, queued=True,
+                        client=t.client_id)
         t._fail(QueryShedError(
             f"query shed after queueing ({reason}): {t.sql[:80]!r}",
             reason=reason,
@@ -547,6 +609,7 @@ class Server:
 
     # -- dispatch (loop thread) ----------------------------------------
     def _enqueue(self, t: Ticket) -> None:
+        t.enqueued_mono = time.monotonic()
         self._window.append(t)
         if len(self._window) >= max(self._megabatch_max, 1):
             # size-triggered early flush: the window is a MAXIMUM wait,
@@ -567,8 +630,11 @@ class Server:
         if not self._window:
             return
         batch, self._window = self._window, []
+        now = time.monotonic()
         groups: dict = {}
         singles: list[list[Ticket]] = []
+        for t in batch:
+            t.flushed_mono = now
         for t in batch:
             if t.signature is None:
                 singles.append([t])
@@ -630,9 +696,12 @@ class Server:
     def _run_group(self, group: list[Ticket]) -> None:
         from datafusion_tpu.cache import scan_tables
         from datafusion_tpu.exec.aggregate import force_core_predicate
+        from datafusion_tpu.obs.attribution import client_scope
 
+        exec_start = time.monotonic()
         ready: list[Ticket] = []
         for t in group:
+            t.exec_start_mono = exec_start
             if t.deadline is not None and t.deadline.expired:
                 self._shed_ticket(t, "deadline")
                 continue
@@ -642,20 +711,31 @@ class Server:
         if self._pin_enabled:
             for t in ready:
                 for tbl in scan_tables(t.plan):
-                    self._ensure_resident(tbl)
+                    self._ensure_resident(tbl, client_id=t.client_id)
         # lower every plan to a relation (counts queries_admitted)
         executed: list[Ticket] = []
         megabatchable = any(t.signature is not None for t in ready)
         for t in ready:
+            admitted = False
             with self._lock:
-                self._pending -= 1
-                self._queued_tickets.pop(id(t), None)
-                # per-server mirror of the queries_admitted counter's
-                # semantics (counted at execute entry, errors included)
-                # so conservation is assertable on one instance
-                self.admitted += 1
+                if self._queued_tickets.pop(id(t), None) is not None:
+                    self._pending -= 1
+                    METRICS.gauge("serve.queue_depth", self._pending)
+                    # per-server mirror of the queries_admitted
+                    # counter's semantics (counted at execute entry,
+                    # errors included) so conservation is assertable
+                    # on one instance.  Gated on the registration pop:
+                    # a stop()-time shutdown shed that beat us here
+                    # already counted this ticket on the shed side
+                    self.admitted += 1
+                    admitted = True
+            if not admitted:
+                continue  # shed concurrently (shutdown drain won)
+            recorder.record("serve.admit", client=t.client_id,
+                            plan=type(t.plan).__name__)
             try:
-                with deadline_scope(t.deadline):
+                with deadline_scope(t.deadline), \
+                        client_scope(t.client_id):
                     if megabatchable and t.signature is not None:
                         with force_core_predicate():
                             t._rel = self.ctx.execute(t.plan)
@@ -680,7 +760,7 @@ class Server:
                     rest.extend(sub)
                     continue
                 try:
-                    self._run_megabatch([t._rel for t in sub])
+                    self._run_megabatch(sub)
                 except Exception:  # noqa: BLE001 — megabatch is an optimization; serial is the answer path
                     METRICS.add("serve.megabatch_fallbacks")
                     for t in sub:
@@ -730,10 +810,23 @@ class Server:
         rel._str_aux_cache = entry["str_aux"]
         rel._ids_lock = entry["lock"]
 
-    def _run_megabatch(self, rels: list) -> None:
+    def _run_megabatch(self, tickets: list[Ticket]) -> None:
         """ONE scan, ONE launch per batch group, N queries' states: the
         cross-query fused pass.  Preconditions (``_mega_key``): every
-        relation shares ``rels[0].core`` and scans the same table."""
+        ticket's relation shares ``tickets[0]._rel.core`` and scans the
+        same table.
+
+        Cost apportionment (obs/attribution.py): the whole pass runs
+        under a ``shared_scope`` whose members are the tickets'
+        clients weighted by row weight — every member query of a
+        megabatch consumes the SAME shared scan, so row weights
+        degenerate to an even split today (the formula generalizes
+        the moment members contribute unequal row sets).  Launch walls
+        measured in ``device_call`` and H2D bytes at the ledger seam
+        split by those weights automatically; the blob-packed demux
+        pull is timed here and split the same way.  Each ticket's
+        ``launch_share_s`` / ``demux_share_s`` record its share for
+        the critical-path segments."""
         from datafusion_tpu.exec.aggregate import group_capacity
         from datafusion_tpu.exec.batch import device_inputs
         from datafusion_tpu.exec.expression import compute_aux_values
@@ -744,9 +837,13 @@ class Server:
             pad_group,
         )
         from datafusion_tpu.exec.relation import device_scope
+        from datafusion_tpu.obs.attribution import shared_scope
         from datafusion_tpu.obs.stats import iter_stats
         from datafusion_tpu.utils.retry import device_call
 
+        rels = [t._rel for t in tickets]
+        weight = 1.0 / len(tickets)
+        members = tuple((t.client_id, weight) for t in tickets)
         leader = rels[0]
         core = leader.core
         for r in rels:
@@ -804,41 +901,54 @@ class Server:
                 METRICS.add("serve.megabatch_batches", len(idxs))
             chunk.clear()
 
-        for batch in iter_stats(leader.child):
-            for idx in core.key_cols:
-                if batch.dicts[idx] is not None:
-                    leader._key_dicts[idx] = batch.dicts[idx]
-            ids = leader._group_ids(batch)
-            staged = batch.cache.get("staged_aux")
-            if staged is not None and staged[0] is core:
-                aux = tuple(staged[1])
-                str_aux = staged[2] if len(staged) > 2 else \
-                    leader._compute_str_aux(batch, core.slots)
+        with shared_scope(members) as launch_acc:
+            for batch in iter_stats(leader.child):
+                for idx in core.key_cols:
+                    if batch.dicts[idx] is not None:
+                        leader._key_dicts[idx] = batch.dicts[idx]
+                ids = leader._group_ids(batch)
+                staged = batch.cache.get("staged_aux")
+                if staged is not None and staged[0] is core:
+                    aux = tuple(staged[1])
+                    str_aux = staged[2] if len(staged) > 2 else \
+                        leader._compute_str_aux(batch, core.slots)
+                else:
+                    aux = tuple(compute_aux_values(
+                        core.aux_specs, batch, leader._aux_cache
+                    ))
+                    str_aux = leader._compute_str_aux(batch, core.slots)
+                with device_scope(device):
+                    data, validity, mask = device_inputs(
+                        leader._device_view(batch, core), device,
+                        core.wire_hints,
+                    )
+                chunk.append((data, validity, aux,
+                              np.int32(batch.num_rows),
+                              mask, ids, str_aux))
+                if len(chunk) >= fuse:
+                    flush()
+            flush()
+            if states is None:
+                states = [core._init_state(group_capacity(1))] * n_live
             else:
-                aux = tuple(compute_aux_values(
-                    core.aux_specs, batch, leader._aux_cache
-                ))
-                str_aux = leader._compute_str_aux(batch, core.slots)
-            with device_scope(device):
-                data, validity, mask = device_inputs(
-                    leader._device_view(batch, core), device,
-                    core.wire_hints,
-                )
-            chunk.append((data, validity, aux, np.int32(batch.num_rows),
-                          mask, ids, str_aux))
-            if len(chunk) >= fuse:
-                flush()
-        flush()
-        if states is None:
-            states = [core._init_state(group_capacity(1))] * n_live
-        else:
-            # ONE blob-packed pull for every query's accumulator state:
-            # N separate finalize-time pulls would pay N pack launches
-            # and N link round trips — the de-multiplex ships as one
-            # transfer and finalize slices numpy
-            from datafusion_tpu.exec.batch import device_pull
+                # ONE blob-packed pull for every query's accumulator
+                # state: N separate finalize-time pulls would pay N
+                # pack launches and N link round trips — the
+                # de-multiplex ships as one transfer and finalize
+                # slices numpy
+                from datafusion_tpu.exec.batch import device_pull
 
-            states = list(device_pull(tuple(states)))
+                pull_t0 = time.perf_counter()
+                states = list(device_pull(tuple(states)))
+                pull_s = time.perf_counter() - pull_t0
+                for t in tickets:
+                    t.demux_share_s += pull_s * weight
+        # the scope's accumulator measured every launch wall the pass
+        # dispatched (device_call's own measurement — the same number
+        # the meter charged, split by the same weights): each ticket's
+        # critical path gets its apportioned share
+        for t in tickets:
+            t.launch_share_s += launch_acc[0] * weight
         for r, s in zip(rels, states):
             if r is not leader:
                 r._key_dicts.update(leader._key_dicts)
@@ -848,27 +958,89 @@ class Server:
     def _finish(self, t: Ticket) -> None:
         """Materialize one ticket's relation and fulfill it (the
         per-client de-multiplex point for megabatched queries — each
-        relation finalizes its OWN state)."""
+        relation finalizes its OWN state).  Also the attribution
+        point: the end-to-end wall decomposes into the canonical
+        serving segments from the ticket's stamps + apportioned
+        shares, the path feeds the tail explainer, and the serve wall
+        — the latency the CLIENT saw, queue wait included — feeds the
+        SLO watchdog (the inner materialization wall alone would hide
+        exactly the queueing tail serving SLOs exist to catch)."""
         from datafusion_tpu.exec.materialize import collect
+        from datafusion_tpu.obs import slo
         from datafusion_tpu.obs.aggregate import observe_latency
+        from datafusion_tpu.obs.attribution import (
+            client_scope,
+            observe_path,
+        )
 
         try:
             rel = t._rel
             if "_injected_state" not in getattr(rel, "__dict__", {}):
                 self._adopt_shared_if_aggregate(rel)
-            with deadline_scope(t.deadline):
+            fin_t0 = time.monotonic()
+            with deadline_scope(t.deadline), \
+                    client_scope(t.client_id) as launch_acc:
                 table = collect(rel)
+            fin_wall = time.monotonic() - fin_t0
             t._fulfill(table)
-            wall = time.monotonic() - t.submitted_mono
+            t.launch_share_s += launch_acc[0]
+            wall = time.monotonic() - t.entry_mono
             observe_latency("serve.latency", wall)
+            slo.WATCHDOG.observe(wall)
+            observe_path(t.client_id, wall, self._segments(
+                t, wall, fin_wall, launch_acc[0]
+            ))
             ewma = self._service_ewma_s
             self._service_ewma_s = (
                 wall if ewma is None else 0.8 * ewma + 0.2 * wall
             )
-            recorder.record("serve.done", ms=round(wall * 1e3, 3))
+            recorder.record("serve.done", ms=round(wall * 1e3, 3),
+                            client=t.client_id)
         except BaseException as e:  # noqa: BLE001 — delivered to the client
             METRICS.add("serve.query_errors")
+            # the error still counts against error-rate SLOs with the
+            # client-visible wall (the funnel's own watchdog feed is
+            # suppressed for served queries — see query_completed)
+            slo.WATCHDOG.observe(
+                time.monotonic() - t.entry_mono, error=True
+            )
             t._fail(e)
+
+    @staticmethod
+    def _segments(t: Ticket, wall: float, fin_wall: float,
+                  fin_launch_s: float) -> dict:
+        """One ticket's canonical critical-path chain (seconds), from
+        its lifecycle stamps and apportioned shares:
+
+        - ``admission``: submit entry -> queue-slot reservation
+          (parse + plan + feasibility/HBM checks);
+        - ``megabatch_window``: parked in the batching window;
+        - ``queue_wait``: loop hand-off plus waiting for an executor
+          slot behind earlier groups — the segment induced queueing
+          grows;
+        - ``shared_launch_share``: this query's apportioned slice of
+          every launch wall it rode (megabatched or solo);
+        - ``demux_pull``: its share of the blob-packed state pull;
+        - ``merge``: host-side finalize/materialize minus the launch
+          wall already attributed;
+        - ``other``: the unaccounted remainder (never negative).
+        """
+        entry = t.entry_mono
+        admitted = t.admitted_mono or entry
+        enqueued = t.enqueued_mono or admitted
+        flushed = t.flushed_mono or enqueued
+        started = t.exec_start_mono or flushed
+        seg = {
+            "admission": max(admitted - entry, 0.0),
+            "megabatch_window": max(flushed - enqueued, 0.0),
+            "queue_wait": max(enqueued - admitted, 0.0)
+            + max(started - flushed, 0.0),
+            "shared_launch_share": t.launch_share_s,
+            "demux_pull": t.demux_share_s,
+            "merge": max(fin_wall - fin_launch_s, 0.0),
+        }
+        seg["other"] = max(wall - sum(seg.values()), 0.0)
+        return seg
 
     def _adopt_shared_if_aggregate(self, rel) -> None:
         from datafusion_tpu.exec.aggregate import AggregateRelation
@@ -878,7 +1050,8 @@ class Server:
             self._adopt_shared(rel)
 
     # -- pinning -------------------------------------------------------
-    def _ensure_resident(self, table: str) -> None:
+    def _ensure_resident(self, table: str,
+                         client_id: str = "default") -> None:
         ds = self.ctx.datasources.get(table)
         if ds is None:
             return
@@ -891,7 +1064,8 @@ class Server:
             # cached results must survive the promotion
             self.ctx.datasources[table] = pinned
             ds = pinned
-        if not ds.resident:
+        newly_resident = not ds.resident
+        if newly_resident:
             # pin only when the measured headroom (if known) still
             # covers the estimate — an admission decision made earlier
             # in the window can be stale by dispatch time, and pinning
@@ -902,6 +1076,14 @@ class Server:
                 METRICS.add("serve.pin_denied")
                 return
         ds.ensure()
+        if newly_resident:
+            # pin byte-seconds accrue to the client whose query
+            # materialized the resident (obs/attribution.py) — a pin
+            # that outlives its creator keeps charging them: residency
+            # is a cost somebody holds, not a one-time event
+            from datafusion_tpu.obs.attribution import register_pin_client
+
+            register_pin_client(ds.fingerprint, client_id)
         # re-attribute the resident batches' cached device copies (and
         # measure them) under the pin's owner tag
         self._retag_pin(ds)

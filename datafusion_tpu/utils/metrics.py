@@ -30,6 +30,18 @@ from contextlib import contextmanager
 PROFILE_STAGES = None  # type: ignore[var-annotated]
 PROFILE_TRACES = None  # type: ignore[var-annotated]
 
+# -- per-client charge scopes (obs/attribution.py) --------------------
+# {thread_ident: scope payload} — which client's work this thread is
+# doing, published by the serving front door (attribution.client_scope
+# / shared_scope) and read by the cost hooks on other subsystems' hot
+# paths (utils/retry.device_call launch walls, the obs/device.py H2D
+# seam).  Same contract as the profiler tables above: plain dict ops,
+# lock-free (DF005), one global read + .get miss when serving is off.
+# Always a dict (not None-gated): the readers are per-launch, not
+# per-sample, and a dict miss is cheaper than a None dance at every
+# publisher.
+CLIENT_SCOPES: dict = {}
+
 
 def set_profile_tables(stages, traces) -> None:
     """Install (or clear, with None/None) the publication tables —

@@ -254,10 +254,16 @@ def device_call(fn, /, *args, _tag=None, **kwargs):
             METRICS.observe("device.dispatch", wall)
             if _tag is not None:
                 METRICS.add(f"device.launches.{_tag}")
+            from datafusion_tpu.obs.attribution import note_launch
             from datafusion_tpu.obs.recorder import record as flight_record
             from datafusion_tpu.obs.stats import record_launch
 
             record_launch()
+            # per-client metering: the launch wall charges this
+            # thread's published charge scope (a megabatched launch's
+            # shared scope splits it by member weight) — one dict read
+            # when serving is off
+            note_launch(wall)
             flight_record("device.launch", attempt=attempt, kernel=_tag,
                           ms=round(wall * 1e3, 3))
             return out

@@ -21,6 +21,14 @@ clients, one hot table — and gates on the acceptance criteria:
 6. Admission-counter conservation: admitted + shed == submitted, and
    queue-depth sheds are real decisions (exercised with a depth-1
    server).
+7. Per-client metering conservation (obs/attribution.py): every
+   closed-loop client's device-seconds delta is recorded, their sum is
+   within 10% of the measured launch wall over the timed phase, and a
+   live ``/debug/tenants`` scrape serves the per-client breakdown.
+8. Tail attribution: an induced-queueing phase (one executor, no
+   megabatching, a launch floor) breaches a tight p99 SLO whose
+   artifact carries the tail explainer ranking ``queue_wait`` as the
+   dominant p99 segment.
 
 The load generator, rung warm-up, floor injection, and timed-phase
 quantile machinery are shared with the ``concurrency`` bench config
@@ -57,6 +65,7 @@ def main() -> int:
     from datafusion_tpu.errors import QueryShedError
     from datafusion_tpu.exec.context import ExecutionContext
     from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.obs import attribution
     from datafusion_tpu.obs.aggregate import HISTOGRAMS
     from datafusion_tpu.obs.device import LEDGER
     from datafusion_tpu.testing import faults
@@ -112,6 +121,11 @@ def main() -> int:
         h_before = (HISTOGRAMS["serve.latency"].snapshot()
                     if "serve.latency" in HISTOGRAMS else None)
         before = dict(METRICS.counts)
+        meter_before = {
+            cid: dict(costs)
+            for cid, costs in attribution.METER.snapshot().items()
+        }
+        dispatch_before = METRICS.timings.get("device.dispatch", 0.0)
         if FLOOR_MS > 0:
             faults.install(floor)
         try:
@@ -203,6 +217,107 @@ def main() -> int:
     print(f"admission: conservation holds "
           f"(admitted {srv.admitted} + shed {srv.shed} == submitted "
           f"{srv.submitted}); depth-1 server shed {shed}/8", flush=True)
+
+    # gate 7: per-client metering sums to the fleet's measured launch
+    # wall (within 10%) over the timed phase, and /debug/tenants
+    # serves the per-client breakdown live
+    import json
+    import urllib.request
+
+    meter_after = attribution.METER.snapshot()
+
+    def _delta(cid: str, key: str) -> float:
+        return (meter_after.get(cid, {}).get(key, 0.0)
+                - meter_before.get(cid, {}).get(key, 0.0))
+
+    client_ids = [f"c{ci}" for ci in range(CLIENTS)]
+    for cid in client_ids:
+        assert _delta(cid, "queries") == PER_CLIENT, (
+            cid, _delta(cid, "queries"))
+    dev_sum = sum(_delta(cid, "device_seconds") for cid in client_ids)
+    launch_wall = (METRICS.timings.get("device.dispatch", 0.0)
+                   - dispatch_before)
+    assert launch_wall > 0, "timed phase dispatched no launches?"
+    ratio = dev_sum / launch_wall
+    assert 0.9 <= ratio <= 1.1, (
+        f"per-client device-seconds {dev_sum:.4f}s vs measured launch "
+        f"wall {launch_wall:.4f}s — conservation off ({ratio:.3f})"
+    )
+    from datafusion_tpu.obs.httpd import start_debug_server
+
+    dbg = start_debug_server(-1)
+    assert dbg is not None, "ephemeral debug plane failed to bind"
+    try:
+        with urllib.request.urlopen(
+            f"{dbg.url}/debug/tenants", timeout=10
+        ) as resp:
+            doc = json.loads(resp.read())
+    finally:
+        dbg.close()
+    for cid in client_ids:
+        assert cid in doc["clients"], f"{cid} missing from /debug/tenants"
+        assert doc["clients"][cid]["device_seconds"] > 0
+    assert doc["conservation"]["launch_wall_s"] > 0
+    print(f"metering: {len(client_ids)} clients, per-client "
+          f"device-seconds sum {dev_sum:.4f}s vs launch wall "
+          f"{launch_wall:.4f}s ({ratio * 100:.1f}%), /debug/tenants "
+          f"serves all clients", flush=True)
+
+    # gate 8: induced queueing names queue_wait as the dominant tail
+    # segment, and the SLO breach artifact carries the tail explainer
+    import glob
+    import tempfile
+
+    from datafusion_tpu.obs import recorder
+    from datafusion_tpu.obs import slo as slo_mod
+
+    breach_dir = tempfile.mkdtemp(prefix="serve_smoke_breach_")
+    recorder.configure(directory=breach_dir, dump_interval_s=0)
+    attribution.EXPLAINER.clear()
+    prev_wd = slo_mod.WATCHDOG
+    wd = slo_mod.SloWatchdog(min_samples=4)
+    wd.add(slo_mod.Objective("serve_tail", "p99", 0.002))
+    slo_mod.WATCHDOG = wd
+    errors_q: list = []
+    # one executor, no megabatching, a launch floor: every query
+    # occupies the worker for >= the floor, so a closed-loop burst
+    # queues N-deep behind it — queue_wait IS the latency
+    qsrv = sctx.serve(workers=1, window_s=0.002, megabatch_max=1)
+    try:
+        faults.install(serve_load.launch_floor_plan(max(FLOOR_MS, 25.0)))
+        try:
+            serve_load.closed_loop(
+                qsrv, q, CLIENTS, 2, lambda i: 0.3 + 1e-4 * i,
+                {}, errors_q, client_prefix="qc",
+            )
+        finally:
+            faults.clear()
+    finally:
+        qsrv.stop()
+        slo_mod.WATCHDOG = prev_wd
+        recorder.configure(dump_interval_s=30.0)
+    assert not errors_q, f"queueing phase failures: {errors_q[:3]}"
+    rows = wd.evaluate()
+    assert rows and rows[0]["breached"], f"no SLO breach induced: {rows}"
+    tail = attribution.EXPLAINER.explain()
+    assert tail["top"] == "queue_wait", (
+        f"tail explainer top segment {tail['top']!r}, want queue_wait: "
+        f"{tail['segments'][:3]}"
+    )
+    artifacts = sorted(glob.glob(f"{breach_dir}/flight-*.json"))
+    assert artifacts, "breach produced no flight artifact"
+    with open(artifacts[-1]) as f:
+        breach_doc = json.load(f)
+    assert breach_doc["reason"] == "slo_breach"
+    assert breach_doc["tail"]["top"] == "queue_wait", (
+        breach_doc["tail"]["segments"][:3]
+    )
+    top_row = breach_doc["tail"]["segments"][0]
+    print(f"tail explainer: induced queueing breached "
+          f"{rows[0]['name']} (burn {rows[0]['burn_rate']:.1f}); "
+          f"artifact ranks queue_wait first "
+          f"(p99 {top_row['p99_s'] * 1e3:.1f} ms, "
+          f"{top_row['share_of_wall'] * 100:.0f}% of wall)", flush=True)
 
     print("SERVE SMOKE PASSED", flush=True)
     return 0
