@@ -47,6 +47,18 @@ class of bug that once cost a debugging session:
   transfer profiler misses the copy.  The one reviewed exception is
   the link-rate probe (``exec/batch.py``), which must measure the raw
   transport without the ledger's bookkeeping inside the timed region.
+- **DF008 blocking-disk-io-under-lock** — no blocking disk IO
+  (``open``, ``os.fsync``/``os.rename``/``os.replace``/…, or the WAL
+  entry points ``atomic_write_json``/``write_snapshot``/``_wal_*``)
+  lexically inside a held-lock ``with`` block in the control plane
+  (``cluster/``, ``serve.py``), and none at all inside the DF005
+  lock-free callback surfaces: a slow fsync under the cluster apply
+  lock extends the critical section to disk latency, stalling every
+  reader behind a write.  WAL appends copy state under the lock,
+  release it, then write.  The one reviewed exception is
+  ``utils/wal.py`` itself — the disk-IO boundary module, which holds
+  its own internal lock across writes by documented contract and
+  announces itself via ``lockcheck.note_blocking``.
 
 Suppression: append ``# df-lint: ok(DF00N)`` (or a blanket
 ``# df-lint: ok``) to the offending line, with a justification — the
@@ -457,6 +469,122 @@ class BlockingIoInSampler(_Rule):
         return out
 
 
+class BlockingDiskIoUnderLock(_Rule):
+    """DF008: blocking disk IO while a lock is (or may be) held."""
+
+    id = "DF008"
+
+    # disk-touching os.* calls that block on the filesystem
+    _OS_DISK = ("fsync", "fdatasync", "rename", "replace", "truncate",
+                "unlink", "remove", "makedirs", "rmdir", "listdir",
+                "scandir", "stat")
+    # repo-local disk-IO entry points: the WAL seams.  Calling one of
+    # these under a held lock is exactly the bug this rule exists for —
+    # a slow fsync would extend the cluster apply critical section to
+    # disk latency, stalling every reader behind a write
+    _WAL_ENTRY = ("atomic_write_json", "write_snapshot",
+                  "note_deadlines", "_wal_sync", "_wal_snapshot",
+                  "_wal_persist_best_effort", "_save_pin_manifest")
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace(os.sep, "/")
+        if p.endswith("utils/wal.py"):
+            # the reviewed disk-IO boundary: wal.py owns held-lock disk
+            # writes by design (its module doc states the contract, and
+            # it announces itself via lockcheck.note_blocking before
+            # every acquire).  Everything else routes through it.
+            return False
+        if "datafusion_tpu/cluster/" in p or p.startswith("cluster/"):
+            return True
+        if p.endswith("serve.py"):
+            return True
+        # DF005-covered lock-free callback surfaces: disk IO there is
+        # as bad as a lock — they run inside other subsystems' critical
+        # sections, so a blocking write inherits every caller's lock
+        return LockInMetricsCallback().applies(relpath)
+
+    def _disk_call(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name) and f.id == "open":
+            return "open"
+        ma = _call_mod_attr(call)
+        if ma is not None and ma[0] == "os" and ma[1] in self._OS_DISK:
+            return f"os.{ma[1]}"
+        name = _call_name(call)
+        if name in ("fsync", "fdatasync"):
+            return f"{name}"
+        if name in self._WAL_ENTRY:
+            return f"{name}"
+        return None
+
+    def _lockfree_fns(self, p: str) -> tuple[str, ...]:
+        df5 = LockInMetricsCallback
+        if p.endswith("obs/device.py"):
+            return df5._DEVICE_FNS
+        if p.endswith("obs/profiler.py"):
+            return df5._PROFILER_FNS
+        if p.endswith(("obs/recorder.py", "obs/aggregate.py",
+                       "obs/slo.py")):
+            return df5._RECORDER_FNS
+        if p.endswith("utils/hedge.py"):
+            return df5._HEDGE_FNS
+        if p.endswith("obs/attribution.py"):
+            return df5._ATTRIBUTION_FNS
+        if p.endswith("obs/stats.py"):
+            return df5._STATS_FNS
+        return ()
+
+    def check(self, tree, relpath):
+        p = relpath.replace(os.sep, "/")
+        out = []
+        lockfree = self._lockfree_fns(p)
+        if lockfree or p.endswith("utils/metrics.py"):
+            # lock-free callback surface: ALL disk IO is banned, not
+            # just disk IO under an explicit `with lock`
+            for fn in _functions_in(tree):
+                if p.endswith("utils/metrics.py") or fn.name in lockfree:
+                    for call in _calls_in(fn):
+                        name = self._disk_call(call)
+                        if name is not None:
+                            out.append(self._finding(
+                                relpath, call,
+                                f"{name}() in lock-free {fn.name}(): "
+                                "this callback runs inside other "
+                                "subsystems' critical sections — disk "
+                                "IO here inherits every caller's lock",
+                            ))
+            return out
+        # control-plane files: disk IO lexically inside a held-lock
+        # `with` block (DF005's ident heuristic: any context expr
+        # mentioning "lock").  WAL appends must copy state under the
+        # lock, release it, then write — never write while holding it
+        for sub in ast.walk(tree):
+            if not isinstance(sub, ast.With):
+                continue
+            held = None
+            for item in sub.items:
+                for leaf in ast.walk(item.context_expr):
+                    if isinstance(leaf, (ast.Name, ast.Attribute)):
+                        ident = leaf.id if isinstance(leaf, ast.Name) \
+                            else leaf.attr
+                        if "lock" in ident.lower():
+                            held = ident
+            if held is None:
+                continue
+            for stmt in sub.body:
+                for call in _calls_in(stmt):
+                    name = self._disk_call(call)
+                    if name is not None:
+                        out.append(self._finding(
+                            relpath, call,
+                            f"{name}() while holding `{held}`: copy "
+                            "state under the lock, release it, then "
+                            "touch disk — a slow fsync must never "
+                            "extend a critical section",
+                        ))
+        return out
+
+
 RULES: list[_Rule] = [
     HostSyncInDispatch(),
     NondeterminismInReplayable(),
@@ -465,6 +593,7 @@ RULES: list[_Rule] = [
     LockInMetricsCallback(),
     RawDevicePut(),
     BlockingIoInSampler(),
+    BlockingDiskIoUnderLock(),
 ]
 
 

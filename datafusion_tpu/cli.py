@@ -183,6 +183,23 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
             f.write(blob)
         return path
 
+    def _wal_summary(doc: dict) -> str:
+        # durability health at a glance: one clause per live WAL
+        # (segment count/bytes, last-fsync age, recovery stats)
+        parts = []
+        for m in doc.get("wal") or []:
+            age = m.get("last_fsync_age_s")
+            rec = m.get("recovery") or {}
+            clause = (f"{m.get('segments', 0)} segs "
+                      f"{m.get('bytes_written', 0)}B "
+                      f"fsync_age={age if age is None else f'{age:.1f}s'}")
+            if rec:
+                clause += (f" recovered@rev={rec.get('recovered_rev')} "
+                           f"({rec.get('replayed_events')} events, "
+                           f"{rec.get('torn_tails')} torn)")
+            parts.append(clause)
+        return f"; wal: {' | '.join(parts)}" if parts else ""
+
     def _tar_summary(blob: bytes) -> str:
         import io
         import tarfile
@@ -211,7 +228,8 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
             n_samples = (doc.get("profile") or {}).get("samples", 0)
             print(f"local: {path} "
                   f"({n_samples} profile samples, "
-                  f"{len(doc['flights']['events'])} flight events)",
+                  f"{len(doc['flights']['events'])} flight events"
+                  f"{_wal_summary(doc)})",
                   file=out)
     for member, url in targets:
         if url is None:
@@ -248,7 +266,7 @@ def run_debug_bundle(cluster: Optional[str], workers: Optional[str],
         print(f"{member}: {path} "
               f"({prof.get('samples', 0)} profile samples, "
               f"{len((doc.get('flights') or {}).get('events', []))} "
-              f"flight events)", file=out)
+              f"flight events{_wal_summary(doc)})", file=out)
     print(f"bundles written to {out_dir} "
           f"({max(len(targets), 1) - failures}/{max(len(targets), 1)} ok)",
           file=out)

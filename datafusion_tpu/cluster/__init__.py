@@ -41,6 +41,19 @@ old primary before it can split-brain the KV.  Clients take a
 comma-separated endpoint list and fail over automatically
 (redirect-on-``not_primary``, capped-backoff sweeps).
 
+**Durability** (`utils/wal.py` + `cluster/service.py`): with
+``DATAFUSION_TPU_WAL_DIR`` set, every replication event is appended to
+a segment-file write-ahead log (CRC'd `wire.py` frames, fsync policy
+``DATAFUSION_TPU_WAL_SYNC=always|interval|off``) *before* quorum-ack,
+with periodic compacted snapshots (tmp -> fsync -> rename; old
+segments reaped only once a covering snapshot is durable).  Boot-time
+recovery replays snapshot+log — terms, revisions, KV, grants, and
+lease *deadlines* (re-armed from persisted remaining TTL, never a
+fresh one) — so a recovered node rejoins as a caught-up standby and a
+correlated full-fleet `kill -9` loses zero acked writes
+(`scripts/crash_smoke.py` is the gate).  Unset = byte-identical
+in-memory behavior.
+
 Deployment shapes: in-process (`ClusterState` / `ClusterNode` +
 `LocalClusterClient` — tests, single-binary demos) or standalone TCP
 services (``python -m datafusion_tpu.cluster --bind host:port
@@ -68,12 +81,29 @@ sockets; existing single-coordinator paths are byte-identical):
                                       server (bounded compute pool; the
                                       selector parks any number of
                                       connections/watches threadless)
+    DATAFUSION_TPU_WAL_DIR            durable WAL+snapshot directory
+                                      (per node — never shared); unset
+                                      = memory-only, byte-identical
+    DATAFUSION_TPU_WAL_SYNC           fsync policy: always (default,
+                                      fsync before ack) | interval |
+                                      off
+    DATAFUSION_TPU_WAL_SYNC_INTERVAL_S  interval-policy fsync cadence
+                                      (default 0.05)
+    DATAFUSION_TPU_WAL_SEGMENT_BYTES  segment rotation size (4 MiB)
+    DATAFUSION_TPU_WAL_SNAPSHOT_BYTES log bytes that trigger a
+                                      compacting snapshot (8 MiB)
+    DATAFUSION_TPU_SERVE_PIN_MANIFEST worker pin-manifest path
+                                      (default <WAL_DIR>/
+                                      pin_manifest.json when WAL_DIR
+                                      is set)
 
 Fault sites (`testing/faults.py`): ``cluster.request`` (service
 partition), ``cluster.lease.refresh`` (lease expiry), ``cluster.watch``
 (stale membership view), ``cluster.replicate`` (log-shipping failure),
 ``cluster.election`` (promotion abort), ``cluster.snapshot`` (catch-up
-snapshot failure).
+snapshot failure), ``wal.write`` / ``wal.fsync`` / ``wal.rename`` /
+``snapshot.write`` (disk faults: short writes, torn records, ENOSPC,
+crash points — see `utils/wal.py`).
 """
 
 from __future__ import annotations

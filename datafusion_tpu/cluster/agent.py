@@ -24,6 +24,15 @@ never a live lease, and never masks an already-dead worker behind a
 fresh TTL.  The agent tracks the leadership ``term`` it last observed
 (`cluster.term` gauge): a bump is the visible trace of a failover.
 
+Durability: against a WAL-backed service (``DATAFUSION_TPU_WAL_DIR``),
+a full-fleet restart looks like a failover, not a reset — the recovered
+primary's revision counter and lease deadlines continue from the
+replayed log, so the agent's rev-regression and truncation guards stay
+quiet and an already-dead lease stays dead (it recovers with its
+REMAINING deadline, never a fresh TTL).  A worker that re-materialized
+its pin manifest before registering advertises ``pins_rehydrated`` in
+its membership record.
+
 Storm control: consecutive heartbeat failures back the loop off with
 capped full jitter (never past one TTL), and a re-registration from
 the background loop staggers a bounded random delay first — a mass
@@ -107,6 +116,14 @@ class WorkerClusterAgent:
         self.last_rev = granted.get("rev", 0)
         info = {"addr": self.addr, "pid": os.getpid(),
                 "batch_size": self.worker_state.batch_size}
+        # a rebooted worker that re-materialized HBM pins from its
+        # durable manifest (serve.py pin seam) advertises the warm
+        # rejoin in its membership record: registration happens AFTER
+        # rehydration, so "ready" in the membership view means the
+        # pins are already resident, never cold-path-pending
+        rehydrated = getattr(self.worker_state, "pins_rehydrated", 0)
+        if rehydrated:
+            info["pins_rehydrated"] = int(rehydrated)
         # advertise the debug HTTP plane (obs/httpd.py) in the lease:
         # `datafusion-tpu debug-bundle --cluster` resolves every live
         # member's bundle endpoint from the membership view alone

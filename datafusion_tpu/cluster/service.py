@@ -44,6 +44,16 @@ Semantics (the useful subset of etcd's):
   dependent results here and broadcasts the fragment-cache invalidation
   to workers.  Over TCP the columns travel as CRC'd binary RAW wire
   segments, not inline base64.
+- **Durability** (``DATAFUSION_TPU_WAL_DIR``; default off = the
+  in-memory behavior above, byte-identical): with a WAL directory
+  configured, `ClusterNode` appends every replication event to a
+  segment-file write-ahead log (`utils/wal.py`) *before* quorum-ack,
+  writes compacted `snapshot_state()` snapshots beside it, and replays
+  both at boot — terms, revisions, KV, grants, lease *deadlines*
+  (re-armed from persisted remaining TTL via `rearm_leases`, never a
+  fresh full TTL), and the result tier all survive a whole-fleet
+  ``kill -9``.  Elections and `replicate_pull` treat a recovered node
+  identically to a caught-up standby.
 """
 
 from __future__ import annotations
@@ -829,6 +839,35 @@ class ClusterState:
             self._shipped_deadlines = {}
             self._append_event("promoted", term=self.term)
 
+    def rearm_leases(self, deadlines, now: Optional[float] = None) -> None:
+        """Recovery-side lease re-arm — the restart sibling of
+        `promote()`'s failover re-arm.  WAL replay applies leases with
+        infinite local expiry (like any replica); this gives each one
+        its PERSISTED remaining deadline back, capped at the TTL and
+        never a fresh full TTL, so a lease that was already dead (or
+        dying) before the crash expires on the first sweep after it
+        instead of masking a dead worker for another whole TTL.  A
+        lease with no persisted deadline (granted after the last
+        deadline note made it to disk) falls back to the full-TTL arm —
+        the WAL's note cadence bounds that window."""
+        now = time.monotonic() if now is None else now
+        clean = {}
+        for k, v in (deadlines or {}).items():
+            try:
+                clean[str(k)] = max(0.0, float(v))
+            except (TypeError, ValueError):
+                continue
+        with self._lock:
+            for lease in self._leases.values():
+                remaining = clean.get(lease.lease_id)
+                if remaining is None:
+                    remaining = lease.ttl_s
+                lease.expires = now + min(remaining, lease.ttl_s)
+                for key in lease.keys:
+                    entry = self._kv.get(key)
+                    if entry is not None:
+                        entry.refreshed = now
+
     # -- introspection --
     def gauges(self) -> dict:
         with self._lock:
@@ -1062,7 +1101,7 @@ class ClusterNode:
                  election_timeout_s: Optional[float] = None,
                  replicate_interval_s: Optional[float] = None,
                  replicas=(), write_quorum: Optional[int] = None,
-                 rank: int = 0):
+                 rank: int = 0, wal_dir: Optional[str] = None):
         from datafusion_tpu import cluster as _cluster
 
         self.state = state or ClusterState()
@@ -1090,6 +1129,17 @@ class ClusterNode:
         self.step_downs = 0
         self.elections_deferred = 0
         self.snapshots_applied = 0
+        # durability (default OFF: no WAL dir means every hook below is
+        # a None test — byte-identical to the in-memory control plane)
+        self.wal = None
+        self.recovered_revisions = 0
+        if wal_dir is None:
+            wal_dir = os.environ.get("DATAFUSION_TPU_WAL_DIR") or None
+        if wal_dir:
+            from datafusion_tpu.utils.wal import WriteAheadLog
+
+            self.wal = WriteAheadLog(wal_dir)
+            self._recover_from_wal()
         self.primary_rev = self.state._rev  # last rev observed upstream
         self.last_primary_contact = time.monotonic()
         self._force_snapshot = False
@@ -1133,6 +1183,27 @@ class ClusterNode:
             }
         rev_before = self.state._rev
         out = apply_request(self.state, msg, bw)
+        if self.wal is not None and self.state._rev > rev_before:
+            # durability BEFORE acknowledgement (and before the quorum
+            # round): the events this request appended — lazy lease
+            # expiries included — must be on the log first.  A disk
+            # fault refuses the ack, exactly like a lost quorum: the
+            # write is applied locally but not acknowledged.
+            try:
+                self._wal_sync()
+            except OSError as e:
+                METRICS.add("cluster.wal_write_failures")
+                if kind in _MUTATING_REQUESTS and \
+                        out.get("type") != "error":
+                    return {
+                        "type": "error", "code": "wal_unavailable",
+                        "term": self.term,
+                        "message": (
+                            f"write applied locally but could not be "
+                            f"logged durably ({e}); not acknowledged — "
+                            f"retry when the log recovers"
+                        ),
+                    }
         if (self.write_quorum > 1 and kind in _MUTATING_REQUESTS
                 and out.get("type") != "error"
                 and self.state._rev > rev_before):
@@ -1188,6 +1259,112 @@ class ClusterNode:
         if role == "primary" and source is not None \
                 and self._primary_hint() != source:
             self.retarget(source)
+
+    # -- durability (WAL + snapshots, crash-only recovery) --
+    def _recover_from_wal(self) -> None:
+        """Crash-only boot: replay the newest valid snapshot plus the
+        WAL tail into the state machine, then re-arm leases from their
+        persisted remaining TTLs.  A recovered node is a caught-up
+        standby as far as elections and `replicate_pull` are concerned:
+        terms, revisions, KV, grants, and the result tier are all back,
+        and the election clock starts at boot."""
+        snap, events, deadlines = self.wal.recover()
+        state = self.state
+        if snap is not None:
+            snap = dict(snap)
+            snap["results"] = [
+                {**spec, "value": _decode_result_value(spec.get("value"))}
+                for spec in snap.get("results", [])
+            ]
+            state.apply_snapshot(snap)
+        grant_revs: dict = {}
+        for ev in events:
+            value = None
+            if ev.get("kind") == "result_put":
+                value = _decode_result_value(ev.pop("value", None))
+            elif ev.get("kind") == "lease_grant":
+                grant_revs[ev.get("lease")] = int(ev.get("rev") or 0)
+            state.apply_event(ev, value=value)
+        # a lease the deadline set COVERS (granted at rev <= the note's
+        # cutoff) but omits was already expired or revoked when the
+        # note was taken: re-arm it at ZERO so the first sweep kills
+        # it.  Only leases granted AFTER the cutoff (the note cadence's
+        # bounded window) fall back to a full TTL.
+        cutoff = self.wal.deadline_cutoff_rev
+        deadlines = dict(deadlines)
+        for lease_id in list(state._leases):
+            if lease_id in deadlines:
+                continue
+            if grant_revs.get(lease_id, 0) <= cutoff:
+                deadlines[lease_id] = 0.0
+        state.rearm_leases(deadlines)
+        self.recovered_revisions = state._rev
+        if self.recovered_revisions:
+            METRICS.add("cluster.recovered_revisions",
+                        self.recovered_revisions)
+            recorder.record("cluster.wal_recovered",
+                            rev=self.recovered_revisions,
+                            **self.wal.recovery)
+
+    def _wal_sync(self) -> None:
+        """Append every not-yet-logged event (plus a rate-limited
+        lease-deadline note) to the WAL, and compact into a snapshot
+        once the log crosses its threshold.  Runs OUTSIDE the cluster
+        locks — `events_since`/`snapshot_state` copy under the state
+        lock and release it before any disk IO (the DF008 contract).
+        Raises OSError on disk faults: ack-bearing callers must refuse
+        the ack (an unlogged write is an unacknowledged write)."""
+        from datafusion_tpu.parallel.wire import BinWriter
+
+        wal = self.wal
+        state = self.state
+        if state._rev > wal.last_rev:
+            if wal.last_rev < max(0, state._events_floor - 1):
+                # the un-logged prefix fell off the retained event
+                # window (WAL enabled on a warm node, or a log left
+                # behind a pulled snapshot-resync): only a full
+                # snapshot restores contiguous coverage
+                self._wal_snapshot()
+            else:
+                records = []
+                for ev in state.events_since(wal.last_rev,
+                                             kinds=None)["events"]:
+                    if ev.get("kind") == "result_put":
+                        value = state.results.peek(
+                            f"cache/result/{ev['key']}")
+                        if value is not None:
+                            bw = BinWriter()
+                            ev = {**ev,
+                                  "value": _encode_result_value(value, bw)}
+                            records.append((ev, bw))
+                            continue
+                    records.append((ev, None))
+                wal.append(records)
+        wal.note_deadlines(state.lease_deadlines)
+        if wal.should_snapshot():
+            self._wal_snapshot()
+
+    def _wal_snapshot(self) -> None:
+        from datafusion_tpu.parallel.wire import BinWriter
+
+        bw = BinWriter()
+        snap = self.state.snapshot_state()
+        for spec in snap["results"]:
+            spec["value"] = _encode_result_value(spec["value"], bw)
+        # recovery re-arms from these when no later deadline note exists
+        snap["lease_deadlines"] = self.state.lease_deadlines()
+        self.wal.write_snapshot(snap, bw)
+
+    def _wal_persist_best_effort(self) -> None:
+        """Non-ack-bearing sync sites (pull catch-up, the control loop,
+        shutdown): a disk fault here is counted, not fatal — the next
+        sync retries the same tail."""
+        if self.wal is None:
+            return
+        try:
+            self._wal_sync()
+        except OSError:
+            METRICS.add("cluster.wal_write_failures")
 
     # -- replication (primary push path / quorum commit) --
     def _replica_links(self) -> list:
@@ -1394,6 +1571,20 @@ class ClusterNode:
                     applied += 1
             if applied:
                 METRICS.add("cluster.replicated_events", applied)
+        if self.wal is not None:
+            # the ack below is this replica's durability vote in the
+            # primary's quorum count: events must hit OUR log first,
+            # and a disk fault withholds the ack
+            try:
+                self._wal_sync()
+            except OSError as e:
+                METRICS.add("cluster.wal_write_failures")
+                return {
+                    "type": "error", "code": "wal_unavailable",
+                    "term": self.term,
+                    "message": f"replica could not log the pushed tail "
+                               f"durably ({e}); push not acknowledged",
+                }
         state.note_lease_deadlines(msg.get("lease_deadlines"))
         self.last_primary_contact = now  # a push IS primary contact
         self.primary_rev = max(self.primary_rev, int(msg.get("rev", 0)))
@@ -1443,7 +1634,9 @@ class ClusterNode:
             raise
         now = time.monotonic() if now is None else now
         self.last_primary_contact = now
-        return self._apply_pull_response(resp)
+        out = self._apply_pull_response(resp)
+        self._wal_persist_best_effort()
+        return out
 
     def _apply_pull_response(self, resp: dict,
                              note_deadlines: bool = True) -> int:
@@ -1605,6 +1798,7 @@ class ClusterNode:
         self._upstream_client = None
         self.promotions += 1
         METRICS.add("cluster.promotions")
+        self._wal_persist_best_effort()  # the "promoted" event + term
         return True
 
     def retarget(self, upstream) -> None:
@@ -1728,6 +1922,10 @@ class ClusterNode:
                     self.maybe_promote()
                 elif self.peers and cycles % probe_every == 0:
                     self.peer_probe_once()
+                # periodic durability sweep: expiry-driven events that
+                # no request triggered, deadline notes on idle nodes,
+                # and compaction snapshots
+                self._wal_persist_best_effort()
             except Exception:  # noqa: BLE001 — the control loop must survive
                 METRICS.add("cluster.control_errors")
 
@@ -1761,6 +1959,16 @@ class ClusterNode:
             self._stop.set()
             self._thread.join(timeout=10)
             self._thread = None
+        if self.wal is not None:
+            # clean shutdown: persist whatever the last sweep missed
+            # and force the final fsync (crash-only recovery does not
+            # NEED this — it just makes a graceful restart lossless
+            # even under sync=interval)
+            self._wal_persist_best_effort()
+            try:
+                self.wal.flush()
+            except OSError:
+                METRICS.add("cluster.wal_write_failures")
 
     # -- introspection --
     @property
@@ -1770,7 +1978,7 @@ class ClusterNode:
         return max(0, self.primary_rev - self.state._rev)
 
     def gauges(self) -> dict:
-        return {
+        out = {
             "cluster.term": self.term,
             "cluster.role": 1 if self.role == "primary" else 0,
             "cluster.replication_lag_revisions": self.replication_lag_revisions,
@@ -1778,6 +1986,13 @@ class ClusterNode:
             "cluster.replica_set_size": self.cluster_size(),
             "cluster.succession_rank": self.rank,
         }
+        if self.wal is not None:
+            # keys appear only with durability on: WAL_DIR unset stays
+            # byte-identical to the in-memory control plane
+            out["cluster.recovered_revisions"] = self.recovered_revisions
+            out["wal.last_rev"] = self.wal.last_rev
+            out["wal.snapshot_rev"] = self.wal.snapshot_rev
+        return out
 
     def status(self) -> dict:
         out = self.state.status(extra=self.gauges())
@@ -1798,6 +2013,9 @@ class ClusterNode:
             # of how many watches/scrapes are parked on it
             "threads": threading.active_count(),
         })
+        if self.wal is not None:
+            out["wal"] = self.wal.manifest()
+            out["recovered_revisions"] = self.recovered_revisions
         return out
 
 
@@ -1891,7 +2109,8 @@ def serve(bind: str = "127.0.0.1:0",
           election_timeout_s: Optional[float] = None,
           advertise: Optional[str] = None,
           write_quorum: Optional[int] = None,
-          rank: int = 0) -> ClusterStateService:
+          rank: int = 0,
+          wal_dir: Optional[str] = None) -> ClusterStateService:
     """Run the service on `bind`; returns the server (embed it, or call
     `serve_forever` via ``python -m datafusion_tpu.cluster``).
     `standby_of` starts this instance as a replicating standby of an
@@ -1921,9 +2140,12 @@ def serve(bind: str = "127.0.0.1:0",
         node = ClusterNode(
             state=state, addr=addr, standby_of=standby_of, peers=peers,
             election_timeout_s=election_timeout_s,
-            write_quorum=write_quorum, rank=rank,
+            write_quorum=write_quorum, rank=rank, wal_dir=wal_dir,
         )
-        if standby_of or node.peers:
+        if standby_of or node.peers or node.wal is not None:
+            # a WAL'd solo primary still wants the control loop: it
+            # carries the periodic durability sweep (deadline notes,
+            # compaction) between requests
             node.start()
     node_cell.append(node)
     server = ClusterStateService(loop, lsock)
@@ -1966,12 +2188,19 @@ def main(argv=None) -> int:
     ap.add_argument("--rank", type=int, default=0,
                     help="succession rank for elections (0 = first in "
                          "line; higher ranks wait longer before claiming)")
+    ap.add_argument("--wal-dir", default=None,
+                    help="write-ahead-log directory for crash-only "
+                         "durability — events are logged before quorum-"
+                         "ack and replayed at boot (default: env "
+                         "DATAFUSION_TPU_WAL_DIR, unset = in-memory "
+                         "only; never share a directory between nodes)")
     args = ap.parse_args(argv)
     peers = [p.strip() for p in (args.peers or "").split(",") if p.strip()]
     server = serve(args.bind, standby_of=args.standby_of, peers=peers,
                    election_timeout_s=args.election_timeout_s,
                    advertise=args.advertise,
-                   write_quorum=args.write_quorum, rank=args.rank)
+                   write_quorum=args.write_quorum, rank=args.rank,
+                   wal_dir=args.wal_dir)
     host, port = server.server_address[:2]
     node: ClusterNode = server.cluster_node  # type: ignore[attr-defined]
     # NB: smoke harnesses parse this line for the address — keep the
@@ -1979,7 +2208,9 @@ def main(argv=None) -> int:
     print(f"cluster service listening on {host}:{port}", flush=True)
     print(f"cluster service role={node.role} term={node.term} "
           f"quorum={node.write_quorum} rank={node.rank}"
-          + (f" standby_of={args.standby_of}" if args.standby_of else ""),
+          + (f" standby_of={args.standby_of}" if args.standby_of else "")
+          + (f" wal_recovered_rev={node.recovered_revisions}"
+             if node.wal is not None else ""),
           flush=True)
     try:
         server.serve_forever()

@@ -134,6 +134,13 @@ def build_bundle(*, label: Optional[str] = None,
         ),
         "slo": slo.WATCHDOG.evaluate() if slo.WATCHDOG.armed() else [],
     }
+    try:
+        from datafusion_tpu.utils import wal as _wal
+        wal_manifests = _wal.active_manifests()
+    except Exception:  # noqa: BLE001 — durability info is best-effort in a bundle
+        wal_manifests = []
+    if wal_manifests:
+        doc["wal"] = wal_manifests
     if status_fn is not None:
         try:
             doc["status"] = status_fn()

@@ -170,6 +170,9 @@ class PinnedSource(DataSource):
         self.name = name
         self.fingerprint = f"table:{name}"
         self._resident = None  # list[RecordBatch] | None
+        # residency-change hook (Server wires the pin-manifest save
+        # here); invoked OUTSIDE self._lock, after ensure()/_drop()
+        self.on_change = None
         self._lock = lockcheck.make_lock("serve.pin_source")
         # per-core shared execution state (group-key encoders, aux
         # caches) so ids/aux computed by one query replay for every
@@ -235,6 +238,9 @@ class PinnedSource(DataSource):
         METRICS.add("serve.tables_pinned")
         recorder.record("serve.pin", table=self.name, bytes=nbytes,
                         batches=len(batches))
+        cb = self.on_change
+        if cb is not None:
+            cb()
         return True
 
     def _drop(self) -> None:
@@ -255,6 +261,9 @@ class PinnedSource(DataSource):
         forget_pin(self.fingerprint)
         METRICS.add("serve.tables_evicted")
         recorder.record("serve.evict", table=self.name)
+        cb = self.on_change
+        if cb is not None:
+            cb()
 
     @property
     def resident(self) -> bool:
@@ -357,7 +366,8 @@ class Server:
                  window_s: Optional[float] = None,
                  megabatch_max: Optional[int] = None,
                  pin: Optional[bool] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 pin_manifest: Optional[str] = None):
         from datafusion_tpu.analysis import lockcheck
         from datafusion_tpu.utils.eventloop import ServerLoop
 
@@ -382,6 +392,23 @@ class Server:
                 "DATAFUSION_TPU_SERVE_DEADLINE_S", 0.0
             ) or None
         self._default_deadline_s = default_deadline_s
+        # durable pin manifest (fingerprints + source paths of resident
+        # PinnedSources): written atomically on every residency change,
+        # re-materialized by `start()` BEFORE the dispatcher runs — a
+        # restarted server rejoins warm instead of sending every tenant
+        # back through the cold path.  Defaults beside the control
+        # plane's WAL when one is configured; unset = off (no new
+        # files, byte-identical serving behavior).
+        if pin_manifest is None:
+            pin_manifest = os.environ.get(
+                "DATAFUSION_TPU_SERVE_PIN_MANIFEST")
+            if not pin_manifest:
+                wal_dir = os.environ.get("DATAFUSION_TPU_WAL_DIR")
+                if wal_dir:
+                    pin_manifest = os.path.join(
+                        wal_dir, "pin_manifest.json")
+        self._pin_manifest_path = pin_manifest or None
+        self.pins_rehydrated = 0
         self._loop = ServerLoop(pool_size=self._workers,
                                 name="df-tpu-serve")
         self._thread: Optional[threading.Thread] = None
@@ -407,6 +434,10 @@ class Server:
     # -- lifecycle -----------------------------------------------------
     def start(self) -> "Server":
         if self._thread is None:
+            # pins re-materialize BEFORE the dispatcher thread exists:
+            # a restarted worker advertises ready only after its tables
+            # are warm again
+            self._rehydrate_pins()
             self._thread = threading.Thread(
                 target=self._loop.run, name="df-tpu-serve", daemon=True
             )
@@ -1064,6 +1095,7 @@ class Server:
             # cached results must survive the promotion
             self.ctx.datasources[table] = pinned
             ds = pinned
+        ds.on_change = self._save_pin_manifest
         newly_resident = not ds.resident
         if newly_resident:
             # pin only when the measured headroom (if known) still
@@ -1115,6 +1147,61 @@ class Server:
         )
         if measured:
             LEDGER.set_pin_bytes(pin.fingerprint, measured)
+
+    # -- pin manifest (durable data plane) -----------------------------
+    def _pin_entries(self) -> list:
+        out = []
+        for table, ds in sorted(self.ctx.datasources.items()):
+            if isinstance(ds, _PinnedProjection):
+                ds = ds.parent
+            if isinstance(ds, PinnedSource) and ds.resident:
+                entry = {"table": table, "fingerprint": ds.fingerprint}
+                path = getattr(ds.inner, "path", None)
+                if path:
+                    entry["path"] = str(path)
+                out.append(entry)
+        return out
+
+    def _save_pin_manifest(self) -> None:
+        """Persist the current resident set (atomic tmp -> fsync ->
+        rename, so a crash mid-write leaves the old manifest intact).
+        Called on every residency change, never under a lock."""
+        path = self._pin_manifest_path
+        if path is None:
+            return
+        from datafusion_tpu.utils.wal import atomic_write_json
+
+        try:
+            atomic_write_json(path, {"pins": self._pin_entries()})
+        except OSError:
+            METRICS.add("serve.pin_manifest_errors")
+
+    def _rehydrate_pins(self) -> None:
+        """Boot-time pin re-materialization from the manifest: every
+        recorded table that is registered in this context gets its
+        `_ensure_resident` walk (promotion + materialize + ledger pin)
+        before the server starts serving.  Tables the context no longer
+        registers — or whose materialization fails — are skipped, not
+        fatal: rejoining cold is degraded, not broken."""
+        path = self._pin_manifest_path
+        if path is None or not self._pin_enabled:
+            return
+        from datafusion_tpu.utils.wal import read_json
+
+        doc = read_json(path)
+        for entry in (doc or {}).get("pins") or []:
+            table = str(entry.get("table") or "")
+            if not table or table not in self.ctx.datasources:
+                METRICS.add("serve.pin_rehydrate_skipped")
+                continue
+            try:
+                self._ensure_resident(table, client_id="rehydrate")
+            except Exception:  # noqa: BLE001 — a cold table must not block boot
+                METRICS.add("serve.pin_rehydrate_errors")
+                continue
+            self.pins_rehydrated += 1
+            METRICS.add("serve.pins_rehydrated")
+            recorder.record("serve.pin_rehydrated", table=table)
 
     # -- introspection -------------------------------------------------
     def stats(self) -> dict:

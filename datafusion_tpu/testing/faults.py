@@ -11,8 +11,14 @@ service partition, ``cluster.lease.refresh`` = lease expiry /
 heartbeat loss, ``cluster.watch`` = stale membership view,
 ``cluster.replicate`` = log-shipping failure, ``cluster.election`` =
 aborted standby promotion, ``cluster.snapshot`` = catch-up snapshot
-failure) — and a process-global, seedable *fault plan* decides which
-sites fire and how.
+failure, and the durability layer's disk path (``wal.write`` = record
+append, ``wal.fsync`` = flush to stable storage, ``wal.rename`` =
+snapshot/manifest rename-into-place, ``snapshot.write`` = compacted
+snapshot serialization) — and a process-global, seedable *fault plan*
+decides which sites fire and how.  The disk sites compose with the
+ops the same way the wire sites do: ``raise`` with ``OSError`` models
+ENOSPC, ``corrupt`` a torn record, ``short`` a short write, ``kill`` a
+crash point mid-IO.
 
 Zero overhead when off: with no plan installed, `check()` is one module
 attribute read and a `None` test.  Nothing else in the engine changes.
@@ -31,7 +37,9 @@ worker *subprocesses* honor it too):
 
 Rule fields:
 - ``site``: fnmatch pattern over site names (``"wire.*"`` works).
-- ``op``: ``raise`` | ``delay`` | ``corrupt`` | ``kill``.
+- ``op``: ``raise`` | ``delay`` | ``corrupt`` | ``short`` | ``kill``
+  (``short`` truncates the payload at a ``corrupt``-style hook — a
+  short write at the WAL sites, dropped tail bytes on the wire).
 - ``exc`` / ``message``: exception to raise (resolved from builtins,
   then `datafusion_tpu.errors`).  Default ``ExecutionError``.
 - ``seconds``: sleep length for ``delay`` — a number, or a
@@ -114,7 +122,7 @@ class _Rule:
     def __init__(self, spec: dict, seed: int, index: int):
         self.site = spec["site"]
         self.op = spec.get("op", "raise")
-        if self.op not in ("raise", "delay", "corrupt", "kill"):
+        if self.op not in ("raise", "delay", "corrupt", "short", "kill"):
             raise ValueError(f"unknown fault op {self.op!r}")
         self.exc = spec.get("exc", "ExecutionError")
         _resolve_exc(self.exc)  # fail at install, not at fire
@@ -304,6 +312,16 @@ def corrupt(site: str, data, **ctx: Any):
     if due is None:
         return data
     rule, ordinal = due
+    if rule.op == "short":
+        # short write: keep only a prefix (rule "offset" pins the cut;
+        # default draws a proper prefix from the rule's seeded stream)
+        buf = bytearray(data)
+        if not buf:
+            return data
+        keep = rule.offset
+        if keep is None:
+            keep = rule.rng.randrange(len(buf))
+        return bytes(buf[: min(int(keep), len(buf))])
     if rule.op != "corrupt":
         _fire(rule, site, ordinal)
         return data
@@ -332,8 +350,9 @@ def _fire(rule: _Rule, site: str, ordinal: int) -> None:
         # simulate SIGKILL mid-work: no cleanup, no flushing, the
         # socket peer sees a mid-frame EOF / connection reset
         os._exit(_KILL_EXIT_CODE)
-    if rule.op == "corrupt":
-        # a corrupt rule on a non-payload site degrades to an error
+    if rule.op in ("corrupt", "short"):
+        # a payload-transform rule on a non-payload site degrades to
+        # an error
         raise _resolve_exc("ExecutionError")(rule.message)
     raise _resolve_exc(rule.exc)(rule.message)
 

@@ -1,0 +1,470 @@
+#!/usr/bin/env python
+"""Crash-recovery smoketest: the durability headline (utils/wal.py +
+cluster WAL hooks), proven the crash-only way — `kill -9` the ENTIRE
+fleet mid-workload and boot it back from disk.
+
+1. spawn 3 WAL-backed cluster replicas (primary + 2 standbys, write
+   quorum 2, one WAL directory each) + 2 cluster-registered workers;
+2. run a workload of quorum-acked KV puts and result-tier publishes
+   while distributed queries execute;
+3. SIGKILL all five processes at once — no shutdown hooks, no flush;
+4. restart the replicas on the same ports/WAL dirs and 2 fresh
+   workers: every acked KV write and result-tier entry must be
+   present, the revision counter must continue (never reset), leases
+   that died with the old fleet must STAY dead (re-armed from the
+   persisted remaining TTL, not a fresh one), and zero queries fail
+   after recovery;
+5. pin rehydration: a serve.Server whose pinned table is recorded in
+   the durable pin manifest must come back RESIDENT before serving
+   (warm rejoin, no cold path);
+6. disk-fault soak: 30% seeded `wal.*` faults (ENOSPC-style) — writes
+   the service acked must all survive a crash+recovery, errored ones
+   simply aren't acked; a torn-record chaos leg (short/corrupt rules)
+   must recover a consistent prefix without crashing recovery.
+
+Exit non-zero on any lost write.  `scripts/smoketest.sh` runs this
+after the cluster smoke; CI wires it as the `crash-smoke` job.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DATAFUSION_TPU_RETRY_BASE_S", "0.01")
+
+
+def _write_csv(tmpdir: str, rows: int = 3000) -> str:
+    import numpy as np
+
+    rng = np.random.default_rng(29)
+    regions = ["north", "south", "east", "west"]
+    path = os.path.join(tmpdir, "t.csv")
+    with open(path, "w") as f:
+        f.write("region,v,x\n")
+        for _ in range(rows):
+            f.write(
+                f"{regions[rng.integers(0, 4)]},"
+                f"{rng.integers(-1000, 1000)},"
+                f"{rng.uniform(-5, 5):.6f}\n"
+            )
+    return path
+
+
+def _free_ports(n: int) -> list:
+    import socket
+
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _start(env, module, extra_args=()):
+    """Spawn a module that prints 'listening on host:port'; returns
+    (proc, addr) with bounded-startup diagnostics."""
+    stderr_path = tempfile.mktemp(prefix="dftpu_crash_err_")
+    stderr_f = open(stderr_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", module, *extra_args],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=stderr_f, text=True,
+    )
+    box: dict = {}
+    t = threading.Thread(
+        target=lambda: box.update(line=proc.stdout.readline()))
+    t.start()
+    t.join(timeout=120)
+    line = box.get("line", "")
+    if t.is_alive() or "listening on" not in line:
+        proc.kill()
+        stderr_f.close()
+        tail = open(stderr_path).read()[-2000:]
+        raise AssertionError(
+            f"{module} failed to start (line={line!r}); stderr:\n{tail}"
+        )
+    addr = line.strip().rsplit(" ", 1)[1]
+    return proc, addr
+
+
+def fleet_crash_smoke(schema, sql, csv_path, tmpdir) -> None:
+    from datafusion_tpu.cluster import connect
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+
+    ports = _free_ports(3)
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    endpoints = ",".join(addrs)
+    wal_dirs = [os.path.join(tmpdir, f"wal-r{i}") for i in range(3)]
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("DATAFUSION_TPU_WAL_DIR", None)  # --wal-dir is explicit
+    env["DATAFUSION_TPU_CLUSTER_TTL_S"] = "2"
+
+    def start_replicas():
+        common = ("--peers", endpoints, "--write-quorum", "2",
+                  "--election-timeout-s", "3")
+        procs = []
+        p, _ = _start(env, "datafusion_tpu.cluster",
+                      ("--bind", addrs[0], "--wal-dir", wal_dirs[0])
+                      + common)
+        procs.append(p)
+        for i in (1, 2):
+            p, _ = _start(env, "datafusion_tpu.cluster",
+                          ("--bind", addrs[i], "--standby-of", addrs[0],
+                           "--rank", str(i - 1), "--wal-dir", wal_dirs[i])
+                          + common)
+            procs.append(p)
+        return procs
+
+    def start_workers(n=2):
+        wenv = dict(env)
+        wenv["DATAFUSION_TPU_CLUSTER"] = endpoints
+        out = []
+        for _ in range(n):
+            proc, addr = _start(wenv, "datafusion_tpu.worker",
+                                ("--bind", "127.0.0.1:0",
+                                 "--device", "cpu"))
+            out.append((proc, addr))
+        return out
+
+    def wait_workers(client, want_addrs, timeout=120):
+        deadline = time.monotonic() + timeout
+        while True:
+            have = set(client.membership()["workers"])
+            if want_addrs <= have:
+                return have
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"workers never registered: want {want_addrs}, "
+                    f"have {have}")
+            time.sleep(0.3)
+
+    procs = start_replicas()
+    workers = start_workers()
+    procs += [p for p, _ in workers]
+    old_worker_addrs = {a for _, a in workers}
+    print(f"fleet up: replicas {addrs} + workers "
+          f"{sorted(old_worker_addrs)}", flush=True)
+
+    client = connect(endpoints)
+    wait_workers(client, old_worker_addrs)
+
+    def make_ctx(**kw):
+        ctx = DistributedContext(cluster=endpoints, **kw)
+        ctx.register_datasource(
+            "t", CsvDataSource(csv_path, schema, True, 131072))
+        return ctx
+
+    lctx = ExecutionContext(device="cpu")
+    lctx.register_datasource(
+        "t", CsvDataSource(csv_path, schema, True, 131072))
+    want = sorted(collect(lctx.sql(sql)).to_rows())
+
+    dctx = make_ctx()
+    got = sorted(collect(dctx.sql(sql)).to_rows())
+    assert got == want, f"pre-crash result diverges:\n{got}\nvs\n{want}"
+    print("pre-crash distributed query matches local engine", flush=True)
+
+    # -- workload: quorum-acked KV puts + result-tier publishes.  Only
+    # writes the service ACKED go in the ledger; in-flight ones that
+    # die with the fleet owe nothing --
+    acked_kv: dict = {}
+    acked_results: dict = {}
+    stop = threading.Event()
+
+    def workload():
+        i = 0
+        while not stop.is_set():
+            key = f"crash/kv/{i}"
+            value = {"i": i, "payload": "x" * 64}
+            try:
+                client.put(key, value)
+                acked_kv[key] = value
+            except Exception:  # noqa: BLE001 — unacked mid-kill write
+                pass
+            if i % 5 == 0:
+                rkey = f"crash-res-{i}"
+                rvalue = {"rows": [[i, i * 2]], "n": i}
+                try:
+                    client.result_put(rkey, rvalue, nbytes=128)
+                    acked_results[rkey] = rvalue
+                except Exception:  # noqa: BLE001 — unacked mid-kill write
+                    pass
+            i += 1
+            time.sleep(0.01)
+
+    t = threading.Thread(target=workload)
+    t.start()
+    time.sleep(2.0)
+
+    # -- the correlated crash: kill -9 EVERYTHING at once --
+    for p in procs:
+        p.send_signal(signal.SIGKILL)
+    for p in procs:
+        p.wait(timeout=10)
+    kill_time = time.monotonic()
+    print(f"kill -9: entire fleet (3 replicas + 2 workers) with "
+          f"{len(acked_kv)} acked KV writes, "
+          f"{len(acked_results)} acked results in flight", flush=True)
+    time.sleep(0.5)
+    stop.set()
+    t.join(timeout=30)
+    assert len(acked_kv) >= 20, (
+        f"workload too thin to prove anything: {len(acked_kv)} acked")
+
+    # -- restart from disk: same ports, same WAL dirs --
+    procs = start_replicas()
+    workers = start_workers()
+    procs += [p for p, _ in workers]
+    new_worker_addrs = {a for _, a in workers}
+    try:
+        client = connect(endpoints)
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                st = client.status()
+                if st["role"] == "primary":
+                    break
+            except Exception:  # noqa: BLE001 — booting
+                pass
+            if time.monotonic() > deadline:
+                raise AssertionError("recovered primary never served")
+            time.sleep(0.3)
+        assert st.get("recovered_revisions", 0) > 0, st
+        rec = (st.get("wal") or {}).get("recovery") or {}
+        print(f"recovered: rev {st['rev']} "
+              f"(snapshot_rev={rec.get('snapshot_rev')}, "
+              f"{rec.get('replayed_events')} events replayed, "
+              f"{rec.get('torn_tails')} torn tails, "
+              f"{rec.get('recovery_ms')}ms)", flush=True)
+
+        # 1. every acked KV write is present with its exact value
+        lost = [k for k, v in acked_kv.items() if client.get(k) != v]
+        assert not lost, (
+            f"{len(lost)}/{len(acked_kv)} acked KV writes lost: "
+            f"{sorted(lost)[:5]}")
+        print(f"KV: {len(acked_kv)}/{len(acked_kv)} acked writes "
+              "recovered", flush=True)
+
+        # 2. every acked result-tier entry is present
+        for rkey, rvalue in acked_results.items():
+            out = client.result_get(rkey)
+            assert out.get("found"), f"result {rkey} lost"
+            assert out.get("value") == rvalue, (rkey, out)
+        print(f"result tier: {len(acked_results)}/{len(acked_results)} "
+              "acked entries recovered", flush=True)
+
+        # 3. leases that died with the fleet STAY dead: the old worker
+        # leases recovered with their REMAINING TTL (<= 2s, mostly
+        # consumed before the restart finished) — they must expire,
+        # never be re-armed fresh
+        wait_workers(client, new_worker_addrs)
+        deadline = time.monotonic() + 30
+        while True:
+            have = set(client.membership()["workers"])
+            stale = have & (old_worker_addrs - new_worker_addrs)
+            if not stale:
+                break
+            if time.monotonic() > deadline:
+                raise AssertionError(
+                    f"dead workers' leases survived recovery re-armed: "
+                    f"{stale} (killed {time.monotonic() - kill_time:.0f}s "
+                    "ago, TTL 2s)")
+            time.sleep(0.5)
+        print("leases: dead workers expired from their persisted "
+              "remaining TTL; new workers registered", flush=True)
+
+        # 4. zero failed queries post-recovery
+        dctx = make_ctx(result_cache=False)
+        for _ in range(5):
+            got = sorted(collect(dctx.sql(sql)).to_rows())
+            assert got == want, (
+                f"post-recovery result diverges:\n{got}\nvs\n{want}")
+        dctx.close()
+        print("queries: 5/5 post-recovery distributed queries OK",
+              flush=True)
+        print("FLEET CRASH RECOVERY OK", flush=True)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def pin_rehydration_smoke(schema, csv_path, tmpdir) -> None:
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.serve import Server
+
+    manifest = os.path.join(tmpdir, "pin_manifest.json")
+    sql = "SELECT region, COUNT(1), SUM(v) FROM t GROUP BY region"
+
+    def make_server():
+        ctx = ExecutionContext(device="cpu")
+        ctx.register_datasource(
+            "t", CsvDataSource(csv_path, schema, True, 131072))
+        return Server(ctx, pin=True, pin_manifest=manifest)
+
+    srv = make_server().start()
+    want = srv.submit(sql).result(timeout=300).to_rows()
+    assert srv.ctx.datasources["t"].resident, "query never pinned t"
+    assert os.path.exists(manifest), "pin manifest never written"
+    srv.stop()  # the manifest was durable BEFORE the stop
+
+    srv2 = make_server().start()
+    try:
+        ds = srv2.ctx.datasources["t"]
+        assert getattr(ds, "resident", False), (
+            "pin not re-materialized before serving")
+        assert srv2.pins_rehydrated == 1, srv2.pins_rehydrated
+        got = srv2.submit(sql).result(timeout=300).to_rows()
+        assert sorted(got) == sorted(want)
+    finally:
+        srv2.stop()
+    print("PIN REHYDRATION OK: restarted server resident before its "
+          "first query", flush=True)
+
+
+def disk_fault_soak(tmpdir) -> None:
+    from datafusion_tpu.cluster.service import ClusterNode
+    from datafusion_tpu.testing import faults
+
+    wal_dir = os.path.join(tmpdir, "wal-soak")
+    acked: dict = {}
+    refused = 0
+    fired_total = 0
+    zombies = []  # crashed nodes held un-GC'd: a real kill -9 never
+    #               flushes their buffered tails either
+    for rnd in range(3):
+        node = ClusterNode(wal_dir=wal_dir)
+        missing = {k for k, v in acked.items() if node.state.get(k) != v}
+        assert not missing, (
+            f"round {rnd}: {len(missing)} acked writes lost: "
+            f"{sorted(missing)[:5]}")
+        # 30% per-record fault rate, capped per rule: un-acked events
+        # retry in the NEXT put's append, so an uncapped 30% per-record
+        # draw compounds over the growing backlog until nothing acks —
+        # the cap models the transient ENOSPC clearing, after which the
+        # backlog drains and acks resume
+        plan = {
+            "seed": 4242 + rnd,
+            "rules": [
+                {"site": "wal.write", "op": "raise", "exc": "OSError",
+                 "p": 0.3, "count": 30},
+                {"site": "wal.fsync", "op": "raise", "exc": "OSError",
+                 "p": 0.3, "count": 15},
+                {"site": "wal.rename", "op": "raise", "exc": "OSError",
+                 "p": 0.3, "count": 15},
+                {"site": "snapshot.write", "op": "raise", "exc": "OSError",
+                 "p": 0.3, "count": 15},
+            ],
+        }
+        with faults.scoped(plan) as p:
+            for i in range(200):
+                key = f"soak/{rnd}/{i}"
+                value = {"rnd": rnd, "i": i}
+                out = node.handle_request(
+                    {"type": "kv_put", "key": key, "value": value})
+                if out.get("type") == "ok":
+                    acked[key] = value
+                else:
+                    assert out.get("code") == "wal_unavailable", out
+                    refused += 1
+            fired_total += sum(r["fired"] for r in p.snapshot())
+        zombies.append(node)  # crash: no stop(), no flush()
+    assert fired_total >= 60, f"soak injected too little: {fired_total}"
+    assert refused > 0, "no write was ever refused at 30% fault rate"
+    assert len(acked) >= 100, f"too few acked writes to prove: {len(acked)}"
+    node = ClusterNode(wal_dir=wal_dir)
+    missing = {k for k, v in acked.items() if node.state.get(k) != v}
+    assert not missing, f"final recovery lost {len(missing)} acked writes"
+    print(f"DISK-FAULT SOAK OK: {len(acked)} acked writes all "
+          f"recovered across 3 crash rounds ({refused} refused under "
+          f"{fired_total} injected wal.* faults)", flush=True)
+
+    # torn-record chaos: short/corrupt rules damage records ON DISK
+    # (silent-corruption model).  Recovery must truncate and carry on —
+    # a consistent prefix, never an exception, never a garbage value
+    torn_dir = os.path.join(tmpdir, "wal-torn")
+    node = ClusterNode(wal_dir=torn_dir)
+    written = {}
+    with faults.scoped({
+        "seed": 99,
+        "rules": [
+            {"site": "wal.write", "op": "short", "p": 0.2, "count": 0},
+            {"site": "wal.write", "op": "corrupt", "p": 0.1, "count": 0},
+        ],
+    }):
+        for i in range(100):
+            key = f"torn/{i}"
+            value = {"i": i}
+            out = node.handle_request(
+                {"type": "kv_put", "key": key, "value": value})
+            if out.get("type") == "ok":
+                written[key] = value
+    zombies.append(node)
+    node = ClusterNode(wal_dir=torn_dir)  # must not raise
+    recovered = [k for k in written if node.state.get(k) is not None]
+    for k in recovered:
+        assert node.state.get(k) == written[k], k
+    assert node.wal.recovery["torn_tails"] >= 1, node.wal.recovery
+    out = node.handle_request(
+        {"type": "kv_put", "key": "torn/after", "value": {"ok": True}})
+    assert out.get("type") == "ok", out
+    print(f"TORN-RECORD CHAOS OK: recovery truncated damaged records "
+          f"({len(recovered)}/{len(written)} survived, "
+          f"{node.wal.recovery['torn_tails']} torn tails), node "
+          "writable after", flush=True)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+
+    schema = Schema([
+        Field("region", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+        Field("x", DataType.FLOAT64, True),
+    ])
+    sql = ("SELECT region, COUNT(1), SUM(v), MIN(x), MAX(x) "
+           "FROM t WHERE v > -900 GROUP BY region")
+
+    tmpdir = tempfile.mkdtemp(prefix="dftpu_crash_")
+    csv_path = _write_csv(tmpdir)
+    pin_rehydration_smoke(schema, csv_path, tmpdir)
+    disk_fault_soak(tmpdir)
+    fleet_crash_smoke(schema, sql, csv_path, tmpdir)
+    print("CRASH SMOKETEST PASSED", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    from datafusion_tpu.obs.httpd import run_with_ci_bundle
+
+    sys.exit(run_with_ci_bundle(main, "crash_smoke_failure"))

@@ -51,6 +51,9 @@ python scripts/cluster_smoke.py
 echo "== scale smoke (3-replica quorum election under SIGKILL, lease-deadline shipping, parked-watch fan-out on the event loop) =="
 python scripts/scale_smoke.py
 
+echo "== crash smoke (WAL durability: full-fleet kill -9 recovery, pin rehydration, 30% seeded wal.* disk-fault soak) =="
+python scripts/crash_smoke.py
+
 echo "== serve smoke (closed-loop concurrent clients: admission control, pinned-table H2D skip, megabatched launches, 3x throughput gate) =="
 python scripts/serve_smoke.py
 

@@ -466,6 +466,37 @@ class TestLintRules:
         # same code outside the metrics/stats scope is fine
         assert _lint(src, "datafusion_tpu/cache/store.py") == []
 
+    def test_df008_disk_io_under_lock_in_control_plane(self):
+        src = (
+            "import os\n"
+            "class Node:\n"
+            "    def bad(self):\n"
+            "        with self._lock:\n"
+            "            os.fsync(3)\n"
+            "            open('/tmp/x', 'wb')\n"
+            "            self._wal_sync()\n"
+            "    def good(self):\n"
+            "        with self._lock:\n"
+            "            tail = list(self._events)\n"
+            "        self._wal_sync()\n"
+        )
+        found = _lint(src, "datafusion_tpu/cluster/service.py")
+        assert [(f.rule, f.line) for f in found] == [
+            ("DF008", 5), ("DF008", 6), ("DF008", 7)]
+        # the WAL module is the reviewed disk-IO boundary: exempt
+        assert _lint(src, "datafusion_tpu/utils/wal.py") == []
+        # outside the durability surfaces the rule does not apply
+        assert _lint(src, "datafusion_tpu/cache/store.py") == []
+
+    def test_df008_disk_io_in_lockfree_metrics(self):
+        src = (
+            "class Metrics:\n"
+            "    def add(self, name):\n"
+            "        open('/tmp/x', 'wb')\n"
+        )
+        found = _lint(src, "datafusion_tpu/utils/metrics.py")
+        assert [f.rule for f in found] == ["DF008"]
+
     def test_suppression_marker(self):
         src = ("import jax\ndef f(x):\n"
                "    return jax.block_until_ready(x)  "

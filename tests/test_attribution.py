@@ -418,7 +418,9 @@ class TestSurfacing:
         code, _, body = _route_request(srv, "/debug/tail", {})
         doc = json.loads(body)
         assert code == 200 and doc["top"] == "queue_wait"
-        # window filter forwards
+        # window filter forwards: age the entry past the window first
+        # (a warm route round-trip can finish inside 0.1 ms)
+        time.sleep(0.001)
         code, _, body = _route_request(
             srv, "/debug/tail", {"window_s": "0.0001"}
         )

@@ -1,0 +1,500 @@
+"""Durability layer: WAL + snapshots + crash-only recovery.
+
+Covers the segment-file log itself (`utils/wal.py`: append/recover
+roundtrip with non-contiguous revisions, rev dedup, torn-tail
+truncation in place, CRC damage detection, mid-log tears dropping the
+segments written over the hole, snapshot compaction + segment reaping,
+tmp-leftover cleanup, invalid-snapshot fallback, the atomic JSON
+manifest helpers), node-level crash recovery (`ClusterNode(wal_dir=)`:
+full state equality across a kill, revision continuity, durability
+before ack under seeded disk faults, and the WAL-off A/B — no WAL dir
+means byte-identical behaviour and zero WAL surface), lease re-arm
+semantics (persisted remaining TTL, never a fresh grant; a lease that
+expired before the crash stays dead via the deadline note's coverage
+cutoff), and the snapshot-resync truncation edge (a partially
+caught-up standby that falls off the event window resyncs by full
+snapshot exactly once — no duplicated or skipped events — including
+under a seeded `cluster.snapshot` fault).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.cache.result import CachedResult
+from datafusion_tpu.cluster import ClusterNode, ClusterState, LocalClusterClient
+from datafusion_tpu.errors import ExecutionError
+from datafusion_tpu.testing import faults
+from datafusion_tpu.utils.wal import (
+    WriteAheadLog,
+    atomic_write_json,
+    read_json,
+)
+
+
+def _ev(rev, key="k", value=1):
+    return {"kind": "put", "rev": rev, "key": key, "value": value}
+
+
+def _append(log, *revs):
+    log.append([(_ev(r, key=f"k{r}", value=r), None) for r in revs])
+
+
+def _snapshot(num_rows=3):
+    return CachedResult(
+        [np.arange(num_rows, dtype=np.int64),
+         np.asarray([0, 1, 0][:num_rows], np.int32)],
+        [None, np.asarray([True, False, True][:num_rows])],
+        [None, ("x", "y")],
+        num_rows,
+        64,
+    )
+
+
+# -- the log itself -------------------------------------------------------
+
+
+class TestWalUnit:
+    def test_append_recover_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        log = WriteAheadLog(d)
+        log.recover()
+        # revisions are strictly increasing but NOT contiguous (entry
+        # revs interleave event revs)
+        _append(log, 1, 3, 7)
+        log.close()
+        log2 = WriteAheadLog(d)
+        snap, events, _ = log2.recover()
+        assert snap is None
+        assert [e["rev"] for e in events] == [1, 3, 7]
+        assert [e["key"] for e in events] == ["k1", "k3", "k7"]
+        assert log2.last_rev == 7
+        assert log2.recovery["replayed_events"] == 3
+        assert log2.recovery["torn_tails"] == 0
+
+    def test_reoffered_tail_dedups(self, tmp_path):
+        log = WriteAheadLog(str(tmp_path))
+        log.recover()
+        _append(log, 1, 2)
+        # concurrent syncers re-offer overlapping tails
+        _append(log, 1, 2, 3)
+        log.close()
+        log2 = WriteAheadLog(str(tmp_path))
+        _, events, _ = log2.recover()
+        assert [e["rev"] for e in events] == [1, 2, 3]
+
+    def test_torn_tail_truncated_in_place(self, tmp_path):
+        d = str(tmp_path)
+        log = WriteAheadLog(d)
+        log.recover()
+        _append(log, 1, 2)
+        log.close()
+        seg = os.path.join(d, "wal-00000001.seg")
+        good = os.path.getsize(seg)
+        with open(seg, "ab") as f:
+            f.write(b"\x00" * 7)  # a crash mid-header
+        log2 = WriteAheadLog(d)
+        _, events, _ = log2.recover()
+        assert [e["rev"] for e in events] == [1, 2]
+        assert log2.recovery["torn_tails"] == 1
+        assert os.path.getsize(seg) == good  # truncated back in place
+        _append(log2, 3)  # appendable right after
+        log2.close()
+        log3 = WriteAheadLog(d)
+        _, events, _ = log3.recover()
+        assert [e["rev"] for e in events] == [1, 2, 3]
+        assert log3.recovery["torn_tails"] == 0
+
+    def test_crc_damage_drops_the_record(self, tmp_path):
+        d = str(tmp_path)
+        log = WriteAheadLog(d)
+        log.recover()
+        _append(log, 1, 2)
+        log.close()
+        seg = os.path.join(d, "wal-00000001.seg")
+        with open(seg, "r+b") as f:
+            f.seek(-1, os.SEEK_END)  # flip a byte inside rev 2's payload
+            last = f.read(1)
+            f.seek(-1, os.SEEK_END)
+            f.write(bytes([last[0] ^ 0xFF]))
+        log2 = WriteAheadLog(d)
+        _, events, _ = log2.recover()
+        assert [e["rev"] for e in events] == [1]
+        assert log2.recovery["torn_tails"] == 1
+        assert log2.last_rev == 1
+
+    def test_mid_log_tear_drops_later_segments(self, tmp_path):
+        d = str(tmp_path)
+        # segment_bytes=1: every record rotates into its own segment
+        log = WriteAheadLog(d, segment_bytes=1)
+        log.recover()
+        _append(log, 1)
+        _append(log, 2)
+        _append(log, 3)
+        log.close()
+        assert os.path.exists(os.path.join(d, "wal-00000003.seg"))
+        # tear the MIDDLE of the log: segment 2 loses its tail
+        seg2 = os.path.join(d, "wal-00000002.seg")
+        with open(seg2, "r+b") as f:
+            f.truncate(os.path.getsize(seg2) // 2)
+        log2 = WriteAheadLog(d, segment_bytes=1)
+        _, events, _ = log2.recover()
+        # segment 3 was written on top of lost history: replaying it
+        # would silently skip rev 2, so it is dropped instead
+        assert [e["rev"] for e in events] == [1]
+        assert log2.last_rev == 1
+        assert log2.recovery["dropped_records"] == 1
+        assert log2.recovery["torn_tails"] == 1
+
+    def test_snapshot_compacts_and_reaps(self, tmp_path):
+        d = str(tmp_path)
+        log = WriteAheadLog(d, segment_bytes=1)
+        log.recover()
+        _append(log, 1)
+        _append(log, 2)
+        _append(log, 3)
+        log.write_snapshot({"rev": 2, "kv": {"compacted": True}})
+        names = sorted(os.listdir(d))
+        # segments fully covered by the snapshot are reaped; the live
+        # segment (rev 3) and anything past the snapshot survive
+        assert "wal-00000001.seg" not in names
+        assert "wal-00000002.seg" not in names
+        assert "wal-00000003.seg" in names
+        assert "snapshot-00000002.snap" in names
+        # a newer snapshot reaps the older one
+        log.write_snapshot({"rev": 3, "kv": {"compacted": 2}})
+        names = sorted(os.listdir(d))
+        assert "snapshot-00000002.snap" not in names
+        # a stale snapshot offer is a no-op
+        log.write_snapshot({"rev": 2, "kv": {}})
+        assert log.snapshot_rev == 3
+        log.close()
+        log2 = WriteAheadLog(d)
+        snap, events, _ = log2.recover()
+        assert snap == {"rev": 3, "kv": {"compacted": 2}}
+        assert events == []  # everything the snapshot covers is skipped
+        assert log2.last_rev == 3 and log2.snapshot_rev == 3
+
+    def test_should_snapshot_threshold(self, tmp_path):
+        log = WriteAheadLog(str(tmp_path), snapshot_bytes=1)
+        log.recover()
+        assert not log.should_snapshot()  # nothing to compact yet
+        _append(log, 1)
+        assert log.should_snapshot()
+        log.write_snapshot({"rev": 1})
+        assert not log.should_snapshot()  # no new state past the snap
+        log.close()
+
+    def test_tmp_leftovers_reaped_on_recovery(self, tmp_path):
+        d = str(tmp_path)
+        leftover = os.path.join(d, "snapshot-00000009.snap.tmp")
+        with open(leftover, "wb") as f:
+            f.write(b"half-written")
+        log = WriteAheadLog(d)
+        log.recover()
+        assert not os.path.exists(leftover)
+        log.close()
+
+    def test_invalid_newer_snapshot_falls_back_to_older(self, tmp_path):
+        d = str(tmp_path)
+        log = WriteAheadLog(d)
+        log.recover()
+        _append(log, 1)
+        log.write_snapshot({"rev": 1, "kv": {"good": True}})
+        log.close()
+        with open(os.path.join(d, "snapshot-00000009.snap"), "wb") as f:
+            f.write(b"\xde\xad\xbe\xef not a snapshot")
+        log2 = WriteAheadLog(d)
+        snap, _, _ = log2.recover()
+        assert snap == {"rev": 1, "kv": {"good": True}}
+        assert log2.snapshot_rev == 1
+
+    def test_deadline_note_carries_coverage_cutoff(self, tmp_path):
+        d = str(tmp_path)
+        log = WriteAheadLog(d, deadline_interval_s=0.0)
+        log.recover()
+        _append(log, 1, 2, 3)
+        assert log.note_deadlines(lambda: {"L1": 5.0}) is True
+        log.close()
+        log2 = WriteAheadLog(d)
+        _, _, deadlines = log2.recover()
+        assert deadlines == {"L1": 5.0}
+        # the note covered everything up to rev 3: a lease granted at
+        # rev <= 3 but absent from the note was dead when it was taken
+        assert log2.deadline_cutoff_rev == 3
+
+    def test_deadline_note_rate_limited(self, tmp_path):
+        log = WriteAheadLog(str(tmp_path), deadline_interval_s=60.0)
+        log.recover()
+        _append(log, 1)
+        assert log.note_deadlines(lambda: {"L": 1.0}) is True
+        assert log.note_deadlines(lambda: {"L": 1.0}) is False
+        log.close()
+
+    def test_bad_sync_policy_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            WriteAheadLog(str(tmp_path), sync="eventually")
+
+    def test_manifest_shape(self, tmp_path):
+        log = WriteAheadLog(str(tmp_path))
+        log.recover()
+        _append(log, 1)
+        m = log.manifest()
+        assert m["last_rev"] == 1 and m["snapshot_rev"] == 0
+        assert isinstance(m["segments"], int) and m["segments"] == 1
+        assert m["appends"] == 1 and m["bytes_written"] > 0
+        assert m["sync"] == "always" and m["recovery"]["recovered_rev"] == 0
+        log.close()
+
+    def test_atomic_json_roundtrip_and_corrupt_read(self, tmp_path):
+        path = str(tmp_path / "manifest.json")
+        atomic_write_json(path, {"pins": ["t"]})
+        assert read_json(path) == {"pins": ["t"]}
+        assert not os.path.exists(path + ".tmp")
+        with open(path, "wb") as f:
+            f.write(b"{torn")
+        assert read_json(path) is None  # corrupt -> None, never raise
+        assert read_json(str(tmp_path / "missing.json")) is None
+
+
+# -- node-level crash recovery --------------------------------------------
+
+
+class TestNodeRecovery:
+    def test_full_state_survives_a_kill(self, tmp_path):
+        d = str(tmp_path)
+        node = ClusterNode(addr="a:1", wal_dir=d)
+        client = LocalClusterClient(node)
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+        client.put("config/x", {"nested": [1, 2]})
+        client.invalidate("t")
+        entry = _snapshot()
+        client.result_publish("fp", entry, 64, ("t",))
+        term, rev = node.term, node.state._rev
+        epoch = node.state.membership()["epoch"]
+        del node, client  # crash: no stop(), no flush()
+        node2 = ClusterNode(addr="a:1", wal_dir=d)
+        assert node2.recovered_revisions == rev
+        assert node2.term == term and node2.state._rev == rev
+        assert node2.state.membership()["epoch"] == epoch
+        assert node2.state.get("config/x") == {"nested": [1, 2]}
+        assert node2.state.membership()["workers"].keys() == {"w:9"}
+        stored = node2.state.result_get("fp")
+        assert stored is not None
+        np.testing.assert_array_equal(
+            stored["snapshot"]["columns"][0], entry.columns[0])
+        assert node2.status()["wal"]["recovery"]["replayed_events"] > 0
+
+    def test_revision_continuity_across_restarts(self, tmp_path):
+        d = str(tmp_path)
+        node = ClusterNode(wal_dir=d)
+        LocalClusterClient(node).put("a", 1)
+        rev1 = node.state._rev
+        del node
+        node2 = ClusterNode(wal_dir=d)
+        LocalClusterClient(node2).put("b", 2)
+        assert node2.state._rev > rev1  # no rev reuse after recovery
+        del node2
+        node3 = ClusterNode(wal_dir=d)
+        assert node3.state.get("a") == 1 and node3.state.get("b") == 2
+
+    def test_disk_fault_refuses_the_ack(self, tmp_path):
+        node = ClusterNode(wal_dir=str(tmp_path))
+        with faults.scoped({"rules": [
+            {"site": "wal.write", "op": "raise",
+             "exc": "OSError", "count": 1},
+        ]}):
+            out = node.handle_request(
+                {"type": "kv_put", "key": "k", "value": 1})
+            assert out["type"] == "error"
+            assert out["code"] == "wal_unavailable"
+        # the fault was transient: the next attempt lands durably
+        out = node.handle_request({"type": "kv_put", "key": "k", "value": 2})
+        assert out["type"] == "ok"
+        del node
+        node2 = ClusterNode(wal_dir=str(tmp_path))
+        assert node2.state.get("k") == 2
+
+    def test_wal_off_is_byte_identical(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("DATAFUSION_TPU_WAL_DIR", raising=False)
+        plain = ClusterNode(addr="a:1")
+        walled = ClusterNode(addr="a:1", wal_dir=str(tmp_path))
+        assert plain.wal is None and walled.wal is not None
+        # zero WAL surface with durability off
+        assert "wal" not in plain.status()
+        assert not any(k.startswith("wal.") for k in plain.gauges())
+        assert "wal" in walled.status()
+        reqs = [
+            {"type": "kv_put", "key": "a", "value": 1},
+            {"type": "kv_put", "key": "b", "value": {"x": [1, 2]}},
+            {"type": "kv_get", "key": "b"},
+            {"type": "invalidate", "table": "t"},
+            {"type": "kv_delete", "key": "a"},
+            {"type": "kv_range", "prefix": ""},
+            {"type": "events", "since": 0},
+        ]
+        for msg in reqs:
+            assert plain.handle_request(dict(msg)) \
+                == walled.handle_request(dict(msg))
+
+
+# -- lease re-arm semantics -----------------------------------------------
+
+
+class TestLeaseRearm:
+    def test_rearm_uses_persisted_remaining_never_full_ttl(self):
+        st = ClusterState()
+        g = st.lease_grant(10.0, now=0.0)
+        st.put("workers/w", {}, lease=g["lease"], now=0.0)
+        st.rearm_leases({g["lease"]: 1.5}, now=100.0)
+        assert st.get("workers/w", now=101.0) is not None
+        # 1.5s remaining, not a fresh 10s grant
+        assert st.get("workers/w", now=102.0) is None
+
+    def test_rearm_zero_dies_on_first_sweep(self):
+        st = ClusterState()
+        g = st.lease_grant(10.0, now=0.0)
+        st.put("workers/w", {}, lease=g["lease"], now=0.0)
+        st.rearm_leases({g["lease"]: 0.0}, now=100.0)
+        assert st.get("workers/w", now=100.001) is None
+
+    def test_rearm_caps_at_the_ttl(self):
+        st = ClusterState()
+        g = st.lease_grant(2.0, now=0.0)
+        st.rearm_leases({g["lease"]: 99.0}, now=100.0)
+        assert st._leases[g["lease"]].expires == pytest.approx(102.0)
+
+    def test_dead_lease_stays_dead_across_crash(self, tmp_path, monkeypatch):
+        """Regression: a lease that expired BEFORE the crash is absent
+        from the deadline note (the note excludes expired leases), but
+        its grant event still replays — without the note's coverage
+        cutoff the full-TTL fallback would revive it, masking a dead
+        worker for a whole extra TTL after every restart."""
+        monkeypatch.setenv("DATAFUSION_TPU_WAL_DEADLINE_S", "0.0")
+        d = str(tmp_path)
+        node = ClusterNode(wal_dir=d)
+        client = LocalClusterClient(node)
+        g = client.lease_grant(0.4)
+        client.put("workers/dead", {}, lease=g["lease"])
+        time.sleep(0.6)
+        # this write sweeps the expired lease AND syncs a deadline
+        # note that no longer mentions it
+        client.put("config/x", 1)
+        del node, client  # crash
+        node2 = ClusterNode(wal_dir=d)
+        st = node2.state
+        # granted at rev <= the note's cutoff but absent from it:
+        # re-armed at zero, gone on the first sweep — never 0.4s alive
+        exp = st._leases[g["lease"]].expires if g["lease"] in st._leases \
+            else None
+        assert exp is None or exp - time.monotonic() <= 0.05
+        assert st.get("workers/dead") is None
+
+    def test_live_lease_rearms_with_remaining(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_WAL_DEADLINE_S", "0.0")
+        d = str(tmp_path)
+        node = ClusterNode(wal_dir=d)
+        client = LocalClusterClient(node)
+        g = client.lease_grant(30.0)
+        client.put("workers/live", {}, lease=g["lease"])
+        del node, client
+        node2 = ClusterNode(wal_dir=d)
+        remaining = node2.state._leases[g["lease"]].expires - time.monotonic()
+        assert 0.0 < remaining <= 30.0
+        assert node2.state.get("workers/live") is not None
+
+    def test_lease_granted_after_the_note_gets_full_ttl(self, tmp_path):
+        # note cadence bounds this window: a grant the note never saw
+        # has no persisted deadline -> bounded full-TTL fallback
+        d = str(tmp_path)
+        log = WriteAheadLog(d, deadline_interval_s=0.0)
+        log.recover()
+        _append(log, 1)
+        log.note_deadlines(lambda: {})  # cutoff = 1
+        log.append([({"kind": "lease_grant", "rev": 2,
+                      "lease": "late", "ttl_s": 10.0}, None)])
+        log.close()
+        node = ClusterNode(wal_dir=d)
+        assert node.wal.deadline_cutoff_rev == 1
+        lease = node.state._leases["late"]
+        assert lease.expires - time.monotonic() == pytest.approx(10.0, abs=1.0)
+
+
+# -- snapshot-resync truncation edge --------------------------------------
+
+
+def _pair(election_timeout_s=1.0):
+    a = ClusterNode(addr="a:1")
+    b = ClusterNode(addr="b:2", standby_of=a,
+                    election_timeout_s=election_timeout_s)
+    return a, b, LocalClusterClient([a, b])
+
+
+class TestSnapshotResyncTruncation:
+    def _blow_the_window(self, client, n=1200):
+        for i in range(n):  # past the 1024-event retention window
+            client.invalidate(f"t{i}")
+
+    def test_partially_caught_up_standby_resyncs_once(self):
+        a, b, client = _pair()
+        g = client.lease_grant(30.0)
+        client.put("workers/w:9", {"addr": "w:9"}, lease=g["lease"])
+        client.put("config/x", 1)
+        assert b.replicate_once() > 0  # partial catch-up, then fall off
+        mid_rev = b.state._rev
+        self._blow_the_window(client)
+        assert b.replicate_once() == -1  # full snapshot, not a tail
+        assert b.snapshots_applied == 1
+        # nothing duplicated, nothing skipped
+        assert b.state._rev == a.state._rev > mid_rev
+        assert b.state.membership()["epoch"] == a.state.membership()["epoch"]
+        assert b.state.membership()["workers"].keys() == {"w:9"}
+        assert b.state.get("config/x") == 1
+        # incremental shipping resumes cleanly after the resync
+        client.put("config/y", 2)
+        assert b.replicate_once() >= 1
+        assert b.snapshots_applied == 1  # no second snapshot needed
+        assert b.state.get("config/y") == 2
+
+    def test_resync_survives_a_snapshot_fault(self):
+        a, b, client = _pair()
+        client.lease_grant(30.0)   # rev 1: keeps the floor at 1 so
+        client.put("config/x", 1)  # the first pull ships events
+        assert b.replicate_once() > 0
+        self._blow_the_window(client)
+        with faults.scoped({"rules": [
+            {"site": "cluster.snapshot", "op": "raise",
+             "exc": "ExecutionError", "count": 1},
+        ]}):
+            with pytest.raises(ExecutionError):
+                b.replicate_once()
+            assert b.snapshots_applied == 0  # the failed pull changed nothing
+        assert b.replicate_once() == -1  # the retry resyncs
+        assert b.snapshots_applied == 1
+        assert b.state._rev == a.state._rev
+        assert b.state.get("config/x") == 1
+
+
+# -- debug-bundle durability block ----------------------------------------
+
+
+class TestBundleWalBlock:
+    def test_bundle_reports_live_wal_manifests(self, tmp_path):
+        from datafusion_tpu.obs.httpd import build_bundle
+
+        node = ClusterNode(wal_dir=str(tmp_path))
+        LocalClusterClient(node).put("a", 1)
+        doc = build_bundle(profile_seconds=0.0)
+        manifests = [m for m in doc.get("wal", [])
+                     if m["dir"] == node.wal.dir]
+        assert len(manifests) == 1
+        assert manifests[0]["last_rev"] == node.state._rev
+        node.wal.close()
+        # a closed WAL drops out of the bundle
+        doc = build_bundle(profile_seconds=0.0)
+        assert all(m["dir"] != node.wal.dir for m in doc.get("wal", []))
