@@ -12,7 +12,8 @@ D2H) is reported separately with a per-phase breakdown under
 `configs.tpch_q1_parquet`.
 
 Env knobs: BENCH_SF (lineitem scale factor for config 3, default 1),
-BENCH_CONFIGS (comma list, default "1,2,3,4,5,3sf10,worker,cache" —
+BENCH_CONFIGS (comma list, default
+"1,2,3,4,5,3sf10,worker,cache,conc,ingest" —
 "3sf10" runs Q1 at the north-star SF-10 scale, "worker" runs the
 coordinator->worker-on-chip parity smoke and writes
 artifacts/TPU_WORKER_SMOKE.json, "cache" runs the result-cache
@@ -35,7 +36,7 @@ def main():
     device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache,conc"
+        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache,conc,ingest"
     ).split(",")
     runners = {
         "1": suite.config1_csv_filter,
@@ -56,6 +57,9 @@ def main():
         # admission + HBM-pinned tables + cross-query megabatching) vs
         # serialized back-to-back execution of the same workload
         "conc": suite.config_concurrency,
+        # streaming ingestion: Q1 view incremental maintenance rate x
+        # freshness vs recomputing the view from scratch per delta
+        "ingest": suite.config_ingest,
     }
     if float(os.environ.get("BENCH_SF", 1)) == 10 and "3" in [
         w.strip() for w in wanted

@@ -96,6 +96,14 @@ def source_version(meta) -> list:
                 continue
             path = body.get("filename")
             if path is None:
+                # in-memory growing sources (ingest.AppendableSource
+                # `meta()` blocks) version by append count, not by file
+                # identity — the ingest log's drift check and any
+                # wrapper that serializes such a meta fold this in
+                dv = body.get("data_version")
+                if dv is not None:
+                    out.append(["mem:" + str(body.get("name") or ""),
+                                int(dv), int(body.get("rows") or 0)])
                 continue
             try:
                 st = os.stat(path)

@@ -346,7 +346,76 @@ class Console:
 
             self._print(LEDGER.report_text())
             return True
+        if cmd == "\\ingest":
+            # streaming-ingest introspection (datafusion_tpu/ingest):
+            # appendable tables, view revisions + freshness lags, WAL
+            self._ingest_status()
+            return True
+        if cmd.startswith("\\append"):
+            # \append <table> {"col": [v, ...], ...} — one durable
+            # delta through the same append path the wire uses
+            self._append(stripped[len("\\append"):].strip())
+            return True
         return False
+
+    def _ingest_status(self) -> None:
+        ing = self.ctx.ingest()
+        st = ing.status()
+        wal = st["wal"]
+        self._print(
+            f"Ingest rev {st['rev']}, "
+            + (f"WAL {wal['appends']} append(s) in {wal['segments']} "
+               f"segment(s) ({wal['segment_bytes']} bytes)"
+               if wal else "no WAL (in-memory)")
+        )
+        if st["recovery"]:
+            r = st["recovery"]
+            self._print(
+                f"  recovered: {r.get('appends_replayed', 0)} append(s) "
+                f"replayed, {r.get('views_recovered', 0)} view(s) re-planned"
+            )
+        for name, t in sorted(st["tables"].items()):
+            self._print(
+                f"  table {name}: {t['rows']} rows "
+                f"({t['base_batches']} base batch(es)), "
+                f"data version {t['data_version']}"
+            )
+        for name, v in sorted(st["views"].items()):
+            mode = ("incremental" if v["incremental"]
+                    else f"full-recompute ({v['fallback_reason']})")
+            self._print(
+                f"  view {name} ON {v['table']}: rev {v['revision']}, "
+                f"{mode}, lag {v['lag_s'] * 1e3:.1f} ms, "
+                f"{v['maintain_launches']} maintain launch(es)"
+            )
+        if not st["tables"] and not st["views"]:
+            self._print("  (no appendable tables or materialized views)")
+
+    def _append(self, arg: str) -> None:
+        import json
+
+        from datafusion_tpu.errors import DataFusionError
+
+        table, _, payload = arg.partition(" ")
+        if not table or not payload.strip():
+            self._print('Usage: \\append <table> {"col": [values], ...}')
+            return
+        try:
+            columns = json.loads(payload)
+        except ValueError as e:
+            self._print(f"Bad columns JSON: {e}")
+            return
+        try:
+            ack = self.ctx.ingest().append(table, columns)
+        except DataFusionError as e:
+            self._print(f"Append failed: {e}")
+            return
+        views = ", ".join(f"{n}@r{r}" for n, r in ack["views"].items())
+        self._print(
+            f"Appended {ack['rows']} row(s) to {ack['table']} "
+            f"(rev {ack['rev']}"
+            + (f"; views advanced: {views})" if views else ")")
+        )
 
     def _cluster_status(self) -> None:
         import os

@@ -324,6 +324,13 @@ class _ClientApi:
     def invalidate(self, table: str) -> dict:
         return self.request({"type": "invalidate", "table": table})
 
+    def view_advance(self, name: str, revision: int) -> dict:
+        """Broadcast a materialized view's new revision; watchers
+        parked on `watch` wake with a ``view`` event."""
+        return self.request({
+            "type": "view_advance", "name": name, "revision": int(revision),
+        })
+
     def result_put(self, key: str, value: dict, nbytes: int,
                    tables: tuple = ()) -> bool:
         return bool(self.request({

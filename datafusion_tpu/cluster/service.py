@@ -75,7 +75,7 @@ from datafusion_tpu.utils.metrics import METRICS
 _EVENT_LOG_CAP = 1024
 # event kinds surfaced to workers/coordinators (lease_refresh piggyback,
 # `events`, `watch`); the remaining kinds exist for log-shipping only
-CLIENT_EVENT_KINDS = ("join", "leave", "invalidate")
+CLIENT_EVENT_KINDS = ("join", "leave", "invalidate", "view")
 _WATCH_TIMEOUT_CAP_S = 60.0
 
 
@@ -169,7 +169,7 @@ class ClusterState:
         return self._rev
 
     _FLIGHT_KINDS = frozenset((
-        "join", "leave", "invalidate", "lease_gone", "promoted",
+        "join", "leave", "invalidate", "lease_gone", "promoted", "view",
     ))
 
     def _append_event(self, kind: str, **payload) -> int:
@@ -562,6 +562,26 @@ class ClusterState:
             METRICS.add("cluster.invalidations")
             return {"rev": rev, "dropped": dropped}
 
+    def view_advance(self, name: str, revision: int,
+                     now: Optional[float] = None) -> dict:
+        """Materialized-view revision broadcast (the ingest plane's
+        freshness signal): record the view's newest revision under
+        ``views/<name>`` so late joiners can read it, and emit a
+        client-visible ``view`` event so subscribers parked on `watch`
+        wake with the advance — with resumption-token proof that no
+        revision was skipped, exactly like invalidations."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._expire(now)
+            key = f"views/{name}"
+            self._kv[key] = _Key(int(revision), None, self._next_rev(), now)
+            rev = self._append_event(
+                "view", key=key, value=int(revision),
+                name=name, revision=int(revision),
+            )
+            METRICS.add("cluster.view_advances")
+            return {"rev": rev, "revision": int(revision)}
+
     # -- shared result tier --
     def result_put(self, fingerprint: str, value: dict, nbytes: int,
                    tables: tuple = ()) -> bool:
@@ -661,7 +681,7 @@ class ClusterState:
                         entry = self._kv.get(key)
                         if entry is not None and entry.lease == ev["lease"]:
                             del self._kv[key]
-            elif kind in ("join", "put"):
+            elif kind in ("join", "put", "view"):
                 key = ev["key"]
                 joined = self._is_member_key(key) and key not in self._kv
                 entry = _Key(ev.get("value"), ev.get("lease"), ev["rev"], now)
@@ -909,7 +929,7 @@ class ClusterState:
 
 _MUTATING_REQUESTS = frozenset((
     "lease_grant", "lease_refresh", "lease_revoke", "kv_put", "kv_delete",
-    "invalidate", "result_put", "result_put_delta",
+    "invalidate", "view_advance", "result_put", "result_put_delta",
 ))
 
 
@@ -972,6 +992,9 @@ def apply_request(state: ClusterState, msg: dict, bw=None) -> dict:
         return {"type": "watch", **out}
     if kind == "invalidate":
         return {"type": "ok", **state.invalidate(msg["table"])}
+    if kind == "view_advance":
+        return {"type": "ok", **state.view_advance(
+            msg["name"], int(msg.get("revision", 0)))}
     if kind == "result_put":
         stored = state.result_put(
             msg["key"], _decode_result_value(msg["value"]),

@@ -119,6 +119,21 @@ class ClusterQuorumError(TransientError, ExecutionError):
         self.quorum = int(quorum)
 
 
+class IngestError(ExecutionError):
+    """A streaming append or materialized-view operation failed
+    permanently (schema mismatch, unknown table/view, ineligible
+    shape).  Deliberately NOT transient: replaying a malformed append
+    cannot make it well-formed."""
+
+
+class IngestUnavailableError(TransientError, IngestError):
+    """The ingest log could not durably record an append — the write
+    was NOT acknowledged and nothing was applied (the ingest twin of
+    the cluster's `wal_unavailable` refusal).  Transient by
+    construction: the caller retries when the log recovers, and the
+    WAL's revision dedup makes replays idempotent."""
+
+
 class StaleTermError(ExecutionError):
     """A write carried a leadership term older than the service's
     current term — the writer is a deposed primary and must not mutate

@@ -257,6 +257,11 @@ class ExecutionContext:
             stmt = parse_sql(sql_text)
         if isinstance(stmt, ast.SqlCreateExternalTable):
             return self._execute_ddl(stmt)
+        if isinstance(stmt, ast.SqlCreateMaterializedView):
+            view = self.ingest().create_view(stmt.name, stmt.query_sql)
+            return DdlResult(
+                f"Registered materialized view {stmt.name} "
+                f"({'incremental' if view.incremental else 'recompute'})")
         if isinstance(stmt, ast.SqlExplain):
             plan = self._plan(stmt.stmt)
             if stmt.analyze:
@@ -341,6 +346,12 @@ class ExecutionContext:
             entry: list = [self.catalog_version(t)]
             ds = self.datasources.get(t)
             if ds is not None:
+                # streaming (appendable) tables version by append count:
+                # every delta must stop dependent cached results from
+                # matching even if a registration bump were ever missed
+                dv = getattr(ds, "data_version", None)
+                if dv is not None:
+                    entry.append(["data", int(dv)])
                 try:
                     entry.append(source_version(ds.to_meta()))
                 except PlanError:
@@ -650,6 +661,25 @@ class ExecutionContext:
                 [None if v is None else v[: physical_plan.count] for v in table.validity],
             )
         raise ExecutionError(f"unknown physical plan kind {kind!r}")
+
+    def ingest(self, wal_dir: Optional[str] = None):
+        """This context's streaming-ingest state (datafusion_tpu/ingest
+        — appendable tables, materialized views, the durable ingest
+        log), created on first use.  `wal_dir` (or
+        ``DATAFUSION_TPU_INGEST_WAL_DIR``) enables durability; pass it
+        on the FIRST call — later calls return the existing instance."""
+        ing = getattr(self, "_ingest", None)
+        if ing is None:
+            import os as _os
+
+            from datafusion_tpu import ingest as _ingest_mod
+
+            if wal_dir is None:
+                wal_dir = _os.environ.get(
+                    "DATAFUSION_TPU_INGEST_WAL_DIR") or None
+            ing = self._ingest = _ingest_mod.IngestContext(
+                self, wal_dir=wal_dir)
+        return ing
 
     def serve(self, **kwargs):
         """A started serving front door over this context

@@ -263,6 +263,11 @@ class _PipelineCore:
         # fused-pass batch-group map (exec/fused.py): one launch runs
         # the filter+project kernel over a whole group of batches
         self.group_jit = jax.jit(self._fused_group)
+        # cross-query megabatch map (serve.py / run_pipeline_megabatch):
+        # one launch runs N queries' filter+project — same core, each
+        # query's literals in its own params slot-tuple — over a whole
+        # stacked group; the shared input columns upload once
+        self.multi_group_jit = jax.jit(self._multi_fused_group)
 
     def _fused_group(self, entries, aux, params):
         """ONE launch for a group of prepared batches: `lax.map` of the
@@ -284,6 +289,37 @@ class _PipelineCore:
         return tuple(
             jax.tree.map(lambda t, i=i: t[i], ys)
             for i in range(len(entries))
+        )
+
+    def _multi_fused_group(self, entries, aux, params_list):
+        """N queries over ONE stacked batch group in one launch (the
+        serve-plane pipeline megabatch): the map body runs the kernel
+        once per query against the same stacked inputs — per-query
+        literals arrive through ``params_list``, so `WHERE x > ?`
+        variants share every uploaded column and the launch itself.
+        Outputs return as [query][batch] tuples of (cols, valids,
+        mask), matching `_fused_group`'s per-batch shape per query."""
+        from datafusion_tpu.exec.fused import stack_entries
+
+        stacked = stack_entries(entries)
+
+        def body(x):
+            cols, valids, num_rows, mask = x
+            outs = []
+            for params in params_list:
+                out_cols, out_valids, m = self._kernel(
+                    cols, valids, aux, num_rows, mask, params
+                )
+                outs.append((tuple(out_cols), tuple(out_valids), m))
+            return tuple(outs)
+
+        ys = jax.lax.map(body, stacked)
+        return tuple(
+            tuple(
+                jax.tree.map(lambda t, i=i: t[i], ys[q])
+                for i in range(len(entries))
+            )
+            for q in range(len(params_list))
         )
 
     @staticmethod
@@ -416,11 +452,13 @@ class PipelineRelation(Relation):
         # under host_scalar — the whole batch often never touches the
         # device.  Predicates containing host-only UDFs keep going to
         # the core so it raises its NotSupportedError contract.
+        from datafusion_tpu.exec.aggregate import _FORCE_CORE_PRED
         from datafusion_tpu.exec.hostfn import contains_host_fn, host_evaluable
 
         host_pred = (
             predicate is not None
             and host_scalar
+            and not _FORCE_CORE_PRED.get()
             and not contains_host_fn(predicate, self._metas)
             and host_evaluable(predicate, self._metas, child.schema)
         )
@@ -466,6 +504,14 @@ class PipelineRelation(Relation):
         from datafusion_tpu.exec.prefetch import pipeline_enabled, staged_pipeline
         from datafusion_tpu.obs.stats import iter_stats, op_timer
 
+        inj = self.__dict__.pop("_injected_batches", None)
+        if inj is not None:
+            # serve-plane megabatch (run_pipeline_megabatch): the
+            # cross-query pass already ran this query's kernel over
+            # the SHARED scan — its assembled output batches replay
+            # here with no further device work
+            yield from inj
+            return
         core = self.core
         batches = iter_stats(self.child)
         if core.needs_kernel and pipeline_enabled(self.device):
@@ -789,3 +835,89 @@ class PipelineRelation(Relation):
                 None if valid is None else np.broadcast_to(valid, (batch.capacity,))
             )
         return cols, valids, dicts
+
+
+def run_pipeline_megabatch(rels: list["PipelineRelation"]) -> float:
+    """ONE scan, N filter/project queries: the serve plane's
+    cross-query fused pass for pipeline shapes (the PipelineRelation
+    twin of serve's Aggregate megabatch).  Preconditions
+    (serve._mega_key): every relation shares ``rels[0].core``
+    (kernel-cache identity — literals parameterized into per-query
+    ``_params`` slots) over one table scan with no per-query host
+    mask, so the input columns upload ONCE and every batch group runs
+    ALL queries' kernels in one launch
+    (`_PipelineCore.multi_group_jit`).  Each relation receives its
+    assembled output batches as ``_injected_batches``; its own
+    `batches()` then replays them with no further device work — the
+    demux is per-query finalize-time pulls, so this returns 0.0 for
+    the caller's demux share.  The query axis pads to its bucket rung
+    (duplicate leader params) so concurrent group sizes share
+    compiled programs."""
+    from datafusion_tpu.exec.fused import (
+        bucket_group,
+        entry_signature,
+        pad_group,
+        pipeline_group_max,
+    )
+    from datafusion_tpu.exec.batch import device_inputs
+    from datafusion_tpu.obs.stats import iter_stats, op_timer
+
+    leader = rels[0]
+    core = leader.core
+    n_live = len(rels)
+    n_q = bucket_group(n_live)
+    params_list = tuple(r._params for r in rels)
+    params_list += (params_list[0],) * (n_q - n_live)
+    group_max = pipeline_group_max()
+    outs_per_rel: list[list] = [[] for _ in rels]
+    buf: list = []  # (batch, entry, aux)
+    cur_sig = None
+
+    def flush():
+        if not buf:
+            return
+        with METRICS.timer("execute.pipeline"), op_timer(leader), \
+                device_scope(leader.device):
+            group = pad_group(
+                [e for _, e, _ in buf],
+                lambda e: (e[0], e[1], np.int32(0), e[3]),
+            )
+            METRICS.add("fused.groups")
+            METRICS.add("fused.group_batches", len(buf))
+            METRICS.add("serve.megabatch_launches")
+            METRICS.add("serve.megabatch_queries", n_live)
+            METRICS.add("serve.megabatch_batches", len(buf))
+            outs = device_call(
+                core.multi_group_jit, tuple(group), buf[0][2],
+                params_list, _tag="pipeline.mega",
+            )
+        for q, r in enumerate(rels):
+            for (b, _, _), (cols, valids, mask) in zip(buf, outs[q]):
+                outs_per_rel[q].append(
+                    r._emit_kernel_output(b, list(cols), list(valids), mask)
+                )
+        buf.clear()
+
+    for batch in iter_stats(leader.child):
+        staged = batch.cache.get("staged_aux")
+        if staged is not None and staged[0] is core:
+            aux = staged[1]
+        else:
+            aux = tuple(
+                compute_aux_values(core.aux_specs, batch, leader._aux_cache)
+            )
+        with METRICS.timer("execute.pipeline"), op_timer(leader), \
+                device_scope(leader.device):
+            data, validity, mask_in = device_inputs(
+                leader._subset_view(batch), leader.device, core.wire_hints
+            )
+        entry = (data, validity, np.int32(batch.num_rows), mask_in)
+        sig = (entry_signature(entry), tuple(map(id, aux)))
+        if buf and (sig != cur_sig or len(buf) >= group_max):
+            flush()
+        cur_sig = sig
+        buf.append((batch, entry, aux))
+    flush()
+    for r, outs in zip(rels, outs_per_rel):
+        r._injected_batches = outs
+    return 0.0

@@ -190,6 +190,17 @@ class _TopKCore:
         self.group_final_jit = jax.jit(self._group_final,
                                        static_argnums=(0,))
         self.final_jit = jax.jit(self._final_merge)
+        # cross-query megabatch folds (serve.py / run_topk_megabatch):
+        # N queries' states ride ONE stacked scan fold — the per-query
+        # state-capacity tuple `ks` is static (bucketed, so concurrent
+        # LIMITs usually share one compiled program), and the final
+        # variant collapses every state through `_final_merge` inside
+        # the same program so the host pulls one packed array per
+        # query from a single blob transfer
+        self.multi_group_jit = jax.jit(self._multi_group,
+                                       static_argnums=(0,))
+        self.multi_final_jit = jax.jit(self._multi_group_final,
+                                       static_argnums=(0,))
         # per-column codec memory for put_compressed (see batch.py)
         self.wire_hints: dict = {}
 
@@ -241,6 +252,55 @@ class _TopKCore:
 
         state, _ = lax.scan(body, state, stacked)
         return state
+
+    def _fold_batch(self, k, state, cols, valids, mask, num_rows,
+                    row_base, rank_tables, img):
+        """One batch merged into one query's state, routed by path."""
+        if self.single:
+            return self._topk1_kernel(
+                k, state, cols, valids, mask, num_rows, row_base,
+                rank_tables,
+            )
+        if self.wide:
+            return self._topk_wide_kernel(
+                k, state, cols, valids, mask, num_rows, row_base,
+                rank_tables, img,
+            )
+        return self._topk_kernel(
+            k, state, cols, valids, mask, num_rows, row_base,
+            rank_tables,
+        )
+
+    def _multi_group(self, ks, states, entries, rank_tables):
+        """N queries' states folded over ONE stacked batch group (the
+        serve-plane TopK megabatch): the scan body merges every
+        query's state against the same batch operands, so a group
+        costs one launch — and one upload — regardless of how many
+        queries ride it.  Megabatched queries share the scan with no
+        per-query predicate masks (eligibility in serve._mega_key),
+        so the entry tuple is identical for all of them."""
+        from datafusion_tpu.exec.fused import stack_entries
+
+        stacked = stack_entries(entries)
+
+        def body(sts, x):
+            cols, valids, mask, num_rows, row_base, img = x
+            return tuple(
+                self._fold_batch(k, st, cols, valids, mask, num_rows,
+                                 row_base, rank_tables, img)
+                for k, st in zip(ks, sts)
+            ), None
+
+        states, _ = lax.scan(body, tuple(states), stacked)
+        return states
+
+    def _multi_group_final(self, ks, states, entries, rank_tables):
+        """The megabatch's LAST group fold fused with every query's
+        result merge — one launch ends the whole cross-query pass,
+        and the outputs pack into one int64 array per query."""
+        if entries:
+            states = self._multi_group(ks, states, entries, rank_tables)
+        return tuple(self._final_merge(st) for st in states)
 
     def _fused_topk(self, k, state, chunk):
         """Fold the per-batch merge over a chunk of prepared batches in
@@ -739,6 +799,14 @@ class SortRelation(Relation):
 
         from datafusion_tpu.exec.kernels import fuse_batch_count
 
+        inj = self.__dict__.pop("_injected_topk", None)
+        if inj is not None and core is None:
+            # serve-plane megabatch (run_topk_megabatch): the
+            # cross-query pass already folded this query's state over
+            # the SHARED scan — skip the scan, run only the host
+            # payload gather
+            yield from self._injected_topk_result(inj)
+            return
         if core is None:
             core = self.core
         topk_jit = core.jit
@@ -948,7 +1016,12 @@ class SortRelation(Relation):
             # bucket-sized, so slice down to the actual LIMIT
             take = np.nonzero(np.asarray(live))[0][: self.limit]
             win = np.asarray(rows)[take]
-        # host payload gather: global row id -> (source batch, local row)
+        yield self._topk_gather(win, src_batches, bases, dicts, in_schema)
+
+    def _topk_gather(self, win, src_batches, bases, dicts, in_schema):
+        """Host payload gather: global row id -> (source batch, local
+        row).  Payload values come from the source batches' HOST
+        arrays — bit-exact, no payload bytes ever crossed the link."""
         base_arr = np.asarray(bases, dtype=np.int64)
         b_idx = np.searchsorted(base_arr, win, side="right") - 1
         local = win - base_arr[b_idx]
@@ -970,10 +1043,32 @@ class SortRelation(Relation):
             out_valid.append(
                 None if not any_null or bool(valid_i.all()) else valid_i
             )
-        yield make_host_batch(
+        return make_host_batch(
             self._schema, out_cols, out_valid,
             [dicts[i] for i in self._out_cols],
         )
+
+    def _injected_topk_result(self, inj) -> Iterator[RecordBatch]:
+        """Consume a megabatch injection: the packed merge result is
+        already on the host, so only the payload gather runs here.  A
+        set wide-path collision flag replays THIS query solo through
+        the exact sort core (counted) — the shared pass cannot replay
+        per-query, and datasources are re-iterable."""
+        packed_h, src_batches, bases, dicts = inj
+        if bool(packed_h[0]):
+            METRICS.add("sort.wide_fallbacks")
+            yield from self._topk_batches(
+                _TopKCore.build(self._key_plans, force_general=True)
+            )
+            return
+        in_schema = self.child.schema
+        merged = packed_h[1:]
+        take = np.nonzero(merged >= 0)[0][: self.limit]
+        win = merged[take]
+        if not len(win) and not src_batches:
+            yield self._empty_result(in_schema, dicts)
+            return
+        yield self._topk_gather(win, src_batches, bases, dicts, in_schema)
 
     def _final_flush(self, core, chunk, state):
         """Dispatch the scan's remaining batch groups, fusing the LAST
@@ -1539,3 +1634,167 @@ class LimitRelation(Relation):
             if remaining <= 0:
                 # stop before pulling (and parsing) another child batch
                 return
+
+
+def run_topk_megabatch(rels: list["SortRelation"]) -> float:
+    """ONE scan, N TopK queries: the serve plane's cross-query fused
+    pass for `ORDER BY ... LIMIT` shapes (the SortRelation twin of
+    serve's Aggregate megabatch).  Preconditions (serve._mega_key):
+    every relation shares ``rels[0].core`` (kernel-cache identity —
+    same key plans, so same compiled fold) over one table scan with NO
+    fused predicate, so the per-batch key operands upload ONCE and
+    every batch group folds ALL queries' states in one launch
+    (`_TopKCore.multi_group_jit`).  The tail group fuses with every
+    query's result merge (`multi_final_jit`) and the packed per-query
+    results pull as ONE blob transfer.  Each relation receives an
+    ``_injected_topk`` payload; its own `batches()` then skips the
+    scan and runs only the host payload gather.  Returns the demux
+    pull wall (seconds) for the caller's cost apportionment; launch
+    walls are measured by device_call under the caller's scope.
+
+    Raises on mid-scan ineligibility (a device-resident f64 key
+    column has no host bytes to image) — the caller falls back to
+    solo execution and pops any injections.
+    """
+    import time as _time
+
+    from datafusion_tpu.exec.batch import device_inputs, device_pull
+    from datafusion_tpu.exec.fused import (
+        fuse_group_max,
+        iter_groups,
+        pad_group,
+    )
+    from datafusion_tpu.obs.stats import iter_stats, op_timer
+
+    leader = rels[0]
+    core = leader.core
+    in_schema = leader.child.schema
+    device = leader.device
+    ks = tuple(r._kb for r in rels)
+    wide_f64 = core.wide and leader._key_plans[0].kind == "f"
+    states = None
+    dicts: list = [None] * len(in_schema)
+    rank_cache: dict = {}
+    fuse = fuse_group_max()
+    chunk: list = []
+    src_batches: list = []
+    bases: list[int] = []
+    next_base = 0
+
+    def groups_of(chunk):
+        entries = [(c[0], c[1], c[2], c[3], c[4], c[6]) for c in chunk]
+        shareds = [c[5] for c in chunk]
+        return entries, list(iter_groups(entries, shareds))
+
+    def flush():
+        nonlocal states
+        if not chunk:
+            return
+        entries, groups = groups_of(chunk)
+        with METRICS.timer("execute.sort"), op_timer(leader), \
+                _device_scope(device):
+            for idxs, ranks in groups:
+                group = pad_group(
+                    [entries[i] for i in idxs],
+                    lambda e: (e[0], e[1], e[2], np.int32(0), e[4], e[5]),
+                )
+                METRICS.add("fused.groups")
+                METRICS.add("fused.group_batches", len(idxs))
+                METRICS.add("serve.megabatch_launches")
+                METRICS.add("serve.megabatch_queries", len(rels))
+                METRICS.add("serve.megabatch_batches", len(idxs))
+                states = device_call(
+                    core.multi_group_jit, ks, states, tuple(group),
+                    ranks, _tag="topk.mega",
+                )
+        chunk.clear()
+
+    def final_flush():
+        # mirrors SortRelation._final_flush: the tail group's fold
+        # fuses with every query's result merge in one launch
+        entries, groups = groups_of(chunk)
+        with METRICS.timer("execute.sort"), op_timer(leader), \
+                _device_scope(device):
+            st = states
+            if not groups:
+                METRICS.add("serve.megabatch_launches")
+                METRICS.add("serve.megabatch_queries", len(rels))
+                return device_call(core.multi_final_jit, ks, st, (), (),
+                                   _tag="topk.mega.final")
+            for gi, (idxs, ranks) in enumerate(groups):
+                group = pad_group(
+                    [entries[i] for i in idxs],
+                    lambda e: (e[0], e[1], e[2], np.int32(0), e[4], e[5]),
+                )
+                METRICS.add("fused.groups")
+                METRICS.add("fused.group_batches", len(idxs))
+                METRICS.add("serve.megabatch_launches")
+                METRICS.add("serve.megabatch_queries", len(rels))
+                METRICS.add("serve.megabatch_batches", len(idxs))
+                if gi == len(groups) - 1:
+                    return device_call(
+                        core.multi_final_jit, ks, st, tuple(group),
+                        ranks, _tag="topk.mega.final",
+                    )
+                st = device_call(
+                    core.multi_group_jit, ks, st, tuple(group), ranks,
+                    _tag="topk.mega",
+                )
+
+    for batch in iter_stats(leader.child):
+        for i, d in enumerate(batch.dicts):
+            if d is not None:
+                dicts[i] = d
+        rank_tables = []
+        for kp in leader._key_plans:
+            if kp.kind != "str":
+                continue
+            d = batch.dicts[kp.index]
+            ranks = (
+                SortRelation._rank_table(d, rank_cache, kp.index)
+                if d is not None
+                else np.zeros(1, np.int32)
+            )
+            rank_tables.append(ranks)
+        img = None
+        if wide_f64:
+            img = leader._f64_image_input(batch, leader._key_plans[0])
+            if img is None:
+                raise NotSupportedError(
+                    "megabatch: device-resident f64 sort key"
+                )
+        if states is None:
+            states = tuple(
+                leader._topk_init(kb, in_schema, core) for kb in ks
+            )
+        with _device_scope(device):
+            data, validity, mask = device_inputs(
+                leader._key_view(batch, core), device, core.wire_hints
+            )
+        src_batches.append(batch)
+        bases.append(next_base)
+        chunk.append(
+            (data, validity, mask, np.int32(batch.num_rows),
+             np.int64(next_base), tuple(rank_tables), img)
+        )
+        next_base += batch.capacity
+        if len(chunk) >= fuse:
+            flush()
+    if states is None:
+        # empty scan: every query's result is all-dead — no device
+        # work at all, each injection carries an all -1 merge
+        pull_s = 0.0
+        packed_h = []
+        for kb in ks:
+            p = np.full(1 + kb, np.int64(-1))
+            p[0] = 0  # no collision
+            packed_h.append(p)
+    else:
+        packed = final_flush()
+        chunk.clear()
+        pull_t0 = _time.perf_counter()
+        packed_h = [np.asarray(p) for p in device_pull(tuple(packed))]
+        pull_s = _time.perf_counter() - pull_t0
+    for r, p in zip(rels, packed_h):
+        r._injected_topk = (p, src_batches, bases, dicts)
+    return pull_s

@@ -40,9 +40,12 @@ and the shared costs apportion back to it:
   stays general);
 - H2D bytes charged at the ledger seam (``note_h2d`` ->
   `charge_h2d`);
-- HBM pin byte-seconds accrued to the client whose query pinned the
-  table (`register_pin_client` + `accrue_pins`, read off the PR 9
-  ledger's pin table on every scrape);
+- HBM pin byte-seconds split across the clients whose queries
+  actually scanned the pin since the last accrual, proportionally to
+  per-pin use counts (`note_pin_use` + `accrue_pins`, read off the
+  PR 9 ledger's pin table on every scrape); an interval with no uses
+  falls back to the materializing client (`register_pin_client`) —
+  residency somebody holds but nobody reads is the holder's cost;
 - a hedge loser's duplicate wall charged to the hedging query's
   client (`charge_hedge_loss`, fed from the coordinator's abandoned
   attempt threads).
@@ -271,30 +274,51 @@ def charge_hedge_loss(scope, seconds: float) -> None:
 
 # -- HBM pin byte-seconds -----------------------------------------------
 # The ledger's pin table (obs/device.py) knows bytes and owner tag
-# (pin.<table>); THIS map knows which client's query pinned it.
-# Accrual is integral-of-residency: on every scrape, each registered
-# pin charges bytes x elapsed-since-last-accrual to its client.
-_PIN_CLIENTS: dict[str, str] = {}      # fingerprint -> client_id
+# (pin.<table>); THESE maps know who to bill.  Accrual is
+# integral-of-residency: on every scrape, each registered pin charges
+# bytes x elapsed-since-last-accrual, split across the clients whose
+# queries USED the pin in that interval proportionally to their use
+# counts — a hot shared table costs its readers, not whoever happened
+# to touch it first.  An interval with no uses bills the materializing
+# client: held-but-unread residency is the holder's cost.
+_PIN_CLIENTS: dict[str, str] = {}      # fingerprint -> materializer
 _PIN_ACCRUED_AT: dict[str, float] = {}  # fingerprint -> monotonic
+_PIN_USERS: dict[str, dict[str, float]] = {}  # fp -> {client: uses}
 
 
 def register_pin_client(fingerprint: str, client_id: str) -> None:
     """Attribute a pinned resident to the client whose query
-    materialized it (serve.Server._ensure_resident)."""
+    materialized it (serve.Server._ensure_resident) — the fallback
+    payer for intervals in which nobody scans the pin."""
     _PIN_CLIENTS[fingerprint] = str(client_id)
     _PIN_ACCRUED_AT[fingerprint] = time.monotonic()
+
+
+def note_pin_use(fingerprint: str, client_id: str) -> None:
+    """One query's scan of a pinned resident: bumps the client's use
+    count for the current accrual interval (dict get + float add —
+    lock-free, DF005; a racing pair may lose an increment, the statsd
+    trade)."""
+    users = _PIN_USERS.get(fingerprint)
+    if users is None:
+        users = _PIN_USERS.setdefault(fingerprint, {})
+    users[client_id] = users.get(client_id, 0.0) + 1.0
 
 
 def forget_pin(fingerprint: str) -> None:
     """Eviction hook: stop accruing for a dropped pin."""
     _PIN_CLIENTS.pop(fingerprint, None)
     _PIN_ACCRUED_AT.pop(fingerprint, None)
+    _PIN_USERS.pop(fingerprint, None)
 
 
 def accrue_pins(now: Optional[float] = None) -> None:
     """Charge pin byte-seconds accrued since the last accrual (called
     from scrape paths — `refresh_tenant_gauges`, `/debug/tenants`).
-    Pins that left the ledger stop accruing and are pruned."""
+    The interval's cost splits across its recorded users by use count
+    (counts reset per interval — each accrual window bills the clients
+    active IN it); no users = the materializer pays.  Pins that left
+    the ledger stop accruing and are pruned."""
     from datafusion_tpu.obs.device import LEDGER
 
     now = time.monotonic() if now is None else now
@@ -307,9 +331,22 @@ def accrue_pins(now: Optional[float] = None) -> None:
         last = _PIN_ACCRUED_AT.get(fp, now)
         dt = max(now - last, 0.0)
         _PIN_ACCRUED_AT[fp] = now
-        if dt > 0:
-            METER.charge(_PIN_CLIENTS[fp], "pin_byte_seconds",
-                         float(info.get("bytes", 0)) * dt)
+        if dt <= 0:
+            continue
+        cost = float(info.get("bytes", 0)) * dt
+        users = _PIN_USERS.get(fp)
+        counts = dict(users) if users else None
+        if users:
+            # window reset; a use recorded between the copy and the
+            # clear slides into the next interval's split (statsd
+            # trade, never lost from the totals)
+            users.clear()
+        total = sum(counts.values()) if counts else 0.0
+        if counts and total > 0:
+            for cid, n in counts.items():
+                METER.charge(cid, "pin_byte_seconds", cost * (n / total))
+        else:
+            METER.charge(_PIN_CLIENTS[fp], "pin_byte_seconds", cost)
 
 
 # -- the tail explainer -------------------------------------------------
@@ -670,6 +707,7 @@ def reset_for_tests() -> None:
     EXPLAINER.clear()
     _PIN_CLIENTS.clear()
     _PIN_ACCRUED_AT.clear()
+    _PIN_USERS.clear()
     _metrics.CLIENT_SCOPES.clear()
 
 

@@ -266,6 +266,8 @@ GET /debug/flights[?trace_id=]  flight-recorder ring dump (JSON)
 GET /debug/hbm                HBM residency ledger breakdown (JSON)
 GET /debug/serve              serving front door: admission counters,
                               pinned tables, megabatch stats (JSON)
+GET /debug/ingest             streaming ingest: appendable tables,
+                              materialized views, freshness lags (JSON)
 GET /debug/tenants            per-client metering: device-seconds,
                               H2D bytes, pin byte-seconds, hedge
                               duplicates + conservation check (JSON)
@@ -385,6 +387,10 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
                 "p99_s": h.quantile(0.99),
             },
         })
+    if path == "/debug/ingest":
+        from datafusion_tpu import ingest
+
+        return _json_body({"node": srv.label, **ingest.debug_snapshot()})
     if path == "/debug/tenants":
         from datafusion_tpu.obs import attribution
 

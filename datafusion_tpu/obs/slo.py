@@ -23,6 +23,7 @@ Declaration is env-driven so fleets configure it without code:
     DATAFUSION_TPU_SLO_INGEST_P50=2.0
     DATAFUSION_TPU_SLO_ERROR_RATE=0.01       # allowed failure fraction
     DATAFUSION_TPU_SLO_PRESSURE_HBM_FRAC=0.8 # allowed live-HBM fraction
+    DATAFUSION_TPU_SLO_Q1_VIEW_FRESHNESS_S=5 # allowed view staleness (s)
     DATAFUSION_TPU_SLO_WINDOW_S=300          # sliding window (default)
     DATAFUSION_TPU_SLO_MIN_SAMPLES=20        # breach quorum (default)
 
@@ -37,6 +38,13 @@ evaluation.  Device capacity comes from ``DATAFUSION_TPU_HBM_BYTES``
 or, when the backend exposes it, ``Device.memory_stats()``; with
 neither available the objective stays dormant (burn 0) instead of
 guessing.
+
+``freshness_s`` is the ingest plane's gauge-style objective: the
+measured materialized-view staleness (seconds since the oldest
+unfolded append, `datafusion_tpu.ingest.freshness_lags`) over the
+allowed lag.  An objective whose name matches a view's name reads
+that view's lag; any other name reads the worst lag across the
+process's views.  No live views = dormant, never a guess.
 """
 
 from __future__ import annotations
@@ -55,15 +63,18 @@ _QUANTILES = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
 class Objective:
     """One declared objective.  ``kind`` is ``p50``/``p95``/``p99``
     (``threshold`` = latency seconds at that quantile), ``error_rate``
-    (``threshold`` = allowed failure fraction), or ``hbm_frac``
+    (``threshold`` = allowed failure fraction), ``hbm_frac``
     (``threshold`` = allowed live-HBM fraction of device capacity,
-    measured by the residency ledger)."""
+    measured by the residency ledger), or ``freshness_s``
+    (``threshold`` = allowed materialized-view staleness in seconds;
+    the name selects one view, or the process-wide worst lag)."""
 
     __slots__ = ("name", "kind", "threshold", "window_s")
 
     def __init__(self, name: str, kind: str, threshold: float,
                  window_s: Optional[float] = None):
-        if kind not in (*_QUANTILES, "error_rate", "hbm_frac"):
+        if kind not in (*_QUANTILES, "error_rate", "hbm_frac",
+                        "freshness_s"):
             raise ValueError(f"unknown SLO kind {kind!r}")
         if threshold <= 0:
             raise ValueError(f"SLO threshold must be positive: {threshold}")
@@ -150,10 +161,37 @@ class SloWatchdog:
             "breached": bool(cap) and burn >= 1.0,
         }
 
+    def _freshness_burn(self, obj: Objective) -> dict:
+        """Ingest-freshness burn: a view's measured staleness (seconds
+        since its oldest unfolded append) over the allowance, read
+        fresh from the live views.  The objective's name selects one
+        view when it matches; otherwise the process-wide worst lag.
+        No live views (or no matching one) = dormant — a fleet-wide
+        objective must not page on processes that serve no views."""
+        from datafusion_tpu import ingest
+
+        lags = ingest.freshness_lags()
+        value = lags.get(obj.name) if obj.name in lags else (
+            max(lags.values()) if lags else None
+        )
+        burn = (value / obj.threshold) if value is not None else 0.0
+        return {
+            "name": obj.name,
+            "kind": obj.kind,
+            "target": obj.threshold,
+            "samples": 1 if value is not None else 0,
+            "value": round(value, 6) if value is not None else 0.0,
+            "burn_rate": round(burn, 4),
+            # gauge objective: the reading is exact, no sample quorum
+            "breached": value is not None and burn >= 1.0,
+        }
+
     def _burn(self, obj: Objective,
               samples: list[tuple[float, float, bool]]) -> dict:
         if obj.kind == "hbm_frac":
             return self._hbm_burn(obj)
+        if obj.kind == "freshness_s":
+            return self._freshness_burn(obj)
         n = len(samples)
         if obj.kind == "error_rate":
             bad = sum(1 for _, _, err in samples if err)
@@ -247,7 +285,8 @@ def objectives_from_env(environ=None) -> list[Objective]:
         name = None
         for tail, k in (("_P50", "p50"), ("_P95", "p95"), ("_P99", "p99"),
                         ("_ERROR_RATE", "error_rate"),
-                        ("_HBM_FRAC", "hbm_frac")):
+                        ("_HBM_FRAC", "hbm_frac"),
+                        ("_FRESHNESS_S", "freshness_s")):
             if suffix.endswith(tail):
                 kind, name = k, suffix[: -len(tail)].lower()
                 break

@@ -144,6 +144,27 @@ class WorkerState:
         # advertised in the cluster lease so `datafusion-tpu
         # debug-bundle --cluster` can pull this worker's bundle
         self.debug_port: Optional[int] = None
+        # streaming-ingest seam (ingest/__init__.py): a process
+        # embedding this worker next to a long-lived ExecutionContext
+        # attaches that context's IngestContext here, and the wire
+        # grows an `append` request.  None on plain fragment workers —
+        # their per-fragment contexts have no tables to append to.
+        self.ingest_ctx = None
+
+    def append(self, table: str, columns: dict,
+               client: Optional[str] = None) -> dict:
+        """Wire append: durable-then-applied on the attached ingest
+        context.  The `wal_unavailable` contract crosses the wire
+        intact — IngestUnavailableError is a TransientError, so the
+        error reply below tells the coordinator to retry, and the
+        log's revision dedup absorbs the replay."""
+        if self.ingest_ctx is None:
+            from datafusion_tpu.errors import IngestUnavailableError
+
+            raise IngestUnavailableError(
+                "ingest not enabled on this worker")
+        ack = self.ingest_ctx.append(table, columns, client=client or None)
+        return {"type": "append_ack", **ack}
 
     def _gauges(self) -> dict:
         """Point-in-time gauges for the Prometheus rendering: span
@@ -441,6 +462,10 @@ def _serve_worker_request(state: WorkerState, msg: dict):
         elif kind == "execute_plan":
             with adoption, deadline_scope(deadline):
                 out = state.execute_plan(msg["fragment"], bw)
+        elif kind == "append":
+            with adoption, deadline_scope(deadline):
+                out = state.append(msg["table"], msg["columns"],
+                                   msg.get("client"))
         else:
             out = {"type": "error", "message": f"unknown request {kind!r}"}
     except faults.InjectedConnectionAbort:

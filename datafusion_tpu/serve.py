@@ -194,8 +194,37 @@ class PinnedSource(DataSource):
     def to_meta(self) -> dict:
         return self.inner.to_meta()
 
+    @property
+    def data_version(self) -> Optional[int]:
+        # appendable inners version per delta; fingerprints fold it in
+        # (exec/context.py query_fingerprint reads the REGISTERED source)
+        return getattr(self.inner, "data_version", None)
+
     def with_projection(self, projection) -> "DataSource":
         return _PinnedProjection(self, list(projection))
+
+    def splice_appendable(self, cls):
+        """Splice a streaming-appendable source (`cls` is
+        ingest.AppendableSource) in UNDER this pin: the appendable
+        materializes from the current batches (the SAME objects when
+        resident, so their device copies survive), and the pin's
+        resident list becomes the appendable's LIVE batch list — every
+        later append grows the pinned copy in place, with no divergent
+        re-materialization.  Idempotent; called by
+        `IngestContext._wrap_source` on first attach."""
+        with self._lock:
+            if isinstance(self.inner, cls):
+                return self.inner
+        # materializing may scan a file-backed inner: outside the lock,
+        # same discipline as ensure()
+        src = cls.wrap(self, name=self.name)
+        with self._lock:
+            if isinstance(self.inner, cls):
+                return self.inner
+            self.inner = src
+            if self._resident is not None:
+                self._resident = src._batches
+        return src
 
     def estimated_bytes(self) -> int:
         """Admission-time residency estimate: resident size when
@@ -223,8 +252,15 @@ class PinnedSource(DataSource):
                 return True
         # the scan runs OUTSIDE the lock (file-backed tables block on
         # IO); a racing ensure may scan too — last writer loses, both
-        # results are equivalent
-        batches = list(self.inner.batches())
+        # results are equivalent.  An in-memory appendable inner pins
+        # its LIVE batch list (not a snapshot copy) so streaming
+        # appends keep growing the resident copy in place.
+        live = getattr(self.inner, "_batches", None)
+        if live is not None and getattr(self.inner, "reusable_batches",
+                                        False):
+            batches = live
+        else:
+            batches = list(self.inner.batches())
         with self._lock:
             if self._resident is None:
                 self._resident = batches
@@ -272,7 +308,10 @@ class PinnedSource(DataSource):
     def batches(self):
         res = self._resident
         if res is not None:
-            return iter(res)
+            # snapshot: the resident list may be an appendable source's
+            # live list — a concurrent append must not extend a scan
+            # that already started (consistent-cut reads)
+            return iter(list(res))
         return self.inner.batches()
 
     def shared_state_for(self, core) -> dict:
@@ -498,6 +537,20 @@ class Server:
             t = Ticket(sql, None, None, None, client_id=client)
             t._fulfill(out)
             return t
+        if isinstance(stmt, ast.SqlCreateMaterializedView):
+            # also DDL-shaped, but the initial build folds the table's
+            # current batches through the view core — charge that
+            # launch to the registering client like any other work
+            from datafusion_tpu.exec.context import DdlResult
+            from datafusion_tpu.obs.attribution import client_scope
+
+            with client_scope(client):
+                view = self.ingest().create_view(stmt.name, stmt.query_sql)
+            t = Ticket(sql, None, None, None, client_id=client)
+            t._fulfill(DdlResult(
+                f"Registered materialized view {stmt.name} "
+                f"({'incremental' if view.incremental else 'recompute'})"))
+            return t
         if isinstance(stmt, ast.SqlExplain):
             raise NotSupportedError(
                 "EXPLAIN is an interactive statement; run it on the "
@@ -559,6 +612,49 @@ class Server:
                         client=client)
         self._loop.call_soon(partial(self._enqueue, ticket))
         return ticket
+
+    # -- streaming ingestion (caller thread) ---------------------------
+    def ingest(self):
+        """The ingest plane behind this server (lazy): the context's
+        `IngestContext` with the serving hook installed — applied
+        appends grow the HBM-pinned resident copy's ledger accounting
+        and re-save the pin manifest."""
+        ing = self.ctx.ingest()
+        if self._on_append_applied not in ing.on_applied:
+            ing.on_applied.append(self._on_append_applied)
+        return ing
+
+    def append(self, table: str, columns: dict,
+               client_id: Optional[str] = None) -> dict:
+        """Streaming append through the front door — durable-then-
+        applied (`IngestContext.append` contract: a WAL fault raises
+        `IngestUnavailableError` with nothing acknowledged).  View-
+        maintenance launches this delta triggers are charged to
+        ``client_id`` through the metering scope, exactly like query
+        launches."""
+        from datafusion_tpu.obs.attribution import client_scope
+
+        client = str(client_id) if client_id else "default"
+        with client_scope(client):
+            return self.ingest().append(table, columns, client=client)
+
+    def _on_append_applied(self, table: str, batch) -> None:
+        """Post-apply ingest hook: the pinned resident list already
+        grew in place (it IS the appendable's live batch list after
+        `splice_appendable`), so only the ledger's pin accounting and
+        the durable manifest need refreshing."""
+        ds = self.ctx.datasources.get(table)
+        if isinstance(ds, _PinnedProjection):
+            ds = ds.parent
+        if not isinstance(ds, PinnedSource) or not ds.resident:
+            return
+        res = ds._resident
+        if res is not None:
+            LEDGER.set_pin_bytes(ds.fingerprint, _host_bytes(res))
+        METRICS.add("serve.pin_appends")
+        cb = ds.on_change
+        if cb is not None:
+            cb()
 
     def _shed_submit(self, sql: str, reason: str,
                      client: str = "default") -> QueryShedError:
@@ -695,33 +791,92 @@ class Server:
         from datafusion_tpu.exec.kernels import parameterize_exprs
         from datafusion_tpu.plan.logical import (
             Aggregate,
+            Limit,
+            Projection,
             Selection,
+            Sort,
             TableScan,
         )
 
-        if not isinstance(plan, Aggregate):
-            return None
-        inner = plan.input
-        pred = None
-        if isinstance(inner, Selection):
-            pred, inner = inner.expr, inner.input
-        if not isinstance(inner, TableScan):
-            return None
-        try:
-            exprs = ([pred] if pred is not None else []) + list(
-                plan.aggr_expr
+        if isinstance(plan, Aggregate):
+            inner = plan.input
+            pred = None
+            if isinstance(inner, Selection):
+                pred, inner = inner.expr, inner.input
+            if not isinstance(inner, TableScan):
+                return None
+            try:
+                exprs = ([pred] if pred is not None else []) + list(
+                    plan.aggr_expr
+                )
+                fps, _, _ = parameterize_exprs(exprs)
+            except Exception:  # noqa: BLE001 — unparameterizable plan: solo lane
+                return None
+            proj = (None if inner.projection is None
+                    else tuple(inner.projection))
+            return (
+                "agg", inner.table_name,
+                self.ctx.catalog_version(inner.table_name), proj,
+                tuple(repr(g) for g in plan.group_expr), tuple(fps),
+                pred is None,
             )
-            fps, _, _ = parameterize_exprs(exprs)
-        except Exception:  # noqa: BLE001 — unparameterizable plan: solo lane
-            return None
-        proj = (None if inner.projection is None
-                else tuple(inner.projection))
-        return (
-            "agg", inner.table_name,
-            self.ctx.catalog_version(inner.table_name), proj,
-            tuple(repr(g) for g in plan.group_expr), tuple(fps),
-            pred is None,
-        )
+        if isinstance(plan, Limit) and isinstance(plan.input, Sort):
+            # ORDER BY ... LIMIT k shape class: the streaming TopK fold
+            # megabatches when queries share key plans over one table
+            # with no predicate (a per-query predicate would fork the
+            # shared fold's mask operand per query).  LIMIT values may
+            # differ — the multi-query fold takes a per-query capacity.
+            from datafusion_tpu.exec.sort import TOPK_MAX
+
+            if not (0 < plan.limit <= TOPK_MAX):
+                return None
+            sort = plan.input
+            inner = sort.input
+            proj_fps = None
+            if isinstance(inner, Projection):
+                try:
+                    proj_fps, _, _ = parameterize_exprs(list(inner.expr))
+                except Exception:  # noqa: BLE001 — unparameterizable plan: solo lane
+                    return None
+                proj_fps, inner = tuple(proj_fps), inner.input
+            if not isinstance(inner, TableScan):
+                return None
+            scan_proj = (None if inner.projection is None
+                         else tuple(inner.projection))
+            return (
+                "topk", inner.table_name,
+                self.ctx.catalog_version(inner.table_name), scan_proj,
+                proj_fps,
+                tuple((repr(se.expr), se.asc) for se in sort.expr),
+            )
+        if isinstance(plan, (Projection, Selection)):
+            # filter/project shape class: per-query literals ride the
+            # shared pipeline core's parameter slots, so `WHERE x > ?`
+            # variants share one scan and one launch per batch group
+            inner = plan
+            proj_exprs = None
+            if isinstance(inner, Projection):
+                proj_exprs, inner = inner.expr, inner.input
+            pred = None
+            if isinstance(inner, Selection):
+                pred, inner = inner.expr, inner.input
+            if not isinstance(inner, TableScan):
+                return None
+            try:
+                exprs = ([pred] if pred is not None else []) + list(
+                    proj_exprs or []
+                )
+                fps, _, _ = parameterize_exprs(exprs)
+            except Exception:  # noqa: BLE001 — unparameterizable plan: solo lane
+                return None
+            scan_proj = (None if inner.projection is None
+                         else tuple(inner.projection))
+            return (
+                "pipe", inner.table_name,
+                self.ctx.catalog_version(inner.table_name), scan_proj,
+                tuple(fps), pred is None, proj_exprs is None,
+            )
+        return None
 
     # -- execution (executor threads) ----------------------------------
     def _run_group(self, group: list[Ticket]) -> None:
@@ -796,6 +951,8 @@ class Server:
                     METRICS.add("serve.megabatch_fallbacks")
                     for t in sub:
                         t._rel.__dict__.pop("_injected_state", None)
+                        t._rel.__dict__.pop("_injected_topk", None)
+                        t._rel.__dict__.pop("_injected_batches", None)
                 rest.extend(sub)
             rest.extend(ts)
         # per-ticket materialization fans back out over the executor
@@ -814,10 +971,36 @@ class Server:
         the predicate in the core (no per-query host masks)."""
         from datafusion_tpu.exec import fused
         from datafusion_tpu.exec.aggregate import AggregateRelation
-        from datafusion_tpu.exec.relation import DataSourceRelation
+        from datafusion_tpu.exec.relation import (
+            DataSourceRelation,
+            PipelineRelation,
+        )
+        from datafusion_tpu.exec.sort import TOPK_MAX, SortRelation
 
         if self._megabatch_max < 2 or not fused.fusion_enabled():
             return None
+        if type(rel) is SortRelation:
+            # streaming TopK lane: no fused predicate (the shared fold
+            # has ONE mask operand per batch), LIMIT within the TopK
+            # window, straight over the scan.  Wide-path eligibility
+            # (host-imaged f64 keys) is per-batch — the runner raises
+            # mid-scan and the group falls back to solo.
+            if rel.predicate is not None:
+                return None
+            if rel.limit is None or not (0 < rel.limit <= TOPK_MAX):
+                return None
+            if not isinstance(rel.child, DataSourceRelation):
+                return None
+            return ("topk", id(rel.core), rel.child.table_name)
+        if type(rel) is PipelineRelation:
+            # filter/project lane: the predicate must live in the core
+            # (per-query literals in params — no per-query host masks)
+            # and there must BE device work to share
+            if rel._host_pred_expr is not None or not rel.core.needs_kernel:
+                return None
+            if not isinstance(rel.child, DataSourceRelation):
+                return None
+            return ("pipe", id(rel.core), rel.child.table_name)
         if type(rel) is not AggregateRelation:
             return None
         if rel._host_pred_expr is not None:
@@ -867,11 +1050,16 @@ class Server:
             iter_groups,
             pad_group,
         )
-        from datafusion_tpu.exec.relation import device_scope
+        from datafusion_tpu.exec.relation import PipelineRelation, device_scope
+        from datafusion_tpu.exec.sort import SortRelation
         from datafusion_tpu.obs.attribution import shared_scope
         from datafusion_tpu.obs.stats import iter_stats
         from datafusion_tpu.utils.retry import device_call
 
+        if type(tickets[0]._rel) is SortRelation:
+            return self._run_megabatch_topk(tickets)
+        if type(tickets[0]._rel) is PipelineRelation:
+            return self._run_megabatch_pipeline(tickets)
         rels = [t._rel for t in tickets]
         weight = 1.0 / len(tickets)
         members = tuple((t.client_id, weight) for t in tickets)
@@ -985,6 +1173,43 @@ class Server:
                 r._key_dicts.update(leader._key_dicts)
                 r._str_dicts.update(leader._str_dicts)
             r._injected_state = s
+
+    def _run_megabatch_topk(self, tickets: list[Ticket]) -> None:
+        """ONE scan, N TopK queries (`exec.sort.run_topk_megabatch` —
+        the `_run_megabatch` twin for ORDER BY ... LIMIT shapes).
+        Cost apportionment matches the aggregate lane: the pass runs
+        under a shared scope with even weights, launch walls split by
+        device_call's own measurement, and the single blob-packed
+        result pull splits as each ticket's demux share.  Each
+        relation receives ``_injected_topk``; its `batches()` then
+        skips the scan and runs only the host payload gather."""
+        from datafusion_tpu.exec.sort import run_topk_megabatch
+        from datafusion_tpu.obs.attribution import shared_scope
+
+        weight = 1.0 / len(tickets)
+        members = tuple((t.client_id, weight) for t in tickets)
+        with shared_scope(members) as launch_acc:
+            pull_s = run_topk_megabatch([t._rel for t in tickets])
+        for t in tickets:
+            t.launch_share_s += launch_acc[0] * weight
+            t.demux_share_s += pull_s * weight
+
+    def _run_megabatch_pipeline(self, tickets: list[Ticket]) -> None:
+        """ONE scan, N filter/project queries
+        (`exec.relation.run_pipeline_megabatch`): per-query literals
+        ride the shared core's parameter slots, so `WHERE x > ?`
+        variants share every upload and every launch.  The demux is
+        per-query finalize-time pulls (attributed per client there),
+        so only launch walls apportion here."""
+        from datafusion_tpu.exec.relation import run_pipeline_megabatch
+        from datafusion_tpu.obs.attribution import shared_scope
+
+        weight = 1.0 / len(tickets)
+        members = tuple((t.client_id, weight) for t in tickets)
+        with shared_scope(members) as launch_acc:
+            run_pipeline_megabatch([t._rel for t in tickets])
+        for t in tickets:
+            t.launch_share_s += launch_acc[0] * weight
 
     def _finish(self, t: Ticket) -> None:
         """Materialize one ticket's relation and fulfill it (the
@@ -1108,14 +1333,20 @@ class Server:
                 METRICS.add("serve.pin_denied")
                 return
         ds.ensure()
-        if newly_resident:
-            # pin byte-seconds accrue to the client whose query
-            # materialized the resident (obs/attribution.py) — a pin
-            # that outlives its creator keeps charging them: residency
-            # is a cost somebody holds, not a one-time event
-            from datafusion_tpu.obs.attribution import register_pin_client
+        from datafusion_tpu.obs.attribution import (
+            note_pin_use,
+            register_pin_client,
+        )
 
+        if newly_resident:
+            # the materializing client is the pin's FALLBACK payer
+            # (obs/attribution.py): intervals in which nobody scans the
+            # resident still cost somebody — residency is a held cost,
+            # not a one-time event
             register_pin_client(ds.fingerprint, client_id)
+        # every scan is a use: accrual splits the pin's byte-seconds
+        # across the interval's actual readers by these counts
+        note_pin_use(ds.fingerprint, client_id)
         # re-attribute the resident batches' cached device copies (and
         # measure them) under the pin's owner tag
         self._retag_pin(ds)

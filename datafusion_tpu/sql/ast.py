@@ -166,6 +166,19 @@ class SqlCreateExternalTable(SqlNode):
 
 
 @dataclass
+class SqlCreateMaterializedView(SqlNode):
+    """CREATE MATERIALIZED VIEW name AS <select> — engine extension
+    (the ingest subsystem's registered continuous query; the reference
+    has no view support at all).  `query` is the defining SELECT; the
+    original SELECT text rides along so the view definition can be
+    WAL-logged and re-planned verbatim on crash recovery."""
+
+    name: str
+    query: SqlSelect
+    query_sql: str = ""
+
+
+@dataclass
 class SqlExplain(SqlNode):
     """EXPLAIN [ANALYZE|VERIFY] stmt — engine extension (the reference
     only println!s the plan on every execute, `context.rs:104`).  With

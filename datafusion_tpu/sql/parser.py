@@ -29,6 +29,10 @@ from datafusion_tpu.sql.tokenizer import EOF, NUMBER, OP, STRING, WORD, Token, t
 
 _EXPLAIN_ANALYZE = re.compile(r"\s*EXPLAIN\s+ANALYZE\b", re.IGNORECASE)
 _EXPLAIN_VERIFY = re.compile(r"\s*EXPLAIN\s+VERIFY\b", re.IGNORECASE)
+_CREATE_MVIEW = re.compile(
+    r"\s*CREATE\s+MATERIALIZED\s+VIEW\s+([A-Za-z_][A-Za-z0-9_]*)\s+AS\b",
+    re.IGNORECASE,
+)
 
 # precedence table (higher binds tighter)
 _PREC_OR = 5
@@ -120,6 +124,8 @@ class Parser:
     def parse_statement(self) -> ast.SqlNode:
         if self.parse_keywords("CREATE", "EXTERNAL", "TABLE"):
             return self._parse_create_external_table()
+        if self.parse_keywords("CREATE", "MATERIALIZED", "VIEW"):
+            return self._parse_create_materialized_view()
         if self.parse_keyword("EXPLAIN"):
             analyze = self.parse_keyword("ANALYZE")
             verify = False if analyze else self.parse_keyword("VERIFY")
@@ -172,6 +178,19 @@ class Parser:
         if t.kind != EOF:
             raise ParserError(f"Unexpected trailing token {t} in {self.sql!r}")
         return sel
+
+    def _parse_create_materialized_view(self) -> ast.SqlCreateMaterializedView:
+        name = self.expect_identifier()
+        self.expect_keyword("AS")
+        # the defining query's own text (everything after AS) rides on
+        # the node so the view definition can be logged and re-planned
+        # verbatim on recovery
+        query_start = self.peek().pos if self.peek().kind != EOF else len(self.sql)
+        self.expect_keyword("SELECT")
+        query = self._parse_select()
+        return ast.SqlCreateMaterializedView(
+            name, query, self.sql[query_start:].strip().rstrip(";")
+        )
 
     def _parse_create_external_table(self) -> ast.SqlCreateExternalTable:
         name = self.expect_identifier()
@@ -354,6 +373,19 @@ def parse_sql(sql: str) -> ast.SqlNode:
     m = _EXPLAIN_VERIFY.match(sql)
     if m:
         return ast.SqlExplain(parse_sql(sql[m.end():]), verify=True)
+    # CREATE MATERIALIZED VIEW is a Python-side extension too (the
+    # ingest subsystem's continuous queries): strip the prefix here and
+    # parse the defining SELECT through whichever front-end is active,
+    # keeping the verbatim query text for WAL logging and recovery
+    # re-planning
+    m = _CREATE_MVIEW.match(sql)
+    if m:
+        query_sql = sql[m.end():].strip().rstrip(";")
+        query = parse_sql(query_sql)
+        if not isinstance(query, ast.SqlSelect):
+            raise ParserError(
+                "CREATE MATERIALIZED VIEW requires AS SELECT ...")
+        return ast.SqlCreateMaterializedView(m.group(1), query, query_sql)
     node = native_parse_sql(sql)
     if node is not None:
         return node

@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Streaming-ingest smoketest: the append durability headline
+(datafusion_tpu/ingest), proven the crash-only way — `kill -9` an
+appender process mid-stream and recover its ingest log from disk.
+
+1. an appender OS process registers a CSV table, enables the ingest
+   WAL, creates a materialized view, and appends in a tight loop,
+   printing one `acked <rev> <i>` line AFTER each acknowledged append;
+2. the parent SIGKILLs it mid-append — no shutdown hooks, no flush —
+   then replays the log in-process: every acked append must be
+   present, the revision counter must continue, and the recovered
+   view must be EXACTLY a batch rescan of its defining query;
+3. disk-fault soak: the same leg under 30% seeded `wal.fsync` faults
+   (ENOSPC-style).  Appends the appender acked must all survive;
+   failed ones raise `wal_unavailable` and simply aren't acked;
+4. live subscription: a subscriber parks on the view revision while
+   appends land; every wake must carry a strictly increasing revision
+   and the view must drain back to freshness-lag zero.
+
+Exit non-zero on any lost acked append.  `scripts/smoketest.sh` runs
+this after the crash smoke; CI wires it as the `ingest-smoke` job.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("DATAFUSION_TPU_RETRY_BASE_S", "0.01")
+
+VIEW_SQL = "SELECT g, SUM(v), COUNT(1) FROM t GROUP BY g"
+
+
+def _write_csv(tmpdir: str, rows: int = 2000) -> str:
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    path = os.path.join(tmpdir, "t.csv")
+    with open(path, "w") as f:
+        f.write("g,v,w\n")
+        for _ in range(rows):
+            f.write(f"g{int(rng.integers(0, 5))},"
+                    f"{int(rng.integers(0, 1000))},"
+                    f"{rng.random():.6f}\n")
+    return path
+
+
+def _make_ctx(csv_path: str):
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+    from datafusion_tpu.exec.context import ExecutionContext
+
+    schema = Schema([
+        Field("g", DataType.UTF8, False),
+        Field("v", DataType.INT64, False),
+        Field("w", DataType.FLOAT64, False),
+    ])
+    ctx = ExecutionContext(result_cache=False)
+    ctx.register_csv("t", csv_path, schema)
+    return ctx
+
+
+def appender_main(csv_path: str, wal_dir: str) -> None:
+    """The child: append forever, ack to stdout.  Appends land in a
+    distinct group ('k') so the parent can audit them by value.  A
+    `wal_unavailable` ack failure is printed as `nacked` — the parent
+    owes nothing for it."""
+    from datafusion_tpu.errors import IngestUnavailableError
+
+    ctx = _make_ctx(csv_path)
+    ing = ctx.ingest(wal_dir=wal_dir)
+    ing.create_view("mv", VIEW_SQL)
+    print("ready", flush=True)
+    i = 0
+    while True:
+        try:
+            ack = ing.append(
+                "t", {"g": ["k"], "v": [i], "w": [float(i)]},
+                client="smoke")
+            print(f"acked {ack['rev']} {i}", flush=True)
+        except IngestUnavailableError:
+            print(f"nacked {i}", flush=True)
+        i += 1
+
+
+def _run_crash_leg(csv_path: str, tmpdir: str, leg: str,
+                   fault_plan=None) -> None:
+    wal_dir = os.path.join(tmpdir, f"ingest-wal-{leg}")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if fault_plan is not None:
+        env["DATAFUSION_TPU_FAULTS"] = json.dumps(fault_plan)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "appender",
+         csv_path, wal_dir],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True,
+    )
+    acked: dict[int, int] = {}  # i -> rev
+    nacked = 0
+    deadline = time.monotonic() + 120
+    line = proc.stdout.readline()
+    assert "ready" in line, f"appender never came up: {line!r}"
+    while len(acked) < 25:
+        line = proc.stdout.readline()
+        if not line:
+            raise AssertionError("appender died before the kill")
+        if line.startswith("acked"):
+            _, rev, i = line.split()
+            acked[int(i)] = int(rev)
+        elif line.startswith("nacked"):
+            nacked += 1
+        if time.monotonic() > deadline:
+            raise AssertionError(
+                f"workload too thin: {len(acked)} acked, {nacked} nacked")
+    # the correlated crash: no shutdown hook ever runs
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=10)
+    print(f"[{leg}] kill -9 with {len(acked)} acked appends "
+          f"({nacked} wal_unavailable nacks) in flight", flush=True)
+
+    # recover from disk in-process (a "restarted" server)
+    ctx = _make_ctx(csv_path)
+    ing = ctx.ingest(wal_dir=wal_dir)
+    rec = ing.recover()
+    print(f"[{leg}] recovered: {rec.get('appends_replayed')} appends, "
+          f"{rec.get('views_recovered')} views, "
+          f"torn_tails={rec.get('torn_tails')}", flush=True)
+    # 1. EVERY acked append is present (durable-then-acked); appends
+    #    that were logged but died before the ack line may also appear
+    #    — durability is a superset of the ack stream, never a subset
+    got = {int(r[0]) for r in ctx.sql_collect(
+        "SELECT v FROM t WHERE g = 'k'").to_rows()}
+    lost = sorted(set(acked) - got)
+    assert not lost, f"[{leg}] LOST acked appends: {lost[:10]}"
+    assert rec.get("appends_replayed", 0) >= len(acked)
+    # 2. the revision counter continues — never resets under a replay
+    assert ing.status()["rev"] >= max(acked.values())
+    ack = ing.append("t", {"g": ["k"], "v": [10**6], "w": [0.0]})
+    assert ack["rev"] > max(acked.values())
+    # 3. the recovered view is exactly a batch rescan
+    want = sorted(ctx.sql_collect(VIEW_SQL).to_rows())
+    got_view = sorted(ing.read_view("mv").to_rows())
+    assert got_view == want, f"[{leg}] recovered view diverges"
+    ing.close()
+    print(f"[{leg}] every acked append recovered; view exact "
+          f"({len(got)} appended rows on disk)", flush=True)
+
+
+def _run_subscriber_leg(csv_path: str) -> None:
+    from datafusion_tpu import ingest as ingest_mod
+
+    ctx = _make_ctx(csv_path)
+    ing = ctx.ingest()
+    ing.create_view("mv", VIEW_SQL)
+    wakes: list[int] = []
+    stop = threading.Event()
+
+    def subscriber():
+        rev = ing.view("mv").revision
+        while not stop.is_set():
+            got = ing.wait_for("mv", rev, timeout=0.2)
+            if got is None:
+                continue
+            assert got > rev, f"wake went backwards: {got} <= {rev}"
+            wakes.append(got)
+            rev = got
+
+    th = threading.Thread(target=subscriber)
+    th.start()
+    for i in range(30):
+        ing.append("t", {"g": ["s"], "v": [i], "w": [0.0]})
+        time.sleep(0.002)
+    final_rev = ing.view("mv").revision
+    deadline = time.monotonic() + 30
+    while not (wakes and wakes[-1] >= final_rev):
+        assert time.monotonic() < deadline, "subscriber never caught up"
+        time.sleep(0.01)
+    stop.set()
+    th.join(timeout=10)
+    assert wakes == sorted(wakes), "wake revisions must be monotonic"
+    assert wakes, "subscriber never woke"
+    lag = ingest_mod.freshness_lags().get("mv")
+    assert lag == 0.0, f"view still stale after drain: lag {lag}"
+    assert sorted(ing.read_view("mv").to_rows()) == \
+        sorted(ctx.sql_collect(VIEW_SQL).to_rows())
+    print(f"[subscribe] {len(wakes)} monotonic wakes, drained to lag 0, "
+          "view exact", flush=True)
+
+
+def main() -> int:
+    tmpdir = tempfile.mkdtemp(prefix="dftpu_ingest_smoke_")
+    csv_path = _write_csv(tmpdir)
+    _run_crash_leg(csv_path, tmpdir, "crash")
+    _run_crash_leg(csv_path, tmpdir, "faults", fault_plan={"rules": [
+        {"site": "wal.fsync", "op": "raise", "exc": "OSError",
+         "message": "injected ENOSPC", "p": 0.3},
+    ]})
+    _run_subscriber_leg(csv_path)
+    print("INGEST SMOKE OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "appender":
+        appender_main(sys.argv[2], sys.argv[3])
+    else:
+        sys.exit(main())

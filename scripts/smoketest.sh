@@ -57,6 +57,9 @@ python scripts/crash_smoke.py
 echo "== serve smoke (closed-loop concurrent clients: admission control, pinned-table H2D skip, megabatched launches, 3x throughput gate) =="
 python scripts/serve_smoke.py
 
+echo "== ingest smoke (streaming appends: kill -9 mid-append + ingest-log recovery, 30% seeded wal fsync faults, live view subscription) =="
+python scripts/ingest_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"

@@ -1,0 +1,436 @@
+"""Streaming ingestion + incrementally maintained materialized views
+(datafusion_tpu/ingest).
+
+The contract under test:
+- appends are durable-then-applied: an acked append survives a crash
+  (ingest-log replay, including a torn log tail), and a WAL write
+  failure acks NOTHING (`wal_unavailable` — retry later, the log's
+  revision dedup absorbs replays);
+- every append bumps the table's data version, which folds into query
+  fingerprints beside the catalog version — cached results stop
+  matching instead of serving stale rows;
+- an incrementally maintained view is EXACT: at every cut (creation,
+  empty delta, single-row delta, wide delta, null-bearing delta) its
+  contents are bit-identical to a full batch rescan of the defining
+  query;
+- unsupported view shapes fall back to counted full recomputes and
+  stay exact;
+- subscribers park on a view revision and wake when it advances;
+- the freshness SLO kind (`DATAFUSION_TPU_SLO_<NAME>_FRESHNESS_S`)
+  reads the live view lags;
+- cross-query megabatching extends past Aggregate: same-shape TopK
+  (ORDER BY ... LIMIT) and Projection/Selection pipelines fold into
+  ONE fused launch per batch group, demultiplexed exactly per query.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from datafusion_tpu.datatypes import DataType, Field, Schema
+from datafusion_tpu.errors import IngestError, IngestUnavailableError
+from datafusion_tpu.exec.batch import StringDictionary, make_host_batch
+from datafusion_tpu.exec.context import ExecutionContext
+from datafusion_tpu.exec.datasource import MemoryDataSource
+from datafusion_tpu.utils.metrics import METRICS
+
+SCHEMA = Schema([
+    Field("g", DataType.UTF8, False),
+    Field("v", DataType.INT64, False),
+    Field("w", DataType.FLOAT64, False),
+])
+
+VIEW_SQL = ("SELECT g, SUM(v), COUNT(1), AVG(w), MIN(w), MAX(w) "
+            "FROM t GROUP BY g")
+
+
+def _base_batch(rows: int = 256, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    d = StringDictionary()
+    codes = d.encode([f"g{j}" for j in rng.integers(0, 5, rows)])
+    v = rng.integers(0, 1000, rows).astype(np.int64)
+    w = np.round(rng.uniform(0, 100, rows), 3)
+    return make_host_batch(SCHEMA, [codes, v, w], dicts=[d, None, None])
+
+
+def _ctx(result_cache: bool = False) -> ExecutionContext:
+    ctx = (ExecutionContext() if result_cache
+           else ExecutionContext(result_cache=False))
+    ctx.register_datasource("t", MemoryDataSource(SCHEMA, [_base_batch()]))
+    return ctx
+
+
+def _delta(i: int, rows: int):
+    rng = np.random.default_rng(100 + i)
+    return {
+        "g": [f"g{j}" for j in rng.integers(0, 7, rows)],
+        "v": [int(x) for x in rng.integers(0, 1000, rows)],
+        "w": [round(float(x), 3) for x in rng.uniform(0, 100, rows)],
+    }
+
+
+class TestAppendPath:
+    def test_append_visible_and_versions_bump(self):
+        ctx = _ctx()
+        ing = ctx.ingest()
+        before_rows = len(ctx.sql_collect("SELECT g FROM t").to_rows())
+        cat0 = ctx.catalog_version("t")
+        ack = ing.append("t", {"g": ["zz"], "v": [1], "w": [0.5]})
+        assert ack["rows"] == 1 and ack["rev"] == 1
+        assert ctx.catalog_version("t") > cat0  # attach + apply both bump
+        rows = ctx.sql_collect("SELECT g FROM t").to_rows()
+        assert len(rows) == before_rows + 1
+        assert ("zz",) in rows
+
+    def test_fingerprint_changes_per_append(self):
+        from datafusion_tpu.sql.parser import parse_sql
+
+        ctx = _ctx()
+        ing = ctx.ingest()
+        ing.append("t", _delta(0, 3))
+        plan = ctx._plan(parse_sql("SELECT g, SUM(v) FROM t GROUP BY g"))
+        fp0 = ctx.query_fingerprint(plan)
+        assert ctx.query_fingerprint(plan) == fp0  # stable between appends
+        ing.append("t", _delta(1, 3))
+        # the data version folds in beside the catalog version: the
+        # same plan over grown data is DIFFERENT work
+        assert ctx.query_fingerprint(plan) != fp0
+
+    def test_cached_result_invalidated_by_append(self):
+        ctx = _ctx(result_cache=True)
+        ing = ctx.ingest()
+        sql = "SELECT SUM(v) FROM t"
+        (first,) = ctx.sql_collect(sql).to_rows()
+        (warm,) = ctx.sql_collect(sql).to_rows()  # served warm
+        assert warm == first
+        ing.append("t", {"g": ["x"], "v": [10_000_000], "w": [1.0]})
+        (after,) = ctx.sql_collect(sql).to_rows()
+        assert after[0] == first[0] + 10_000_000  # NOT the stale entry
+
+    def test_schema_mismatch_rejected_before_log(self):
+        ctx = _ctx()
+        ing = ctx.ingest()
+        with pytest.raises(IngestError):
+            ing.append("t", {"g": ["a"], "v": [1]})  # missing w
+        with pytest.raises(IngestError):
+            ing.append("t", {"g": ["a"], "v": [1], "w": [1.0],
+                             "bogus": [1]})
+        with pytest.raises(IngestError):
+            ing.append("t", {"g": ["a", "b"], "v": [1], "w": [1.0]})
+        assert ing.status()["rev"] == 0  # nothing acked
+
+    def test_wal_unavailable_acks_nothing(self, tmp_path, monkeypatch):
+        ctx = _ctx()
+        ing = ctx.ingest(wal_dir=str(tmp_path))
+        ing.append("t", _delta(0, 2))
+        rows0 = len(ctx.sql_collect("SELECT g FROM t").to_rows())
+
+        def broken(entries):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(ing._wal, "append", broken)
+        with pytest.raises(IngestUnavailableError):
+            ing.append("t", _delta(1, 2))
+        # the failed append applied nothing (its revision is burned,
+        # not acked — see test_failed_log_write_burns_its_revision)
+        assert len(ctx.sql_collect("SELECT g FROM t").to_rows()) == rows0
+        monkeypatch.undo()
+        ack = ing.append("t", _delta(1, 2))  # the retry lands cleanly
+        assert ack["rev"] == 3  # rev 2 burned by the failed write
+
+
+class TestRecovery:
+    def test_crash_recovery_replays_acked_appends(self, tmp_path):
+        wal = str(tmp_path)
+        ctx = _ctx()
+        ing = ctx.ingest(wal_dir=wal)
+        ing.create_view("mv", VIEW_SQL)
+        for i in range(3):
+            ing.append("t", _delta(i, 5 + i))
+        want_rows = sorted(ctx.sql_collect(VIEW_SQL).to_rows())
+        want_rev = ing.view("mv").revision
+        ing.close()
+        del ctx, ing
+
+        # a fresh process: base table DDL first, then log replay
+        ctx2 = _ctx()
+        ing2 = ctx2.ingest(wal_dir=wal)
+        rec = ing2.recover()
+        assert rec["appends_replayed"] == 3
+        assert rec["views_recovered"] == 1
+        assert rec["torn_tails"] == 0
+        assert sorted(ing2.read_view("mv").to_rows()) == want_rows
+        assert sorted(ctx2.sql_collect(VIEW_SQL).to_rows()) == want_rows
+        # revision sequence continues for parked subscribers
+        assert ing2.view("mv").revision == want_rev
+
+    def test_torn_tail_keeps_every_acked_append(self, tmp_path):
+        wal = str(tmp_path)
+        ctx = _ctx()
+        ing = ctx.ingest(wal_dir=wal)
+        for i in range(2):
+            ing.append("t", _delta(i, 4))
+        want = sorted(ctx.sql_collect("SELECT g, v FROM t").to_rows())
+        ing.close()
+        del ctx, ing
+        segs = sorted(p for p in os.listdir(wal) if p.endswith(".seg"))
+        with open(os.path.join(wal, segs[-1]), "ab") as f:
+            f.write(b"\x00" * 11)  # crash mid-record header
+
+        ctx2 = _ctx()
+        ing2 = ctx2.ingest(wal_dir=wal)
+        rec = ing2.recover()
+        assert rec["appends_replayed"] == 2  # both acked appends live
+        assert rec["torn_tails"] == 1
+        assert sorted(ctx2.sql_collect("SELECT g, v FROM t").to_rows()) \
+            == want
+        ack = ing2.append("t", _delta(9, 1))  # log appendable right after
+        assert ack["rev"] == 3
+
+
+    def test_failed_log_write_burns_its_revision(self, tmp_path,
+                                                 monkeypatch):
+        """The disk state after a failed WAL write is unknown: the
+        record may be durable despite the error.  The failed append's
+        revision must be BURNED — reusing it would let recovery's rev
+        dedup drop a later ACKED append in favor of the torn record."""
+        wal = str(tmp_path)
+        ctx = _ctx()
+        ing = ctx.ingest(wal_dir=wal)
+        real_append = ing._wal.append
+
+        def durable_then_error(entries):
+            real_append(entries)  # the record lands on disk...
+            raise OSError("fsync failed")  # ...but the ack path errors
+
+        monkeypatch.setattr(ing._wal, "append", durable_then_error)
+        with pytest.raises(IngestUnavailableError):
+            ing.append("t", {"g": ["nacked"], "v": [-1], "w": [0.0]})
+        monkeypatch.undo()
+        ack = ing.append("t", {"g": ["acked"], "v": [5], "w": [0.0]})
+        assert ack["rev"] == 2  # rev 1 burned by the failed write
+        ing.close()
+        del ctx, ing
+
+        ctx2 = _ctx()
+        ing2 = ctx2.ingest(wal_dir=wal)
+        ing2.recover()
+        rows = ctx2.sql_collect("SELECT g FROM t").to_rows()
+        assert ("acked",) in rows  # the acked append ALWAYS survives
+        assert ("nacked",) in rows  # durable superset of the ack stream
+
+
+class TestIncrementalViews:
+    def test_exact_parity_at_every_cut(self):
+        ctx = _ctx()
+        ing = ctx.ingest()
+        view = ing.create_view("mv", VIEW_SQL)
+        assert view.incremental, view.fallback_reason
+
+        def check(cut: str):
+            got = sorted(ing.read_view("mv").to_rows())
+            want = sorted(ctx.sql_collect(VIEW_SQL).to_rows())
+            assert got == want, f"divergence at cut {cut!r}"
+
+        check("creation fold")
+        ing.append("t", {"g": [], "v": [], "w": []})
+        check("empty delta")
+        ing.append("t", {"g": ["q"], "v": [7], "w": [3.25]})
+        check("single row, new group")
+        for i in range(4):
+            ing.append("t", _delta(i, 50))
+            check(f"wide delta {i}")
+        launches0 = view.maintain_launches
+        ing.append("t", _delta(99, 200))
+        check("final delta")
+        # ONE fused maintenance launch per delta, no full recomputes
+        assert view.maintain_launches == launches0 + 1
+        assert view.full_recomputes == 0
+
+    def test_fallback_shapes_counted_and_exact(self):
+        ctx = _ctx()
+        ing = ctx.ingest()
+        top = ing.create_view("top", "SELECT g, v FROM t ORDER BY v LIMIT 3")
+        assert not top.incremental
+        assert top.fallback_reason == "plan_shape"
+        smin = ing.create_view("smin", "SELECT MIN(g) FROM t")
+        assert not smin.incremental
+        assert smin.fallback_reason == "string_minmax"
+        ing.append("t", {"g": ["AA"], "v": [-5], "w": [0.0]})
+        assert sorted(ing.read_view("top").to_rows()) == sorted(
+            ctx.sql_collect("SELECT g, v FROM t ORDER BY v LIMIT 3")
+            .to_rows())
+        assert ing.read_view("smin").to_rows() == \
+            ctx.sql_collect("SELECT MIN(g) FROM t").to_rows()
+        assert top.full_recomputes >= 1
+        assert METRICS.counts.get("view.fallback.plan_shape", 0) >= 1
+        assert METRICS.counts.get("view.fallback.string_minmax", 0) >= 1
+
+    def test_create_view_via_sql(self):
+        ctx = _ctx()
+        ctx.sql_collect(f"CREATE MATERIALIZED VIEW mv AS {VIEW_SQL}")
+        ing = ctx.ingest()
+        assert "mv" in ing.views()
+        ing.append("t", _delta(0, 10))
+        assert sorted(ing.read_view("mv").to_rows()) == \
+            sorted(ctx.sql_collect(VIEW_SQL).to_rows())
+
+    def test_subscription_wakes_on_advance(self):
+        ctx = _ctx()
+        ing = ctx.ingest()
+        ing.create_view("mv", VIEW_SQL)
+        rev0 = ing.view("mv").revision
+        assert ing.wait_for("mv", rev0, timeout=0.05) is None  # no advance
+
+        def feeder():
+            time.sleep(0.05)
+            ing.append("t", _delta(3, 2))
+
+        th = threading.Thread(target=feeder)
+        th.start()
+        try:
+            got = ing.wait_for("mv", rev0, timeout=10)
+        finally:
+            th.join()
+        assert got == rev0 + 1
+
+    def test_freshness_slo_reads_live_lags(self, monkeypatch):
+        from datafusion_tpu.obs import slo
+
+        objs = slo.objectives_from_env(
+            {"DATAFUSION_TPU_SLO_MV_FRESHNESS_S": "0.5"})
+        assert [(o.name, o.kind) for o in objs] == [("mv", "freshness_s")]
+        ctx = _ctx()
+        ing = ctx.ingest()
+        view = ing.create_view("mv", VIEW_SQL)
+        w = slo.SloWatchdog(capture_on_breach=False)
+        w.objectives = objs
+        (row,) = w.snapshot()
+        assert not row["breached"]  # caught up: lag 0
+        monkeypatch.setattr(view, "_pending_since", time.monotonic() - 2)
+        (row,) = w.snapshot()
+        assert row["breached"] and row["value"] >= 0.5
+
+
+# -- cross-query megabatching beyond Aggregate -----------------------
+
+
+def _csv(tmp_path, rows: int = 4000) -> str:
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "t.csv")
+    with open(path, "w") as f:
+        f.write("g,v,w\n")
+        for _ in range(rows):
+            f.write(f"g{int(rng.integers(0, 7))},"
+                    f"{rng.integers(0, 100000)},{rng.random():.6f}\n")
+    return path
+
+
+def _rows_of(rel):
+    from datafusion_tpu.exec.materialize import compact_batch
+
+    rows = []
+    for b in rel.batches():
+        cols, _valids, dicts, n = compact_batch(b)
+        decode = []
+        for j, c in enumerate(cols):
+            d = dicts[j]
+            decode.append([d.values[x] for x in c[:n]] if d is not None
+                          else list(c[:n]))
+        rows += list(zip(*decode))
+    return [(a, int(b)) for a, b in rows]
+
+
+class TestMegabatchLanes:
+    def test_topk_megabatch_direct_parity(self, tmp_path):
+        from datafusion_tpu.exec.sort import SortRelation, run_topk_megabatch
+
+        path = _csv(tmp_path)
+        ctx0 = ExecutionContext()
+        ctx0.register_csv("t", path, SCHEMA)
+        solo = [ctx0.sql_collect(
+            f"SELECT g, v FROM t ORDER BY v DESC LIMIT {k}").to_rows()
+            for k in (5, 12, 7)]
+        ctx = ExecutionContext()
+        ctx.register_csv("t", path, SCHEMA)
+        rels = [ctx.sql(f"SELECT g, v FROM t ORDER BY v DESC LIMIT {k}")
+                for k in (5, 12, 7)]
+        assert all(type(r) is SortRelation for r in rels)
+        # the by-fingerprint kernel cache makes every limit share ONE
+        # core — the precondition serve's grouping key relies on
+        assert all(r.core is rels[0].core for r in rels)
+        run_topk_megabatch(rels)
+        for rel, want in zip(rels, solo):
+            assert _rows_of(rel) == want
+
+    def test_pipeline_megabatch_direct_parity(self, tmp_path):
+        from datafusion_tpu.exec.aggregate import force_core_predicate
+        from datafusion_tpu.exec.relation import (
+            PipelineRelation,
+            run_pipeline_megabatch,
+        )
+
+        path = _csv(tmp_path)
+        ctx0 = ExecutionContext()
+        ctx0.register_csv("t", path, SCHEMA)
+        lits = (99000, 99900, 95000)
+        solo = [ctx0.sql_collect(
+            f"SELECT g, v FROM t WHERE v > {lit}").to_rows()
+            for lit in lits]
+        ctx = ExecutionContext()
+        ctx.register_csv("t", path, SCHEMA)
+        with force_core_predicate():
+            rels = [ctx.sql(f"SELECT g, v FROM t WHERE v > {lit}")
+                    for lit in lits]
+        assert all(type(r) is PipelineRelation for r in rels)
+        # literals parameterize into shared slots: ONE core, per-query
+        # params, no host-side predicate residue
+        assert all(r.core is rels[0].core for r in rels)
+        assert all(r._host_pred_expr is None for r in rels)
+        run_pipeline_megabatch(rels)
+        for rel, want in zip(rels, solo):
+            assert _rows_of(rel) == want
+
+    def test_serve_groups_topk_and_pipeline(self, tmp_path):
+        path = _csv(tmp_path)
+        ctx0 = ExecutionContext()
+        ctx0.register_csv("t", path, SCHEMA)
+        solo_topk = [ctx0.sql_collect(
+            f"SELECT g, v FROM t ORDER BY v DESC LIMIT {k}").to_rows()
+            for k in (5, 12, 7)]
+        solo_pipe = [ctx0.sql_collect(
+            f"SELECT g, v FROM t WHERE v > {lit}").to_rows()
+            for lit in (99000, 99900, 95000)]
+
+        ctx = ExecutionContext(result_cache=False)
+        ctx.register_csv("t", path, SCHEMA)
+        c0 = METRICS.snapshot()["counts"]
+        srv = ctx.serve(workers=2, window_s=0.05, megabatch_max=8)
+        try:
+            tickets = [srv.submit(
+                f"SELECT g, v FROM t ORDER BY v DESC LIMIT {k}",
+                client_id=f"c{i}") for i, k in enumerate((5, 12, 7))]
+            got = [t.result(timeout=120).to_rows() for t in tickets]
+            assert got == solo_topk
+            t2 = [srv.submit(f"SELECT g, v FROM t WHERE v > {lit}",
+                             client_id=f"c{i}")
+                  for i, lit in enumerate((99000, 99900, 95000))]
+            assert [t.result(timeout=120).to_rows() for t in t2] \
+                == solo_pipe
+        finally:
+            srv.stop()
+        c1 = METRICS.snapshot()["counts"]
+        launched = (c1.get("serve.megabatch_launches", 0)
+                    - c0.get("serve.megabatch_launches", 0))
+        queries = (c1.get("serve.megabatch_queries", 0)
+                   - c0.get("serve.megabatch_queries", 0))
+        fallbacks = (c1.get("serve.megabatch_fallbacks", 0)
+                     - c0.get("serve.megabatch_fallbacks", 0))
+        assert launched >= 2  # at least one fused launch per lane
+        assert queries >= 6
+        assert fallbacks == 0
