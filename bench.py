@@ -13,11 +13,12 @@ D2H) is reported separately with a per-phase breakdown under
 
 Env knobs: BENCH_SF (lineitem scale factor for config 3, default 1),
 BENCH_CONFIGS (comma list, default
-"1,2,3,4,5,3sf10,worker,cache,conc,ingest" —
+"1,2,3,4,5,3sf10,worker,cache,conc,ingest,joins" —
 "3sf10" runs Q1 at the north-star SF-10 scale, "worker" runs the
 coordinator->worker-on-chip parity smoke and writes
 artifacts/TPU_WORKER_SMOKE.json, "cache" runs the result-cache
-warm-repeat phase), BENCH_RUNS / BENCH_COLD_RUNS.
+warm-repeat phase, "joins" runs the TPC-H Q3/Q5/Q10/Q12 join shapes
+against a pandas-merge oracle), BENCH_RUNS / BENCH_COLD_RUNS.
 """
 
 import json
@@ -36,7 +37,7 @@ def main():
     device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache,conc,ingest"
+        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache,conc,ingest,joins"
     ).split(",")
     runners = {
         "1": suite.config1_csv_filter,
@@ -60,6 +61,10 @@ def main():
         # streaming ingestion: Q1 view incremental maintenance rate x
         # freshness vs recomputing the view from scratch per delta
         "ingest": suite.config_ingest,
+        # multi-table TPC-H shapes (Q3/Q5/Q10/Q12) through the hash
+        # join, gated on pandas-merge parity + a warm pinned-probe
+        # launches-per-pass ceiling
+        "joins": suite.config_joins,
     }
     if float(os.environ.get("BENCH_SF", 1)) == 10 and "3" in [
         w.strip() for w in wanted

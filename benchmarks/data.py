@@ -164,3 +164,85 @@ def sort_batches(rows: int, batch_rows: int):
         ]
         batches.append(make_host_batch(schema, cols, [None] * 4, [None] * 4))
     return schema, MemoryDataSource(schema, batches)
+
+
+def tpch_join_csvs(sf: float = 0.01):
+    """TPC-H-lite star-schema CSVs for the join configs (Q3/Q5/Q10/Q12
+    shapes): nation/customer/orders/lineitem at roughly `sf` times the
+    spec's cardinalities, seeded, cached on disk.  Returns
+    {table: (path, schema)} plus enough skew (dangling orders, repeated
+    customers) that LEFT OUTER and dedup paths do real work."""
+    from datafusion_tpu.datatypes import DataType, Field, Schema
+
+    os.makedirs(BENCH_DIR, exist_ok=True)
+    n_cust = max(200, int(150_000 * sf))
+    n_orders = max(2_000, int(1_500_000 * sf))
+    n_line = max(8_000, int(6_000_000 * sf))
+    n_nation = 25
+    tag = f"sf{sf:g}"
+    rng = np.random.default_rng(19)
+
+    def write(name, header, rows):
+        path = os.path.join(BENCH_DIR, f"join_{name}_{tag}.csv")
+        if os.path.exists(path):
+            return path
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(header + "\n")
+            for r in rows:
+                f.write(",".join(str(v) for v in r) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    nation = [(i, f"NATION_{i:02d}") for i in range(n_nation)]
+    cust = [
+        (i, int(rng.integers(0, n_nation)), int(rng.integers(0, 5)),
+         round(float(rng.uniform(-999, 9999)), 2))
+        for i in range(n_cust)
+    ]
+    # ~2% of orders reference customers past the table (dangling keys)
+    orders = [
+        (i, int(rng.integers(0, int(n_cust * 1.02))),
+         f"1995-{rng.integers(1, 13):02d}-{rng.integers(1, 29):02d}",
+         int(rng.integers(0, 3)))
+        for i in range(n_orders)
+    ]
+    line = [
+        (int(rng.integers(0, n_orders)), int(rng.integers(1, 51)),
+         round(float(rng.uniform(900, 105000)), 2),
+         round(float(rng.uniform(0, 0.1)), 2), int(rng.integers(0, 7)))
+        for _ in range(n_line)
+    ]
+    I64, F64, U8 = DataType.INT64, DataType.FLOAT64, DataType.UTF8
+    return {
+        "nation": (
+            write("nation", "n_nationkey,n_name", nation),
+            Schema([Field("n_nationkey", I64, False),
+                    Field("n_name", U8, False)]),
+        ),
+        "customer": (
+            write("customer", "c_custkey,c_nationkey,c_mktsegment,c_acctbal",
+                  cust),
+            Schema([Field("c_custkey", I64, False),
+                    Field("c_nationkey", I64, False),
+                    Field("c_mktsegment", I64, False),
+                    Field("c_acctbal", F64, False)]),
+        ),
+        "orders": (
+            write("orders", "o_orderkey,o_custkey,o_orderdate,o_shippriority",
+                  orders),
+            Schema([Field("o_orderkey", I64, False),
+                    Field("o_custkey", I64, False),
+                    Field("o_orderdate", U8, False),
+                    Field("o_shippriority", I64, False)]),
+        ),
+        "lineitem": (
+            write("lineitem", "l_orderkey,l_quantity,l_extendedprice,"
+                  "l_discount,l_shipmode", line),
+            Schema([Field("l_orderkey", I64, False),
+                    Field("l_quantity", I64, False),
+                    Field("l_extendedprice", F64, False),
+                    Field("l_discount", F64, False),
+                    Field("l_shipmode", I64, False)]),
+        ),
+    }

@@ -1012,3 +1012,158 @@ def config5_mesh(_device_kind: str):
     if proc.returncode != 0:
         raise RuntimeError(f"mesh bench failed:\n{proc.stdout}\n{proc.stderr}")
     return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# -- config joins: TPC-H Q3/Q5/Q10/Q12 shapes over the join subsystem --
+def config_joins(device_kind: str):
+    """Multi-table TPC-H shapes through the hash-join operator, gated
+    on bit-level parity against a pandas-merge oracle, plus a warm
+    pinned-probe leg: once the (dense-int, unique-key) orders build is
+    resident, repeat passes must launch ZERO build kernels and stay
+    under a launches-per-pass ceiling derived from the probe batch
+    count — the 'warm probes move no build work' serving contract."""
+    import pandas as pd
+
+    from datafusion_tpu import cache as qcache
+    from datafusion_tpu.exec.context import ExecutionContext
+    from datafusion_tpu.exec.datasource import CsvDataSource
+    from datafusion_tpu.exec.materialize import collect
+    from datafusion_tpu.utils.metrics import METRICS
+
+    sf = float(os.environ.get("BENCH_JOIN_SF", 0.01))
+    batch_rows = 1 << 14
+    tables = bdata.tpch_join_csvs(sf)
+    device = None if device_kind == "cpu" else device_kind
+    frames = {}
+    with qcache.configured(enabled=False):
+        ctx = ExecutionContext(
+            device="cpu" if device is None else device, batch_size=batch_rows
+        )
+        for name, (path, schema) in tables.items():
+            ctx.register_datasource(
+                name, CsvDataSource(path, schema, True, batch_rows))
+            frames[name] = pd.read_csv(path)
+
+        def rows_of(sql):
+            def key(row):
+                return tuple(
+                    (v is None, 0 if v is None else v) for v in row)
+            return sorted(collect(ctx.sql(sql)).to_rows(), key=key)
+
+        def check(label, got, want_df):
+            want = sorted(
+                tuple(None if pd.isna(v) else v for v in t)
+                for t in want_df.itertuples(index=False)
+            )
+            assert len(got) == len(want), (
+                f"{label}: {len(got)} rows vs oracle {len(want)}")
+            for g, w in zip(got, want):
+                for gv, wv in zip(g, w):
+                    if isinstance(gv, float) or isinstance(wv, float):
+                        np.testing.assert_allclose(
+                            gv, wv, rtol=1e-9, err_msg=label)
+                    else:
+                        assert gv == wv, f"{label}: {g} vs {w}"
+
+        li, od, cu, na = (frames["lineitem"], frames["orders"],
+                          frames["customer"], frames["nation"])
+        li = li.assign(rev=li.l_extendedprice * (1 - li.l_discount))
+        results = {}
+
+        q3 = ("SELECT o_orderkey, o_shippriority, "
+              "SUM(l_extendedprice * (1 - l_discount)) FROM lineitem "
+              "JOIN orders ON lineitem.l_orderkey = orders.o_orderkey "
+              "JOIN customer ON orders.o_custkey = customer.c_custkey "
+              "WHERE c_mktsegment = 1 "
+              "GROUP BY o_orderkey, o_shippriority")
+        t, got = _timed(lambda: rows_of(q3), runs=3, warmup=1)
+        o3 = (li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+              .merge(cu, left_on="o_custkey", right_on="c_custkey"))
+        o3 = (o3[o3.c_mktsegment == 1]
+              .groupby(["o_orderkey", "o_shippriority"], as_index=False)
+              .agg(rev=("rev", "sum")))
+        check("q3", got, o3[["o_orderkey", "o_shippriority", "rev"]])
+        results["q3_s"] = round(t, 4)
+        log(f"    Q3 shape: {len(got)} groups, p50 {t * 1e3:.1f} ms")
+
+        q5 = ("SELECT n_name, SUM(l_extendedprice * (1 - l_discount)) "
+              "FROM lineitem "
+              "JOIN orders ON lineitem.l_orderkey = orders.o_orderkey "
+              "JOIN customer ON orders.o_custkey = customer.c_custkey "
+              "JOIN nation ON customer.c_nationkey = nation.n_nationkey "
+              "GROUP BY n_name")
+        t, got = _timed(lambda: rows_of(q5), runs=3, warmup=1)
+        o5 = (li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+              .merge(cu, left_on="o_custkey", right_on="c_custkey")
+              .merge(na, left_on="c_nationkey", right_on="n_nationkey")
+              .groupby("n_name", as_index=False).agg(rev=("rev", "sum")))
+        check("q5", got, o5[["n_name", "rev"]])
+        results["q5_s"] = round(t, 4)
+        log(f"    Q5 shape: {len(got)} nations, p50 {t * 1e3:.1f} ms")
+
+        q10 = ("SELECT c_custkey, n_name, "
+               "SUM(l_extendedprice * (1 - l_discount)) FROM lineitem "
+               "JOIN orders ON lineitem.l_orderkey = orders.o_orderkey "
+               "JOIN customer ON orders.o_custkey = customer.c_custkey "
+               "JOIN nation ON customer.c_nationkey = nation.n_nationkey "
+               "WHERE o_orderdate <= '1995-06-30' "
+               "GROUP BY c_custkey, n_name")
+        t, got = _timed(lambda: rows_of(q10), runs=3, warmup=1)
+        o10 = (li.merge(od, left_on="l_orderkey", right_on="o_orderkey")
+               .merge(cu, left_on="o_custkey", right_on="c_custkey")
+               .merge(na, left_on="c_nationkey", right_on="n_nationkey"))
+        o10 = (o10[o10.o_orderdate <= "1995-06-30"]
+               .groupby(["c_custkey", "n_name"], as_index=False)
+               .agg(rev=("rev", "sum")))
+        check("q10", got, o10[["c_custkey", "n_name", "rev"]])
+        results["q10_s"] = round(t, 4)
+        log(f"    Q10 shape: {len(got)} groups, p50 {t * 1e3:.1f} ms")
+
+        q12 = ("SELECT l_shipmode, COUNT(1) FROM lineitem "
+               "JOIN orders ON lineitem.l_orderkey = orders.o_orderkey "
+               "WHERE l_quantity > 25 GROUP BY l_shipmode")
+        t, got = _timed(lambda: rows_of(q12), runs=3, warmup=1)
+        o12 = (li.merge(od, left_on="l_orderkey", right_on="o_orderkey"))
+        o12 = (o12[o12.l_quantity > 25]
+               .groupby("l_shipmode", as_index=False)
+               .agg(n=("l_orderkey", "count")))
+        check("q12", [(a, int(b)) for a, b in got],
+              o12[["l_shipmode", "n"]])
+        results["q12_s"] = round(t, 4)
+        log(f"    Q12 shape: {len(got)} shipmodes, p50 {t * 1e3:.1f} ms")
+
+        # warm pinned-probe gate on Q12 (orders build: unique dense int
+        # key -> device path, pinned after the timed passes above)
+        n_line = len(li)
+        n_batches = -(-n_line // batch_rows)
+        before = METRICS.snapshot()["counts"]
+        pm = _pass_metrics(lambda: rows_of(q12), bytes_per_pass=0.0)
+        after = METRICS.snapshot()["counts"]
+        build_launches = (after.get("device.launches.join.build", 0)
+                          - before.get("device.launches.join.build", 0))
+        assert build_launches == 0, (
+            f"warm Q12 passes launched {build_launches} build kernels")
+        reuse = (after.get("join.build.reuse", 0)
+                 - before.get("join.build.reuse", 0))
+        assert reuse >= 3, f"pinned build reused {reuse} times in 4 passes"
+        # ceiling: scan decode + filter + fused probe + aggregate per
+        # probe batch, plus a fixed epilogue allowance
+        ceiling = 8 * n_batches + 32
+        assert pm["launches_per_pass"] <= ceiling, (
+            f"warm Q12 launches_per_pass {pm['launches_per_pass']} "
+            f"exceeds ceiling {ceiling} ({n_batches} probe batches)")
+        log(f"    warm Q12: {pm['launches_per_pass']} launches/pass "
+            f"(ceiling {ceiling}), 0 build launches, reuse={reuse}")
+
+    total_rows = sum(len(f) for f in frames.values())
+    wall = results["q3_s"] + results["q5_s"] + results["q10_s"] + results["q12_s"]
+    return {
+        "name": "tpch_joins",
+        "rows": total_rows,
+        "unit": "rows/s",
+        "value": round(total_rows * 4 / max(wall, 1e-9), 1),
+        "launches_per_pass_warm_q12": pm["launches_per_pass"],
+        "probe_batches": n_batches,
+        "vs_baseline": 1.0,  # parity leg: pass/fail, not a speed ratio
+        **results,
+    }

@@ -60,6 +60,7 @@ from datafusion_tpu.plan.expr import (
 from datafusion_tpu.plan.logical import (
     Aggregate,
     EmptyRelation,
+    Join,
     Limit,
     LogicalPlan,
     Projection,
@@ -398,6 +399,9 @@ def _node_label(plan: LogicalPlan) -> str:
         )
     if isinstance(plan, Limit):
         return f"Limit: {plan.limit}"
+    if isinstance(plan, Join):
+        on = ", ".join(f"#{l}=#{r}" for l, r in plan.on)
+        return f"Join: type={plan.join_type}, on=[{on}]"
     return type(plan).__name__
 
 
@@ -445,6 +449,8 @@ def _verify_node(plan: LogicalPlan, report: VerifyReport, functions,
         schema = _verify_sort(plan, report, functions, depth)
     elif isinstance(plan, Limit):
         schema = _verify_limit(plan, report, functions, depth)
+    elif isinstance(plan, Join):
+        schema = _verify_join(plan, report, functions, depth)
     else:
         report.add(type(plan).__name__,
                    f"unknown plan variant {type(plan).__name__}")
@@ -624,6 +630,71 @@ def _verify_limit(plan: Limit, report: VerifyReport, functions,
     _check_arity(report, "Limit.schema", plan.schema, len(child),
                  "limit passes rows through")
     return plan.schema
+
+
+def _verify_join(plan: Join, report: VerifyReport, functions,
+                 depth: int) -> Schema:
+    """Cross-relation checks: both inputs verify recursively (EXPLAIN
+    VERIFY then renders both input schemas in pre-order), every ON key
+    index resolves in its own side, key pairs are dtype-compatible
+    (equal or supertype-promotable — the equi-probe compares raw
+    values, so an incomparable pair is a plan bug, not a runtime one),
+    and the declared output qualifies cross-input duplicate names."""
+    left = _verify_node(plan.left, report, functions, depth + 1)
+    right = _verify_node(plan.right, report, functions, depth + 1)
+    if not plan.on:
+        report.add("Join.on", "join has no ON key pairs (cross joins "
+                              "are not supported)")
+    for i, (li, ri) in enumerate(plan.on):
+        path = f"Join.on[{i}]"
+        ok = True
+        if not 0 <= li < len(left):
+            report.add(path, f"left key index {li} out of range for the "
+                             f"left input ({len(left)} columns)")
+            ok = False
+        if not 0 <= ri < len(right):
+            report.add(path, f"right key index {ri} out of range for the "
+                             f"right input ({len(right)} columns)")
+            ok = False
+        if not ok:
+            continue
+        lt, rt = left.field(li).data_type, right.field(ri).data_type
+        if lt != rt and get_supertype(lt, rt) is None:
+            report.add(
+                path,
+                f"ON keys {left.field(li).name!r} ({lt!r}) and "
+                f"{right.field(ri).name!r} ({rt!r}) have no common "
+                f"supertype — the equi-join cannot compare them",
+            )
+    declared = plan.schema
+    _check_arity(report, "Join.schema", declared, len(left) + len(right),
+                 "left fields then right fields")
+    combined = list(left.fields) + list(right.fields)
+    for i, f in enumerate(combined):
+        if i >= len(declared):
+            break
+        decl = declared.field(i)
+        if decl.data_type != f.data_type:
+            report.add(
+                "Join.schema",
+                f"declared field {i} ({decl.name!r}) is "
+                f"{decl.data_type!r} but the input column is "
+                f"{f.data_type!r}",
+            )
+    # cross-input duplicate names must be qualified in the output —
+    # an ambiguous declared name would break downstream index_of
+    seen: dict[str, int] = {}
+    for i in range(len(declared)):
+        name = declared.field(i).name
+        if name in seen:
+            report.add(
+                "Join.schema",
+                f"output columns {seen[name]} and {i} share the name "
+                f"{name!r} — cross-input duplicates must be qualified "
+                f"(e.g. 'table.{name}')",
+            )
+        seen[name] = i
+    return declared
 
 
 def verify_exprs(exprs: Sequence[Expr], schema: Schema,

@@ -42,6 +42,7 @@ from datafusion_tpu.plan.expr import FunctionMeta, FunctionType
 from datafusion_tpu.plan.logical import (
     Aggregate,
     EmptyRelation,
+    Join,
     Limit,
     LogicalPlan,
     Projection,
@@ -561,6 +562,27 @@ class ExecutionContext:
                     device=self.device,
                 )
             return LimitRelation(self.execute(plan.input), plan.limit, plan.schema)
+        if isinstance(plan, Join):
+            from datafusion_tpu.join.relation import HashJoinRelation
+
+            # build-side identity: the right subtree's result under the
+            # current catalog/data versions PLUS the key columns the
+            # hash index is built over (the same dimension subtree
+            # joined on different keys needs different builds) — the
+            # ledger pin key that lets warm queries reuse a resident
+            # build, invalidated by any catalog/data version bump
+            try:
+                keys = ",".join(str(r) for _, r in plan.on)
+                build_key = (
+                    f"join:{self.query_fingerprint(plan.right)}:k={keys}"
+                )
+            except PlanError:
+                build_key = None
+            return HashJoinRelation(
+                self.execute(plan.left), self.execute(plan.right),
+                plan.on, plan.join_type, plan.schema,
+                device=self.device, build_key=build_key,
+            )
         raise ExecutionError(f"Cannot execute plan node {type(plan).__name__}")
 
     def _execute_fused(self, plan: LogicalPlan, fns) -> Optional[Relation]:

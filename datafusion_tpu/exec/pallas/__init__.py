@@ -8,6 +8,9 @@ lowering loses to the CPU baseline (ROADMAP item 4):
 - `sort_kernel`: segmented bitonic sort — whole-block compare-exchange
   networks run in VMEM, multi-key orders compose as chained stable
   passes, all inside one launch.
+- `hash_build`: hash-join build over dense-int keys — per-slot row
+  index and key count accumulated in VMEM slot tiles (the same one-hot
+  tile sweep as hash_agg; XLA's scatter alternative is serial on TPU).
 
 Engagement policy (``DATAFUSION_TPU_PALLAS``):
 
@@ -79,6 +82,13 @@ def agg_max_groups() -> int:
     sort-merge path keeps the job (the one-hot tile sweep is linear in
     G, so past this point sorting wins)."""
     return int(os.environ.get("DATAFUSION_TPU_PALLAS_AGG_GROUPS", 8192))
+
+
+def build_max_slots() -> int:
+    """Largest direct-address slot table the hash-build kernel fills;
+    above it the stock-XLA scatter build keeps the job (the one-hot
+    tile sweep is linear in K, same trade as `agg_max_groups`)."""
+    return int(os.environ.get("DATAFUSION_TPU_PALLAS_BUILD_SLOTS", 8192))
 
 
 def sort_max_rows() -> int:

@@ -59,11 +59,14 @@ from datafusion_tpu.parallel.physical import PlanFragment
 from datafusion_tpu.parallel.wire import (
     CRC_ENABLED,
     WIRE_VERSION,
+    BinWriter,
     dec_array,
+    enc_array,
     recv_msg,
     send_msg,
 )
 from datafusion_tpu.plan.logical import (
+    Join,
     LogicalPlan,
     Projection,
     Selection,
@@ -98,7 +101,11 @@ class WorkerHandle:
     def __repr__(self):
         return f"worker({self.host}:{self.port}, {'up' if self.alive else 'down'})"
 
-    def request(self, msg: dict, timeout: Optional[float] = -1) -> dict:
+    def request(self, msg: dict, timeout: Optional[float] = -1,
+                bw=None) -> dict:
+        """`bw` (a wire.BinWriter) attaches CRC'd binary segments to
+        the REQUEST frame — shuffle-join dispatches ship their block
+        payloads this way instead of base64-inlining them in JSON."""
         if timeout == -1:
             timeout = self.request_timeout
         if CRC_ENABLED and "wire_version" not in msg:
@@ -115,7 +122,7 @@ class WorkerHandle:
             (self.host, self.port), timeout=connect_timeout
         ) as s:
             s.settimeout(timeout)
-            send_msg(s, msg)
+            send_msg(s, msg, bw, crc=CRC_ENABLED)
             try:
                 out = recv_msg(s)
             except TimeoutError as e:
@@ -384,7 +391,7 @@ _DISPATCH_PROBE_ROUNDS = 2
 def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
               request_type: str,
               deadline: Optional[Deadline] = None,
-              hedge=None, local_exec=None,
+              hedge=None, local_exec=None, extra: Optional[dict] = None,
               ) -> list[tuple[PlanFragment, dict]]:
     """Send the fragments to the workers concurrently (round-robin over
     live workers; one thread per in-flight fragment, so N workers
@@ -691,6 +698,10 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                 )
             w = pick_worker(live)
             msg = {"type": request_type, "fragment": frag.to_json_str()}
+            if extra:
+                # request-kind parameters riding beside the fragment
+                # (e.g. shuffle_map's keys/num_parts/side)
+                msg.update(extra)
             timeout = -1
             if deadline is not None:
                 msg["deadline_s"] = max(deadline.remaining(), 0.001)
@@ -1109,6 +1120,245 @@ def _match_shippable_aggregate(plan: LogicalPlan, datasources: dict):
     if not isinstance(datasources.get(inner.table_name), PartitionedDataSource):
         return None, None, None
     return plan, pred, inner
+
+
+class DistributedShuffleJoinRelation(Relation):
+    """Hash-partitioned shuffle join (parallel/shuffle.py).
+
+    Each side is either **shippable** — a Projection/Selection chain
+    over a partitioned table, executed as `shuffle_map` fragments on
+    workers — or **coordinator-local** (any other relation, including
+    a nested distributed join), whose rows the coordinator partitions
+    itself.  Map blocks for partition `p` from both sides then meet in
+    one `shuffle_join` reduce request at a worker, which builds the
+    hash table from the right side's blocks and probes with the left.
+
+    Fault model: map fragments inherit `_dispatch`'s full failover /
+    hedging / dedup machinery; duplicate blocks drop by fingerprint at
+    the reduce.  A reduce request whose worker dies replays on the
+    next live worker (`shuffle.reduce_replayed`) — it is a pure
+    function of its blocks, so the replay is exact — and when every
+    worker is gone the coordinator runs the reduce itself
+    (`shuffle.local_reduces`) rather than failing the query.
+    """
+
+    def __init__(self, plan, sides, workers: list[WorkerHandle],
+                 query_deadline_s: Optional[float] = None, hedge=None):
+        # sides: per (left, right) input either ("frags", side_plan, ds)
+        # or ("local", relation)
+        self.plan = plan
+        self.sides = sides
+        self.workers = workers
+        self._schema = plan.schema
+        self.query_deadline_s = query_deadline_s
+        self.hedge = hedge
+
+    def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
+        return _collect_worker_flight_dumps(self.workers, trace_id)
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def op_label(self) -> str:
+        kinds = "/".join(s[0] for s in self.sides)
+        return (
+            f"DistributedShuffleJoin[{self.plan.join_type}, sides={kinds}, "
+            f"workers={len(self.workers)}]"
+        )
+
+    def _map_side(self, si: int, tag: str, qid: str, num_parts: int,
+                  deadline) -> dict:
+        """Run one side's map phase; returns {partition: [host block]}."""
+        from datafusion_tpu.parallel import shuffle
+
+        keys = [l for l, _ in self.plan.on] if si == 0 else [
+            r for _, r in self.plan.on
+        ]
+        per_part: dict = {p: [] for p in range(num_parts)}
+        side = self.sides[si]
+        if side[0] == "frags":
+            _, side_plan, ds = side
+            plan_json = side_plan.to_json()
+            n = len(ds.partitions)
+            fragments = [
+                PlanFragment(i, n, plan_json, pt.to_meta(), f"{qid}{tag}")
+                for i, pt in enumerate(ds.partitions)
+            ]
+            responses = _dispatch(
+                self.workers, fragments, "shuffle_map", deadline,
+                hedge=self.hedge,
+                extra={"keys": keys, "num_parts": num_parts, "side": tag},
+            )
+            for _frag, resp in _iter_unique_responses(responses):
+                for ob in resp["blocks"]:
+                    b = shuffle.decode_block(ob)
+                    per_part[b["partition"]].append(b)
+            flight.record("shuffle.map", side=tag, fragments=n,
+                          partitions=num_parts)
+            return per_part
+        # coordinator-local side: materialize the relation here and
+        # split it with the SAME partitioner the workers use
+        from datafusion_tpu.exec.materialize import collect_columns
+
+        rel = side[1]
+        columns, validity, dicts, total = collect_columns(rel)
+        raw_cols = []
+        for i, f in enumerate(rel.schema.fields):
+            if f.data_type == DataType.UTF8:
+                d = dicts[i]
+                raw_cols.append({
+                    "codes": np.asarray(columns[i], np.int32),
+                    "values": [] if d is None else d.values,
+                })
+            else:
+                raw_cols.append(columns[i])
+        raw = {"num_rows": total, "columns": raw_cols,
+               "validity": list(validity)}
+        for b in shuffle.split_blocks(
+            raw, keys, num_parts, (qid, tag, "local", num_parts, keys)
+        ):
+            per_part[b["partition"]].append(b)
+        flight.record("shuffle.map", side=tag, fragments=0, rows=total,
+                      partitions=num_parts)
+        return per_part
+
+    def _reduce_one(self, p: int, qid: str, left_blocks, right_blocks,
+                    deadline) -> Optional[dict]:
+        """One partition's reduce, with worker failover and a
+        coordinator-local last resort."""
+        from datafusion_tpu.parallel import shuffle
+
+        if not any(b["num_rows"] for b in left_blocks):
+            # no probe rows: both join types emit nothing here
+            METRICS.add("shuffle.partitions_skipped")
+            return None
+        if self.plan.join_type == "inner" and not any(
+            b["num_rows"] for b in right_blocks
+        ):
+            METRICS.add("shuffle.partitions_skipped")
+            return None
+        bw = BinWriter()
+        msg = {
+            "type": "shuffle_join",
+            "partition": p,
+            "query_id": qid,
+            "on": [[l, r] for l, r in self.plan.on],
+            "join_type": self.plan.join_type,
+            "left_blocks": [shuffle.encode_block(b, bw) for b in left_blocks],
+            "right_blocks": [shuffle.encode_block(b, bw) for b in right_blocks],
+        }
+        for attempt in range(len(self.workers) + _DISPATCH_PROBE_ROUNDS + 1):
+            if deadline is not None:
+                deadline.check(f"shuffle partition {p}")
+            live = [w for w in self.workers if w.alive]
+            if not live:
+                for w in self.workers:
+                    if w.probe():
+                        w.readmit()
+                live = [w for w in self.workers if w.alive]
+            if not live:
+                break
+            w = live[(p + attempt) % len(live)]
+            timeout = -1
+            if deadline is not None:
+                msg["deadline_s"] = max(deadline.remaining(), 0.001)
+                timeout = msg["deadline_s"]
+                if w.request_timeout is not None:
+                    timeout = min(timeout, w.request_timeout)
+            try:
+                return w.request(msg, timeout=timeout, bw=bw)
+            except (ConnectionError, OSError):
+                # worker died mid-shuffle: the blocks are still here,
+                # the reduce is a pure function of them — replay on
+                # the next live worker is exact, and the dedup
+                # fingerprints make a racing duplicate harmless
+                w.mark_down()
+                METRICS.add("shuffle.reduce_replayed")
+                flight.record("shuffle.failover", partition=p,
+                              worker=f"{w.host}:{w.port}", attempt=attempt)
+        # every worker is gone: run the reduce HERE (degraded but
+        # correct — same code path the workers run)
+        METRICS.add("shuffle.local_reduces")
+        flight.record("shuffle.local_reduce", partition=p)
+        raw = shuffle.reduce_join(
+            left_blocks, right_blocks, list(self.plan.on),
+            self.plan.join_type,
+        )
+        # inline-encode (bw=None) so the merge path below decodes it
+        # exactly like a remote response
+        return {
+            "type": "rows",
+            "fragment_id": f"{qid}/p{p}",
+            "num_rows": raw["num_rows"],
+            "columns": [
+                {"codes": enc_array(c["codes"]), "values": c["values"]}
+                if isinstance(c, dict)
+                else enc_array(np.asarray(c))
+                for c in raw["columns"]
+            ],
+            "validity": [
+                None if v is None else enc_array(np.asarray(v))
+                for v in raw["validity"]
+            ],
+        }
+
+    def batches(self) -> Iterator[RecordBatch]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        from datafusion_tpu.parallel import shuffle
+
+        qid = uuid.uuid4().hex[:12]
+        num_parts = shuffle.shuffle_parts(len(self.workers))
+        if obs_trace.enabled():
+            self.stats.attrs.update(partitions=num_parts,
+                                    workers=len(self.workers))
+        deadline = (
+            None
+            if self.query_deadline_s is None
+            else Deadline.after(self.query_deadline_s)
+        )
+        with METRICS.timer("shuffle.map"):
+            left_parts = self._map_side(0, "L", qid, num_parts, deadline)
+            right_parts = self._map_side(1, "R", qid, num_parts, deadline)
+        with ThreadPoolExecutor(
+            max_workers=min(num_parts, max(2, len(self.workers) * 2)),
+            thread_name_prefix="df-tpu-shuffle",
+        ) as pool:
+            responses = list(pool.map(
+                lambda p: self._reduce_one(
+                    p, qid, left_parts[p], right_parts[p], deadline
+                ),
+                range(num_parts),
+            ))
+        dicts: list[Optional[StringDictionary]] = [
+            StringDictionary() if f.data_type == DataType.UTF8 else None
+            for f in self._schema.fields
+        ]
+        flight.record("shuffle.merge", partitions=num_parts,
+                      responses=sum(1 for r in responses if r is not None))
+        seen: set = set()
+        for resp in responses:
+            if resp is None or resp["num_rows"] == 0:
+                continue
+            fid = resp.get("fragment_id")
+            if fid in seen:
+                METRICS.add("coord.duplicate_responses_dropped")
+                continue
+            seen.add(fid)
+            cols = []
+            for i, f in enumerate(self._schema.fields):
+                c = resp["columns"][i]
+                if f.data_type == DataType.UTF8:
+                    codes = dec_array(c["codes"])
+                    cols.append(dicts[i].merge_codes(codes, c["values"]))
+                else:
+                    cols.append(dec_array(c).astype(f.data_type.np_dtype))
+            valids = [
+                None if v is None else dec_array(v).astype(bool)
+                for v in resp["validity"]
+            ]
+            yield make_host_batch(self._schema, cols, valids, list(dicts))
 
 
 def _match_distributed_pipeline(plan: LogicalPlan, datasources: dict):
@@ -1547,4 +1797,51 @@ class DistributedContext(ExecutionContext):
                 query_deadline_s=self.query_deadline_s,
                 hedge=self.hedge, local_exec=self._local_exec_fn,
             )
+        if isinstance(plan, Join):
+            rel = self._maybe_shuffle_join(plan)
+            if rel is not None:
+                return rel
         return super()._execute_plan(plan)
+
+    def _shippable_join_side(self, side_plan: LogicalPlan):
+        """The side's PartitionedDataSource when it is a shippable
+        row pipeline with serializable partition meta, else None."""
+        ds = _match_distributed_pipeline(side_plan, self.datasources)
+        if ds is None:
+            return None
+        try:
+            ds.to_meta()
+        except PlanError:
+            return None
+        return ds
+
+    def _maybe_shuffle_join(self, plan: Join):
+        """Shuffle-exchange lowering for a Join: engages when at least
+        one input is a shippable partitioned pipeline (the other side
+        — e.g. a nested join's output — materializes at the
+        coordinator and is partitioned with the same hash).  Falls
+        back to the local hash join (whose children still distribute
+        their scans) when neither side ships, or when
+        DATAFUSION_TPU_SHUFFLE=0."""
+        import os
+
+        if os.environ.get("DATAFUSION_TPU_SHUFFLE", "1") == "0":
+            return None
+        side_ds = [
+            self._shippable_join_side(side_plan)
+            for side_plan in (plan.left, plan.right)
+        ]
+        if not any(ds is not None for ds in side_ds):
+            return None
+        sides = []
+        for side_plan, ds in zip((plan.left, plan.right), side_ds):
+            if ds is not None:
+                _check_fragment_plan(side_plan)
+                sides.append(("frags", side_plan, ds))
+            else:
+                sides.append(("local", self.execute(side_plan)))
+        METRICS.add("shuffle.joins")
+        return DistributedShuffleJoinRelation(
+            plan, sides, self.workers,
+            query_deadline_s=self.query_deadline_s, hedge=self.hedge,
+        )

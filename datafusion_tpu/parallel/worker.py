@@ -15,9 +15,21 @@ Requests:  {"type": "ping"}
            {"type": "status"}
            {"type": "execute_fragment", "fragment": <PlanFragment str>}
            {"type": "execute_plan", "fragment": <PlanFragment str>}
+           {"type": "shuffle_map", "fragment": ..., "keys": [...],
+            "num_parts": P, "side": "L"|"R"}
+           {"type": "shuffle_join", "partition": p, "on": [[l,r]...],
+            "join_type": ..., "left_blocks": [...], "right_blocks": [...]}
 Responses: {"type": "pong", ...} / {"type": "status", ...} /
            {"type": "partial_state", ...} / {"type": "rows", ...} /
+           {"type": "shuffle_blocks", ...} /
            {"type": "error", "message": ...}
+
+The two `shuffle_*` kinds are the distributed-join exchange
+(parallel/shuffle.py): `shuffle_map` executes a row fragment exactly
+like `execute_plan` (same fragment cache — a replayed map task after a
+failover re-partitions the cached rows instead of re-scanning) and
+splits the rows into hash partitions; `shuffle_join` joins merged
+per-partition blocks from both sides with the host `HashIndex` core.
 """
 
 from __future__ import annotations
@@ -413,6 +425,70 @@ class WorkerState:
             "validity": list(validity),
         }
 
+    def shuffle_map(self, fragment_str: str, keys: list, num_parts: int,
+                    side: str, bw: Optional[BinWriter] = None) -> dict:
+        """Map side of the shuffle exchange: run the side's fragment
+        (row path, fragment-cached) and split its output into
+        `num_parts` hash-partitioned blocks.  Partitioning happens
+        AFTER the cache seam on purpose — the cached payload is the
+        plain rows result, so `execute_plan` and replayed map tasks
+        with different partition counts all share one scan."""
+        from datafusion_tpu.parallel import shuffle
+
+        frag = PlanFragment.from_json_str(fragment_str)
+        raw, hit = self._serve_fragment(frag, self._execute_plan)
+        key_idx = [int(k) for k in keys]
+        with obs_trace.span("worker.shuffle_map", side=side,
+                            **frag.span_attrs()):
+            blocks = shuffle.split_blocks(
+                raw, key_idx, int(num_parts),
+                (fragment_fingerprint(frag), side, int(num_parts), key_idx),
+            )
+        out = {
+            "type": "shuffle_blocks",
+            "fragment_id": frag.fragment_id,
+            "side": side,
+            "num_rows": raw["num_rows"],
+            "blocks": [shuffle.encode_block(b, bw) for b in blocks],
+        }
+        if hit:
+            out["cache_hit"] = True
+        return out
+
+    def shuffle_join(self, msg: dict, bw: Optional[BinWriter] = None) -> dict:
+        """Reduce side: merge both sides' blocks for one partition
+        (duplicate fingerprints drop idempotently) and join them with
+        the host `HashIndex` core.  Responds in the standard `rows`
+        shape so the coordinator's merge path is shared with the
+        row-fragment union."""
+        from datafusion_tpu.parallel import shuffle
+
+        partition = int(msg["partition"])
+        faults.check("worker.shuffle_join", partition=partition)
+        with obs_trace.span("worker.shuffle_join", partition=partition):
+            raw = shuffle.reduce_join(
+                [shuffle.decode_block(o) for o in msg["left_blocks"]],
+                [shuffle.decode_block(o) for o in msg["right_blocks"]],
+                [(int(l), int(r)) for l, r in msg["on"]],
+                msg.get("join_type", "inner"),
+            )
+        self.queries += 1
+        return {
+            "type": "rows",
+            "fragment_id": f"{msg.get('query_id', '')}/p{partition}",
+            "num_rows": raw["num_rows"],
+            "columns": [
+                {"codes": enc_array(c["codes"], bw), "values": c["values"]}
+                if isinstance(c, dict)
+                else enc_array(c, bw)
+                for c in raw["columns"]
+            ],
+            "validity": [
+                None if v is None else enc_array(np.asarray(v), bw)
+                for v in raw["validity"]
+            ],
+        }
+
 
 def _serve_worker_request(state: WorkerState, msg: dict):
     """One decoded request -> ``(response, BinWriter)``.  Runs on the
@@ -462,6 +538,15 @@ def _serve_worker_request(state: WorkerState, msg: dict):
         elif kind == "execute_plan":
             with adoption, deadline_scope(deadline):
                 out = state.execute_plan(msg["fragment"], bw)
+        elif kind == "shuffle_map":
+            with adoption, deadline_scope(deadline):
+                out = state.shuffle_map(
+                    msg["fragment"], msg["keys"], int(msg["num_parts"]),
+                    msg.get("side", ""), bw,
+                )
+        elif kind == "shuffle_join":
+            with adoption, deadline_scope(deadline):
+                out = state.shuffle_join(msg, bw)
         elif kind == "append":
             with adoption, deadline_scope(deadline):
                 out = state.append(msg["table"], msg["columns"],

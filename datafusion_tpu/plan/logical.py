@@ -269,6 +269,60 @@ class Limit(LogicalPlan):
         }
 
 
+class Join(LogicalPlan):
+    """Two-input equi-join (the variant the reference enum never grew).
+
+    `on` is a list of (left_index, right_index) key pairs, each index
+    positional within its OWN input's schema; `join_type` is "inner"
+    or "left" (LEFT OUTER: unmatched probe rows survive with NULL
+    build-side columns).  The output schema is left's fields followed
+    by right's, with cross-input duplicate names qualified by the
+    planner before the node is built.
+    """
+
+    JOIN_TYPES = ("inner", "left")
+
+    def __init__(
+        self,
+        left: LogicalPlan,
+        right: LogicalPlan,
+        on: Sequence[tuple[int, int]],
+        join_type: str,
+        schema: Schema,
+    ):
+        if join_type not in self.JOIN_TYPES:
+            raise PlanError(f"unknown join type {join_type!r}")
+        self.left = left
+        self.right = right
+        self.on = [(int(l), int(r)) for l, r in on]
+        self.join_type = join_type
+        self._schema = schema
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def children(self):
+        return (self.left, self.right)
+
+    def _fmt(self, lines, indent):
+        on = ", ".join(f"#{l}=#{r}" for l, r in self.on)
+        lines.append("  " * indent + f"Join: type={self.join_type}, on=[{on}]")
+        self.left._fmt(lines, indent + 1)
+        self.right._fmt(lines, indent + 1)
+
+    def to_json(self):
+        return {
+            "Join": {
+                "left": self.left.to_json(),
+                "right": self.right.to_json(),
+                "on": [[l, r] for l, r in self.on],
+                "join_type": self.join_type,
+                "schema": self._schema.to_json(),
+            }
+        }
+
+
 _PLAN_DECODERS = {
     "EmptyRelation": lambda b: EmptyRelation(Schema.from_json(b["schema"])),
     "TableScan": lambda b: TableScan(
@@ -295,5 +349,12 @@ _PLAN_DECODERS = {
     ),
     "Limit": lambda b: Limit(
         b["limit"], LogicalPlan.from_json(b["input"]), Schema.from_json(b["schema"])
+    ),
+    "Join": lambda b: Join(
+        LogicalPlan.from_json(b["left"]),
+        LogicalPlan.from_json(b["right"]),
+        [(p[0], p[1]) for p in b["on"]],
+        b["join_type"],
+        Schema.from_json(b["schema"]),
     ),
 }

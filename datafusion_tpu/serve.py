@@ -450,6 +450,12 @@ class Server:
         self.pins_rehydrated = 0
         self._loop = ServerLoop(pool_size=self._workers,
                                 name="df-tpu-serve")
+        # last observed scan cardinality per table (megabatch passes
+        # record what they scanned): the megabatch cost-apportionment
+        # weights come from these REAL row counts — a member whose
+        # plan also scans a join dimension table weighs more than a
+        # member touching only the shared fact scan
+        self._table_rows: dict = {}
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._window: list[Ticket] = []          # loop thread only
@@ -964,6 +970,34 @@ class Server:
         if rest:
             self._finish(rest[0])
 
+    def _member_weights(self, tickets: list) -> list:
+        """Per-member megabatch cost weights from REAL scan row
+        counts: each member weighs by the total rows of the tables its
+        plan scans (`self._table_rows`, learned from earlier passes).
+        A member whose join also reads a dimension table therefore
+        carries its extra rows; members touching only the shared scan
+        split evenly, and unknown cardinalities (first pass over a
+        table) fall back to the even split — never a zero weight."""
+        from datafusion_tpu.cache import scan_tables
+
+        counts = []
+        for t in tickets:
+            try:
+                known = [self._table_rows.get(n)
+                         for n in scan_tables(t.plan)]
+            except Exception:  # noqa: BLE001 — weighting must not fail a query
+                known = []
+            rows = sum(k for k in known if k)
+            counts.append(rows if rows and all(known) else None)
+        if any(c is None for c in counts):
+            return [1.0 / len(tickets)] * len(tickets)
+        total = float(sum(counts))
+        return [c / total for c in counts]
+
+    def _note_table_rows(self, table: str, rows: int) -> None:
+        if table and rows > 0:
+            self._table_rows[table] = int(rows)
+
     def _mega_key(self, rel):
         """Concrete megabatch grouping key for an already-lowered
         relation — stricter than the plan signature: the relations must
@@ -1032,15 +1066,15 @@ class Server:
 
         Cost apportionment (obs/attribution.py): the whole pass runs
         under a ``shared_scope`` whose members are the tickets'
-        clients weighted by row weight — every member query of a
-        megabatch consumes the SAME shared scan, so row weights
-        degenerate to an even split today (the formula generalizes
-        the moment members contribute unequal row sets).  Launch walls
-        measured in ``device_call`` and H2D bytes at the ledger seam
-        split by those weights automatically; the blob-packed demux
-        pull is timed here and split the same way.  Each ticket's
-        ``launch_share_s`` / ``demux_share_s`` record its share for
-        the critical-path segments."""
+        clients weighted by REAL scan row counts
+        (``_member_weights``): every member consumes the shared scan,
+        but a member whose plan ALSO reads other tables (a join's
+        dimension side) carries those rows in its weight.  Launch
+        walls measured in ``device_call`` and H2D bytes at the ledger
+        seam split by those weights automatically; the blob-packed
+        demux pull is timed here and split the same way.  Each
+        ticket's ``launch_share_s`` / ``demux_share_s`` record its
+        share for the critical-path segments."""
         from datafusion_tpu.exec.aggregate import group_capacity
         from datafusion_tpu.exec.batch import device_inputs
         from datafusion_tpu.exec.expression import compute_aux_values
@@ -1061,8 +1095,10 @@ class Server:
         if type(tickets[0]._rel) is PipelineRelation:
             return self._run_megabatch_pipeline(tickets)
         rels = [t._rel for t in tickets]
-        weight = 1.0 / len(tickets)
-        members = tuple((t.client_id, weight) for t in tickets)
+        weights = self._member_weights(tickets)
+        members = tuple(
+            (t.client_id, w) for t, w in zip(tickets, weights)
+        )
         leader = rels[0]
         core = leader.core
         for r in rels:
@@ -1120,8 +1156,10 @@ class Server:
                 METRICS.add("serve.megabatch_batches", len(idxs))
             chunk.clear()
 
+        rows_seen = 0
         with shared_scope(members) as launch_acc:
             for batch in iter_stats(leader.child):
+                rows_seen += batch.num_rows
                 for idx in core.key_cols:
                     if batch.dicts[idx] is not None:
                         leader._key_dicts[idx] = batch.dicts[idx]
@@ -1160,14 +1198,16 @@ class Server:
                 pull_t0 = time.perf_counter()
                 states = list(device_pull(tuple(states)))
                 pull_s = time.perf_counter() - pull_t0
-                for t in tickets:
-                    t.demux_share_s += pull_s * weight
+                for t, w in zip(tickets, weights):
+                    t.demux_share_s += pull_s * w
+        # next window's weights see what this pass actually scanned
+        self._note_table_rows(leader.child.table_name, rows_seen)
         # the scope's accumulator measured every launch wall the pass
         # dispatched (device_call's own measurement — the same number
         # the meter charged, split by the same weights): each ticket's
         # critical path gets its apportioned share
-        for t in tickets:
-            t.launch_share_s += launch_acc[0] * weight
+        for t, w in zip(tickets, weights):
+            t.launch_share_s += launch_acc[0] * w
         for r, s in zip(rels, states):
             if r is not leader:
                 r._key_dicts.update(leader._key_dicts)
@@ -1178,21 +1218,24 @@ class Server:
         """ONE scan, N TopK queries (`exec.sort.run_topk_megabatch` —
         the `_run_megabatch` twin for ORDER BY ... LIMIT shapes).
         Cost apportionment matches the aggregate lane: the pass runs
-        under a shared scope with even weights, launch walls split by
-        device_call's own measurement, and the single blob-packed
-        result pull splits as each ticket's demux share.  Each
-        relation receives ``_injected_topk``; its `batches()` then
-        skips the scan and runs only the host payload gather."""
+        under a shared scope with real scan-row weights
+        (``_member_weights``), launch walls split by device_call's own
+        measurement, and the single blob-packed result pull splits as
+        each ticket's demux share.  Each relation receives
+        ``_injected_topk``; its `batches()` then skips the scan and
+        runs only the host payload gather."""
         from datafusion_tpu.exec.sort import run_topk_megabatch
         from datafusion_tpu.obs.attribution import shared_scope
 
-        weight = 1.0 / len(tickets)
-        members = tuple((t.client_id, weight) for t in tickets)
+        weights = self._member_weights(tickets)
+        members = tuple(
+            (t.client_id, w) for t, w in zip(tickets, weights)
+        )
         with shared_scope(members) as launch_acc:
             pull_s = run_topk_megabatch([t._rel for t in tickets])
-        for t in tickets:
-            t.launch_share_s += launch_acc[0] * weight
-            t.demux_share_s += pull_s * weight
+        for t, w in zip(tickets, weights):
+            t.launch_share_s += launch_acc[0] * w
+            t.demux_share_s += pull_s * w
 
     def _run_megabatch_pipeline(self, tickets: list[Ticket]) -> None:
         """ONE scan, N filter/project queries
@@ -1204,12 +1247,14 @@ class Server:
         from datafusion_tpu.exec.relation import run_pipeline_megabatch
         from datafusion_tpu.obs.attribution import shared_scope
 
-        weight = 1.0 / len(tickets)
-        members = tuple((t.client_id, weight) for t in tickets)
+        weights = self._member_weights(tickets)
+        members = tuple(
+            (t.client_id, w) for t, w in zip(tickets, weights)
+        )
         with shared_scope(members) as launch_acc:
             run_pipeline_megabatch([t._rel for t in tickets])
-        for t in tickets:
-            t.launch_share_s += launch_acc[0] * weight
+        for t, w in zip(tickets, weights):
+            t.launch_share_s += launch_acc[0] * w
 
     def _finish(self, t: Ticket) -> None:
         """Materialize one ticket's relation and fulfill it (the

@@ -23,6 +23,15 @@ class SqlIdentifier(SqlNode):
 
 
 @dataclass
+class SqlCompoundIdentifier(SqlNode):
+    """Qualified column reference `table.column` (multi-relation FROM
+    clauses need the qualifier to disambiguate duplicate names)."""
+
+    qualifier: str
+    name: str
+
+
+@dataclass
 class SqlWildcard(SqlNode):
     """`*` in a projection or COUNT(*)."""
 
@@ -108,11 +117,23 @@ class SqlOrderByExpr(SqlNode):
     asc: bool = True
 
 
+@dataclass
+class SqlJoin(SqlNode):
+    """`left [INNER|LEFT [OUTER]] JOIN right ON <expr>` — a FROM-clause
+    relation (left-deep chains nest in `left`).  `join_type` is
+    "inner" or "left"."""
+
+    left: SqlNode
+    right: SqlNode
+    join_type: str
+    on: SqlNode
+
+
 # -- statements --
 @dataclass
 class SqlSelect(SqlNode):
     projection: list[SqlNode] = field(default_factory=list)
-    relation: Optional[SqlNode] = None  # SqlIdentifier table name
+    relation: Optional[SqlNode] = None  # SqlIdentifier table or SqlJoin tree
     selection: Optional[SqlNode] = None  # WHERE
     group_by: list[SqlNode] = field(default_factory=list)
     having: Optional[SqlNode] = None

@@ -23,9 +23,11 @@ from datafusion_tpu.plan.expr import (
     ScalarFunction,
     SortExpr,
 )
+from datafusion_tpu.datatypes import Schema
 from datafusion_tpu.plan.logical import (
     Aggregate,
     EmptyRelation,
+    Join,
     Limit,
     LogicalPlan,
     Projection,
@@ -134,6 +136,43 @@ def _push(plan: LogicalPlan, required: set[int]):
         if mapping is _IDENTITY:
             return Limit(plan.limit, new_input, plan.schema), _IDENTITY
         return Limit(plan.limit, new_input, new_input.schema), mapping
+    if isinstance(plan, Join):
+        # split the requirement across the two inputs (join output =
+        # left fields then right fields) and always require the ON keys
+        n_l = len(plan.left.schema)
+        l_req = {i for i in required if i < n_l} | {l for l, _ in plan.on}
+        r_req = {i - n_l for i in required if i >= n_l} | {
+            r for _, r in plan.on
+        }
+        new_left, l_map = _push(plan.left, l_req)
+        new_right, r_map = _push(plan.right, r_req)
+        if l_map is _IDENTITY and r_map is _IDENTITY:
+            return (
+                Join(new_left, new_right, plan.on, plan.join_type,
+                     plan.schema),
+                _IDENTITY,
+            )
+        lm = l_map if l_map is not _IDENTITY else {
+            i: i for i in range(n_l)
+        }
+        rm = r_map if r_map is not _IDENTITY else {
+            i: i for i in range(len(plan.right.schema))
+        }
+        n_l_new = len(new_left.schema)
+        mapping: dict[int, int] = {}
+        for old, new in lm.items():
+            mapping[old] = new
+        for old, new in rm.items():
+            mapping[n_l + old] = n_l_new + new
+        fields = [None] * (n_l_new + len(new_right.schema))
+        for old_pos, new_pos in mapping.items():
+            fields[new_pos] = plan.schema.field(old_pos)
+        on_new = [(lm[l], rm[r]) for l, r in plan.on]
+        return (
+            Join(new_left, new_right, on_new, plan.join_type,
+                 Schema(fields)),
+            mapping,
+        )
     if isinstance(plan, EmptyRelation):
         return plan, _IDENTITY
     raise TypeError(f"unknown LogicalPlan {type(plan).__name__}")

@@ -46,7 +46,15 @@ _CMP_OPS = {"=", "!=", "<>", "<", "<=", ">", ">="}
 _RESERVED_STOP = {
     "FROM", "WHERE", "GROUP", "HAVING", "ORDER", "LIMIT", "BY",
     "ASC", "DESC", "AND", "OR", "NOT", "AS", "IS", "NULL",
+    "JOIN", "ON", "INNER", "LEFT", "OUTER",
 }
+
+# multi-relation FROM is a Python-front-end extension: the C++ parser
+# raises on JOIN grammar (it never returns None for ASCII input), so
+# statements containing the keyword route straight to this parser.  A
+# false positive ('JOIN' inside a string literal) is harmless — the
+# Python parser implements the full grammar.
+_HAS_JOIN = re.compile(r"\bJOIN\b", re.IGNORECASE)
 
 _TYPE_WORDS = {
     "BOOLEAN": ast.SqlType.Boolean,
@@ -150,7 +158,7 @@ class Parser:
             if not self.consume_op(","):
                 break
         if self.parse_keyword("FROM"):
-            sel.relation = ast.SqlIdentifier(self.expect_identifier())
+            sel.relation = self._parse_relation()
         if self.parse_keyword("WHERE"):
             sel.selection = self.parse_expr()
         if self.parse_keywords("GROUP", "BY"):
@@ -178,6 +186,26 @@ class Parser:
         if t.kind != EOF:
             raise ParserError(f"Unexpected trailing token {t} in {self.sql!r}")
         return sel
+
+    def _parse_relation(self) -> ast.SqlNode:
+        """FROM-clause relation: a table name, optionally followed by a
+        left-deep `[INNER|LEFT [OUTER]] JOIN table ON expr` chain."""
+        rel: ast.SqlNode = ast.SqlIdentifier(self.expect_identifier())
+        while True:
+            if self.parse_keyword("JOIN") or self.parse_keywords(
+                "INNER", "JOIN"
+            ):
+                join_type = "inner"
+            elif self.parse_keyword("LEFT"):
+                self.parse_keyword("OUTER")
+                self.expect_keyword("JOIN")
+                join_type = "left"
+            else:
+                return rel
+            right = ast.SqlIdentifier(self.expect_identifier())
+            self.expect_keyword("ON")
+            on = self.parse_expr()
+            rel = ast.SqlJoin(rel, right, join_type, on)
 
     def _parse_create_materialized_view(self) -> ast.SqlCreateMaterializedView:
         name = self.expect_identifier()
@@ -348,6 +376,10 @@ class Parser:
                         self.expect_op(")")
                         break
                 return ast.SqlFunction(t.value, args)
+            if self.consume_op("."):
+                return ast.SqlCompoundIdentifier(
+                    t.value, self.expect_identifier()
+                )
             return ast.SqlIdentifier(t.value)
         raise ParserError(f"Unexpected token {t} in {self.sql!r}")
 
@@ -386,6 +418,9 @@ def parse_sql(sql: str) -> ast.SqlNode:
             raise ParserError(
                 "CREATE MATERIALIZED VIEW requires AS SELECT ...")
         return ast.SqlCreateMaterializedView(m.group(1), query, query_sql)
+    # multi-relation FROM (JOIN) is Python-front-end-only grammar
+    if _HAS_JOIN.search(sql):
+        return Parser(sql).parse_statement()
     node = native_parse_sql(sql)
     if node is not None:
         return node

@@ -45,6 +45,7 @@ from datafusion_tpu.plan.expr import (
 from datafusion_tpu.plan.logical import (
     Aggregate,
     EmptyRelation,
+    Join,
     Limit,
     LogicalPlan,
     Projection,
@@ -92,6 +93,38 @@ def convert_data_type(sql_type: ast.SqlType) -> DataType:
     return _SQL_TYPE_TO_DATATYPE[sql_type]
 
 
+def _strip_cast(e: Expr) -> Expr:
+    # supertype coercion wraps mismatched-width key columns in Casts;
+    # the equi-key extractor wants the underlying column (the executor
+    # compares under numpy promotion)
+    while isinstance(e, Cast):
+        e = e.expr
+    return e
+
+
+def _split_on_conjuncts(
+    expr: Expr, n_left: int
+) -> tuple[list[tuple[int, int]], list[Expr]]:
+    """Decompose a resolved ON expression (combined-schema indices)
+    into equi-key pairs and residual conjuncts.  Returns
+    (pairs, residuals): pairs are (left_index, right_index) with the
+    right index rebased to the right input's own schema; any conjunct
+    that is not a cross-side column equality is a residual."""
+    if isinstance(expr, BinaryExpr) and expr.op == Operator.And:
+        p1, r1 = _split_on_conjuncts(expr.left, n_left)
+        p2, r2 = _split_on_conjuncts(expr.right, n_left)
+        return p1 + p2, r1 + r2
+    if isinstance(expr, BinaryExpr) and expr.op == Operator.Eq:
+        l = _strip_cast(expr.left)
+        r = _strip_cast(expr.right)
+        if isinstance(l, Column) and isinstance(r, Column):
+            if l.index < n_left <= r.index:
+                return [(l.index, r.index - n_left)], []
+            if r.index < n_left <= l.index:
+                return [(r.index, l.index - n_left)], []
+    return [], [expr]
+
+
 class SchemaProvider(Protocol):
     """Catalog seam (reference `sqlplanner.rs:28-31`)."""
 
@@ -115,7 +148,61 @@ class SqlToRel:
             if schema is None:
                 raise PlanError(f"no schema found for table {node.name}")
             return TableScan("default", node.name, schema, None)
+        if isinstance(node, ast.SqlJoin):
+            return self._plan_join(node)[0]
         raise NotSupportedError(f"sql_to_rel does not support this relation: {node!r}")
+
+    def _plan_relation(self, node: ast.SqlNode) -> tuple[LogicalPlan, list[str]]:
+        """Plan a FROM-clause relation, returning the plan plus one
+        source-table qualifier per output column (what duplicate-name
+        qualification renames by)."""
+        if isinstance(node, ast.SqlIdentifier):
+            plan = self.sql_to_rel(node)
+            return plan, [node.name] * len(plan.schema)
+        if isinstance(node, ast.SqlJoin):
+            return self._plan_join(node)
+        raise NotSupportedError(
+            f"unsupported FROM-clause relation: {node!r}"
+        )
+
+    def _plan_join(self, node: ast.SqlJoin) -> tuple[LogicalPlan, list[str]]:
+        """Plan `left [INNER|LEFT] JOIN right ON expr`.
+
+        The output schema is left's fields then right's; a bare name
+        present on BOTH sides is qualified as ``table.name`` on each
+        (so either spelling stays resolvable downstream).  The ON
+        expression resolves against that combined schema; its
+        equality conjuncts between opposite sides become the Join's
+        key pairs and every other conjunct survives as a Selection
+        over the join (a residual filter, evaluated after the match).
+        LEFT OUTER marks every right-side output column nullable —
+        unmatched probe rows carry NULLs there.
+        """
+        left, lq = self._plan_relation(node.left)
+        right, rq = self._plan_relation(node.right)
+        ls, rs = left.schema, right.schema
+        lset = {f.name for f in ls.fields}
+        rset = {f.name for f in rs.fields}
+        fields: list[Field] = []
+        for f, q in zip(ls.fields, lq):
+            name = f.name if f.name not in rset else f"{q}.{f.name}"
+            fields.append(Field(name, f.data_type, f.nullable))
+        right_null = node.join_type == "left"
+        for f, q in zip(rs.fields, rq):
+            name = f.name if f.name not in lset else f"{q}.{f.name}"
+            fields.append(Field(name, f.data_type, f.nullable or right_null))
+        combined = Schema(fields)
+        on_expr = self.sql_to_rex(node.on, combined)
+        pairs, residual = _split_on_conjuncts(on_expr, len(ls))
+        if not pairs:
+            raise PlanError(
+                "JOIN requires at least one left.col = right.col "
+                f"equality in ON, got {node.on!r}"
+            )
+        plan: LogicalPlan = Join(left, right, pairs, node.join_type, combined)
+        for r in residual:
+            plan = Selection(r, plan)
+        return plan, lq + rq
 
     def _plan_select(self, sel: ast.SqlSelect) -> LogicalPlan:
         if sel.relation is not None:
@@ -304,6 +391,17 @@ class SqlToRel:
         if isinstance(node, ast.SqlIdentifier):
             # name -> positional index (reference sqlplanner.rs:214-223)
             return Column(schema.index_of(node.name))
+        if isinstance(node, ast.SqlCompoundIdentifier):
+            # qualified `table.column`: duplicate-name columns were
+            # renamed to the literal "table.column" by the join planner;
+            # a unique bare name resolves by name alone (the qualifier
+            # is then redundant and not re-checked)
+            try:
+                return Column(
+                    schema.index_of(f"{node.qualifier}.{node.name}")
+                )
+            except InvalidColumnError:
+                return Column(schema.index_of(node.name))
         if isinstance(node, ast.SqlNested):
             return self.sql_to_rex(node.expr, schema)
         if isinstance(node, ast.SqlCast):

@@ -60,6 +60,9 @@ python scripts/serve_smoke.py
 echo "== ingest smoke (streaming appends: kill -9 mid-append + ingest-log recovery, 30% seeded wal fsync faults, live view subscription) =="
 python scripts/ingest_smoke.py
 
+echo "== join smoke (2-worker shuffle joins: Q3-shaped 3-table exact, SIGKILL failover, warm pinned-build zero-H2D probe) =="
+python scripts/join_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"
