@@ -13,12 +13,13 @@ D2H) is reported separately with a per-phase breakdown under
 
 Env knobs: BENCH_SF (lineitem scale factor for config 3, default 1),
 BENCH_CONFIGS (comma list, default
-"1,2,3,4,5,3sf10,worker,cache,conc,ingest,joins" —
+"1,2,3,4,5,3sf10,worker,cache,conc,ingest,joins,adaptive" —
 "3sf10" runs Q1 at the north-star SF-10 scale, "worker" runs the
 coordinator->worker-on-chip parity smoke and writes
 artifacts/TPU_WORKER_SMOKE.json, "cache" runs the result-cache
 warm-repeat phase, "joins" runs the TPC-H Q3/Q5/Q10/Q12 join shapes
-against a pandas-merge oracle), BENCH_RUNS / BENCH_COLD_RUNS.
+against a pandas-merge oracle, "adaptive" runs the cost-store
+cold-vs-trained planning comparison), BENCH_RUNS / BENCH_COLD_RUNS.
 """
 
 import json
@@ -37,7 +38,8 @@ def main():
     device_kind = "cpu" if platforms == {"cpu"} else "tpu"
 
     wanted = os.environ.get(
-        "BENCH_CONFIGS", "1,2,3,4,5,3sf10,worker,cache,conc,ingest,joins"
+        "BENCH_CONFIGS",
+        "1,2,3,4,5,3sf10,worker,cache,conc,ingest,joins,adaptive",
     ).split(",")
     runners = {
         "1": suite.config1_csv_filter,
@@ -65,6 +67,10 @@ def main():
         # join, gated on pandas-merge parity + a warm pinned-probe
         # launches-per-pass ceiling
         "joins": suite.config_joins,
+        # feedback-driven planning: same workload cold vs trained
+        # (persisted cost store), gated on >=2 decision flips,
+        # bit-exact rows, >=1.2x on the mis-defaulted aggregate
+        "adaptive": suite.config_adaptive,
     }
     if float(os.environ.get("BENCH_SF", 1)) == 10 and "3" in [
         w.strip() for w in wanted

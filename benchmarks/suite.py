@@ -1167,3 +1167,74 @@ def config_joins(device_kind: str):
         "vs_baseline": 1.0,  # parity leg: pass/fail, not a speed ratio
         **results,
     }
+
+
+def config_adaptive(device_kind: str):
+    """Feedback-driven planning (datafusion_tpu/cost): the same
+    workload cold (empty cost store) vs trained (statistics persisted
+    by the cold leg, loaded by a fresh process).
+
+    Each leg is a SUBPROCESS so it pays its own compiles — the whole
+    point is that the trained leg's pre-sized aggregate compiles ONE
+    sort-merge kernel where the cold leg climbs the capacity regrow
+    ladder, and its join builds the smaller side.  Gates: at least two
+    decision classes flip, results bit-exact across legs, and the
+    mis-defaulted aggregate shape speeds up >= 1.2x."""
+    import importlib.util
+    import json as _json
+    import subprocess
+    import tempfile
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    smoke_path = os.path.join(repo, "scripts", "adaptive_smoke.py")
+    spec = importlib.util.spec_from_file_location("_adaptive", smoke_path)
+    smoke = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(smoke)
+
+    tmpdir = tempfile.mkdtemp(prefix="df-tpu-bench-adaptive-")
+    smoke._write_tables(tmpdir)
+
+    def leg(label, cost="1"):
+        env = dict(os.environ)
+        env["DATAFUSION_TPU_COST_DIR"] = tmpdir
+        env["DATAFUSION_TPU_COST"] = cost
+        env.setdefault("DATAFUSION_TPU_FUSE_GROUP", "8")
+        out = subprocess.run(
+            [sys.executable, smoke_path, "--leg", tmpdir],
+            cwd=repo, env=env, capture_output=True, text=True,
+            timeout=600,
+        )
+        assert out.returncode == 0, (
+            f"adaptive {label} leg failed:\n{out.stderr[-4000:]}")
+        r = _json.loads(out.stdout.strip().splitlines()[-1])
+        log(f"    {label}: agg {r['agg_wall_s'] * 1e3:.0f} ms, "
+            f"decisions {r['decisions'] or '[]'}")
+        return r
+
+    log("  config adaptive: cold vs trained planning")
+    cold = leg("cold")
+    trained = leg("trained")
+    changed = sorted(set(trained["decisions"]) - set(cold["decisions"]))
+    assert len(changed) >= 2, (
+        f"expected >=2 decision classes to flip, got {changed}")
+    assert trained["agg_rows"] == cold["agg_rows"], (
+        "trained aggregate rows diverged from cold")
+    assert trained["join_rows"] == cold["join_rows"], (
+        "trained join rows diverged from cold")
+    speedup = cold["agg_wall_s"] / max(trained["agg_wall_s"], 1e-9)
+    assert speedup >= 1.2, (
+        f"trained aggregate speedup {speedup:.2f}x below the 1.2x gate "
+        f"(cold {cold['agg_wall_s']:.3f}s, "
+        f"trained {trained['agg_wall_s']:.3f}s)")
+    log(f"    trained speedup on the mis-defaulted aggregate: "
+        f"{speedup:.2f}x, decisions flipped: {changed}")
+    return {
+        "name": "adaptive_planning",
+        "rows": smoke.ROWS,
+        "unit": "speedup",
+        "value": round(speedup, 3),
+        "cold_agg_ms": round(cold["agg_wall_s"] * 1e3, 1),
+        "trained_agg_ms": round(trained["agg_wall_s"] * 1e3, 1),
+        "decisions_changed": changed,
+        "vs_baseline": round(speedup, 3),
+    }

@@ -27,8 +27,9 @@ class of bug that once cost a debugging session:
   classification the retry layer depends on.
 - **DF005 lock-in-metrics-callback** — no lock acquisition inside
   ``utils/metrics.py``, the ambient-operator ``record_*`` callbacks
-  (``obs/stats.py``), or the hedge tracker's evidence path
-  (``utils/hedge.py``): they run inside other subsystems' critical
+  (``obs/stats.py``), the hedge tracker's evidence path
+  (``utils/hedge.py``), or the cost store's observe/lookup path
+  (``cost/store.py``): they run inside other subsystems' critical
   sections (CacheStore eviction, retry loops, dispatch threads),
   where taking a lock would build silent lock-order edges.
 - **DF007 blocking-io-in-sampler** — no blocking IO (file/socket/HTTP
@@ -334,6 +335,14 @@ class LockInMetricsCallback(_Rule):
                         "observe_path", "observe_phases",
                         "current_scope", "current_client",
                         "client_scope", "shared_scope")
+    # the cost store's observe/lookup path (cost/store.py): observations
+    # arrive from scan generators, aggregate finalizers, the join build
+    # path and the serving loop — some of those run inside other
+    # subsystems' critical sections.  Fresh-dict publish + GIL-atomic
+    # deque appends are the contract; this list enforces it.  (flush()
+    # and _load() are cold persistence seams, deliberately NOT listed.)
+    _COST_FNS = ("observe", "lookup", "value", "note_decision",
+                 "note_replan")
 
     def applies(self, relpath: str) -> bool:
         p = relpath.replace(os.sep, "/")
@@ -341,7 +350,7 @@ class LockInMetricsCallback(_Rule):
                            "obs/recorder.py", "obs/aggregate.py",
                            "obs/slo.py", "obs/device.py",
                            "obs/profiler.py", "utils/hedge.py",
-                           "obs/attribution.py"))
+                           "obs/attribution.py", "cost/store.py"))
 
     def _scan(self, node, relpath, where):
         out = []
@@ -393,6 +402,8 @@ class LockInMetricsCallback(_Rule):
             wanted = self._HEDGE_FNS
         elif p.endswith("obs/attribution.py"):
             wanted = self._ATTRIBUTION_FNS
+        elif p.endswith("cost/store.py"):
+            wanted = self._COST_FNS
         else:
             wanted = self._STATS_FNS
         out = []
@@ -532,6 +543,10 @@ class BlockingDiskIoUnderLock(_Rule):
             return df5._ATTRIBUTION_FNS
         if p.endswith("obs/stats.py"):
             return df5._STATS_FNS
+        if p.endswith("cost/store.py"):
+            # the cost observe path is DF005 lock-free AND disk-free:
+            # persistence happens only in flush()/_load() (cold seams)
+            return df5._COST_FNS
         return ()
 
     def check(self, tree, relpath):

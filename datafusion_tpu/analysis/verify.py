@@ -86,6 +86,26 @@ def verify_enabled() -> bool:
     return os.environ.get("DATAFUSION_TPU_VERIFY", "1").lower() not in _FALSY
 
 
+def assert_schema_preserved(before: Schema, after: Schema,
+                            what: str = "rewrite") -> None:
+    """The cost-optimizer contract: a cost-driven physical choice
+    (build-side swap, dimension reorder, chunk resize) may change HOW
+    a plan runs, never WHAT it returns — the rewritten plan's inferred
+    schema must equal the original field-for-field (name, type,
+    nullability).  Raises `PlanVerificationError` on any drift, which
+    the planner treats as "discard the rewrite", so a buggy rewrite
+    can degrade performance but never correctness."""
+    if before == after:
+        return
+    want = ", ".join(f"{f.name}: {f.data_type!r}" for f in before.fields)
+    got = ", ".join(f"{f.name}: {f.data_type!r}" for f in after.fields)
+    raise PlanVerificationError(
+        f"{what} changed the inferred schema: expected ({want}), "
+        f"got ({got})",
+        [Diagnostic("root", f"{what} must preserve the plan schema")],
+    )
+
+
 class Diagnostic:
     """One verification finding, anchored to a plan location."""
 

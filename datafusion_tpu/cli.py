@@ -351,6 +351,12 @@ class Console:
             # appendable tables, view revisions + freshness lags, WAL
             self._ingest_status()
             return True
+        if cmd == "\\cost":
+            # cost/statistics store introspection (datafusion_tpu/cost):
+            # learned per-(table, shape) observations, recent planner
+            # decisions (chosen vs default) and runtime replans
+            self._cost_status()
+            return True
         if cmd.startswith("\\append"):
             # \append <table> {"col": [v, ...], ...} — one durable
             # delta through the same append path the wire uses
@@ -390,6 +396,39 @@ class Console:
             )
         if not st["tables"] and not st["views"]:
             self._print("  (no appendable tables or materialized views)")
+
+    def _cost_status(self) -> None:
+        from datafusion_tpu import cost as _cost
+
+        snap = _cost.store().snapshot()
+        state = "on" if _cost.enabled() else "off (DATAFUSION_TPU_COST=0)"
+        where = snap["path"] or "in-memory"
+        self._print(
+            f"Cost store: {snap['entries']} entr(ies), "
+            f"adaptive planning {state}, persisted to {where}"
+        )
+        for tkey, shapes in sorted(snap["tables"].items()):
+            self._print(f"  {tkey}:")
+            for shape, rec in sorted(shapes.items()):
+                facts = ", ".join(
+                    f"{k}={rec[k]:.4g}" for k in sorted(rec)
+                    if k not in ("n", "ts") and not k.endswith("_last")
+                    and not k.endswith("_max")
+                )
+                self._print(f"    {shape}: n={rec.get('n', 0)} ({facts})")
+        for d in snap["decisions"][-8:]:
+            where = f" [{d['table']}]" if d.get("table") else ""
+            self._print(
+                f"  decision {d['decision']}{where}: chose {d['chosen']} "
+                f"(default {d['default']}) — {d['reason']}"
+            )
+        for r in snap["replans"][-4:]:
+            self._print(
+                f"  replan {r['what']}: estimated {r['estimate']}, "
+                f"observed {r['actual']} — {r['action']}"
+            )
+        if not snap["tables"]:
+            self._print("  (no observations yet)")
 
     def _append(self, arg: str) -> None:
         import json

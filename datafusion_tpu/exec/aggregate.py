@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import time
 from typing import Iterator, Optional
 
 import jax
@@ -102,6 +103,20 @@ def _pallas_agg_max() -> int:
     from datafusion_tpu.exec import pallas as _pallas
 
     return _pallas.agg_max_groups()
+
+
+def _agg_window() -> int:
+    """Pallas hash-agg engagement ceiling: the cost subsystem's learned
+    window when runtime history warrants deviating (datafusion_tpu/
+    cost/advisor.py), else the static env threshold — byte-identical
+    routing under DATAFUSION_TPU_COST=0 or a cold store."""
+    from datafusion_tpu import cost as _cost
+
+    if _cost.enabled():
+        from datafusion_tpu.cost import advisor
+
+        return advisor.pallas_agg_window()
+    return _pallas_agg_max()
 
 
 def _probe_hash_agg():
@@ -670,7 +685,7 @@ class _AggregateCore:
         group_cap = counts.shape[0]
         if group_cap <= DENSE_GROUP_MAX:
             return self._dense_update(env, capacity, mask, ids, counts, accs, str_aux)
-        if self._pallas_agg and group_cap <= _pallas_agg_max():
+        if self._pallas_agg and group_cap <= _agg_window():
             return self._pallas_update(env, capacity, mask, ids, counts, accs, str_aux)
         return self._sortmerge_update(env, capacity, mask, ids, counts, accs, str_aux)
 
@@ -937,7 +952,7 @@ class _AggregateCore:
         counts, _ = state
         G = counts.shape[0]
         if G <= DENSE_GROUP_MAX or (
-            self._pallas_agg and G <= _pallas_agg_max()
+            self._pallas_agg and G <= _agg_window()
         ):
             stacked = stack_entries(entries)
 
@@ -1244,6 +1259,18 @@ class AggregateRelation(Relation):
         self._key_dicts: dict[int, StringDictionary] = {}
         self._str_dicts: dict[int, StringDictionary] = {}
         self._str_aux_cache: dict = {}
+        # feedback-driven planning (datafusion_tpu/cost): the plan->
+        # operator boundary fills these when the scanned table has
+        # learned statistics — `_cost_hint` (estimated group count)
+        # pre-sizes the accumulator at first flush, `_cost_obs`
+        # ((table key, shape)) says where finalize() records actuals
+        self._cost_hint: Optional[int] = None
+        self._cost_obs: Optional[tuple] = None
+        self._cost_planned_cap = 0
+        self._cost_replans = 0
+        self._cost_exec_s = 0.0
+        self._cost_rows = 0
+        self._cost_route: Optional[tuple] = None
         # serializes GroupKeyEncoder mutation: normally only the staging
         # producer encodes, but a cache-pin miss (another relation
         # scanning the same batches overwrote the group_ids slot) makes
@@ -1319,6 +1346,84 @@ class AggregateRelation(Relation):
         if needed <= max(current, DENSE_GROUP_MAX):
             return max(needed, current)
         return group_capacity(4 * n)
+
+    # -- feedback-driven sizing (datafusion_tpu/cost) -------------------
+    def _cost_presize(self, needed: int) -> int:
+        """First-flush capacity under a learned group-count hint.
+
+        Normally returns the hint's capacity (>= the chunk's actual
+        need), committing to the final route up front.  But the hint is
+        checked against the chunk's ALREADY-ENCODED group count first —
+        host-side facts, no device work yet — and a miss beyond the
+        configured ratio in either direction aborts the pre-sized plan:
+        the corrected cardinality is recorded immediately and the
+        capacity re-derives from actuals, exactly as a cold run would.
+        """
+        hint = self._cost_hint
+        if not hint:
+            return needed
+        from datafusion_tpu import cost as _cost
+
+        planned = group_capacity(int(hint))
+        actual = max(self.encoder.num_groups, 1)
+        ratio = _cost.replan_ratio()
+        if planned > needed * ratio or actual > int(hint) * ratio:
+            self._note_replan(
+                int(hint), actual,
+                f"pre-size {planned} aborted, capacity {needed} from actuals",
+            )
+            return needed
+        self._cost_planned_cap = max(planned, needed)
+        return self._cost_planned_cap
+
+    def _cost_misestimate(self, needed: int) -> None:
+        """A later flush outgrew the pre-sized capacity: the estimate
+        undershot.  Record the replan once; growth itself proceeds on
+        the normal 4x-headroom ladder."""
+        self._cost_planned_cap = 0
+        self._note_replan(
+            int(self._cost_hint or 0), self.encoder.num_groups,
+            f"pre-sized accumulator outgrown, regrow to {needed}",
+        )
+
+    def _note_replan(self, estimate: int, actual: int, action: str) -> None:
+        from datafusion_tpu import cost as _cost
+        from datafusion_tpu.obs import recorder
+
+        self._cost_replans += 1
+        METRICS.add("plan.replans")
+        recorder.record(
+            "query.replan", op="aggregate", estimate=estimate,
+            actual=actual, action=action,
+        )
+        store = _cost.store()
+        if self._cost_obs is not None:
+            # corrected stats land NOW, not at finalize: a query that
+            # fails after the replan still teaches the next one
+            store.observe(self._cost_obs[0], self._cost_obs[1],
+                          groups=actual)
+        store.note_replan("aggregate.capacity", estimate, actual, action)
+
+    def _cost_observe_done(self) -> None:
+        """Finalize-time observation: actual group cardinality for the
+        (table, GROUP BY shape) this relation was annotated with, and
+        the route/wall evidence the Pallas window learner feeds on.
+        Lock-free store writes; no-op for unannotated relations."""
+        obs, route = self._cost_obs, self._cost_route
+        if obs is None and (route is None or route[0] == "dense"):
+            return
+        from datafusion_tpu import cost as _cost
+
+        store = _cost.store()
+        if obs is not None and self.key_cols and self.encoder.num_groups:
+            store.observe(obs[0], obs[1], groups=self.encoder.num_groups)
+        if route is not None and route[0] != "dense" and self._cost_rows:
+            from datafusion_tpu.cost import advisor
+
+            advisor.observe_agg_route(
+                store, route[0], route[1], self._cost_exec_s,
+                self._cost_rows,
+            )
 
     def _decide_placement(self, batch) -> Optional[_Placement]:
         """Link-aware split of the SELECT-list aggregates between host
@@ -1586,14 +1691,35 @@ class AggregateRelation(Relation):
             # so every id in the chunk fits the accumulator
             needed = self._pick_capacity(capacity)
             if state is None:
+                # learned-cardinality pre-size (datafusion_tpu/cost):
+                # jump straight to the final capacity — and with it the
+                # dense/Pallas/sort-merge route — instead of climbing
+                # the regrow ladder (each rung past the dense bound
+                # compiles a fresh sort-merge kernel).  The check
+                # against the chunk's already-encoded actuals happens
+                # HERE, before any device launch: a wild misestimate
+                # aborts the pre-sized plan while it is still cheap
+                needed = self._cost_presize(needed)
                 capacity = needed
                 state = core._init_state(capacity)
             elif needed > capacity:
+                if 0 < getattr(self, "_cost_planned_cap", 0) < needed:
+                    self._cost_misestimate(needed)
                 state = core._grow_state(state, needed)
                 capacity = needed
+            t0 = time.perf_counter()
             with METRICS.timer("execute.aggregate"), op_timer(self), \
                     device_scope(self.device):
                 state = dispatch_chunk(state)
+            self._cost_exec_s += time.perf_counter() - t0
+            self._cost_rows += sum(int(c[3]) for c in chunk)
+            self._cost_route = (
+                "dense" if capacity <= DENSE_GROUP_MAX
+                else "pallas"
+                if core._pallas_agg and capacity <= _agg_window()
+                else "sortmerge",
+                capacity,
+            )
             if self._op_stats is not None:
                 self.stats.attrs["fused_batches"] = (
                     self.stats.attrs.get("fused_batches", 0) + len(chunk)
@@ -1866,6 +1992,7 @@ class AggregateRelation(Relation):
         return np.asarray(counts), [np.asarray(a) for a in accs]
 
     def finalize(self, state) -> RecordBatch:
+        self._cost_observe_done()
         if isinstance(state, tuple) and len(state) == 3 and state[0] == "hostsplit":
             return self._finalize_split(state[1], state[2])
         counts, accs = self._pull_state(state)

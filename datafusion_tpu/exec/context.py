@@ -264,6 +264,12 @@ class ExecutionContext:
                 f"Registered materialized view {stmt.name} "
                 f"({'incremental' if view.incremental else 'recompute'})")
         if isinstance(stmt, ast.SqlExplain):
+            # mark the cost store's decision serial BEFORE planning so
+            # EXPLAIN ANALYZE can attribute the rewrite decisions made
+            # while optimizing THIS statement (join order / build side)
+            from datafusion_tpu import cost as _cost
+
+            decision_mark = _cost.store().decision_serial
             plan = self._plan(stmt.stmt)
             if stmt.analyze:
                 # EXPLAIN ANALYZE executes the query under a trace
@@ -271,7 +277,8 @@ class ExecutionContext:
                 # stats (obs/explain.py)
                 from datafusion_tpu.obs.explain import explain_analyze
 
-                return explain_analyze(self, plan)
+                return explain_analyze(
+                    self, plan, decision_mark=decision_mark)
             if stmt.verify:
                 # EXPLAIN VERIFY type-checks the plan WITHOUT executing
                 # and renders the inferred schema per operator
@@ -301,8 +308,26 @@ class ExecutionContext:
         if self._optimize:
             with METRICS.timer("optimize"):
                 plan = push_down_projection(plan)
+                plan = self._cost_rewrite(plan)
         recorder.record("query.plan", plan=type(plan).__name__)
         return plan
+
+    def _cost_rewrite(self, plan: LogicalPlan) -> LogicalPlan:
+        """Cost-driven logical rewrites (join build side / order —
+        datafusion_tpu/cost/optimizer.py).  Advisory by contract: any
+        failure — including the verifier vetoing a schema-changing
+        rewrite — discards the rewrite and keeps the static plan."""
+        from datafusion_tpu import cost as _cost
+
+        if not _cost.enabled():
+            return plan
+        try:
+            from datafusion_tpu.cost.optimizer import apply_cost_rewrites
+
+            return apply_cost_rewrites(self, plan)
+        except Exception:  # noqa: BLE001 — cost rewrites must never fail a query
+            METRICS.add("cost.rewrite_errors")
+            return plan
 
     def _execute_ddl(self, stmt: ast.SqlCreateExternalTable) -> DdlResult:
         # the intent the reference commented out (context.rs:47-75)
@@ -385,6 +410,11 @@ class ExecutionContext:
                      **rel.stats.snapshot()}
                     for depth, rel in collect_tree(root)
                 ]
+        # query completion is the cost store's persistence seam: cold
+        # path, no locks held, throttled internally (cost/store.flush)
+        from datafusion_tpu import cost as _cost
+
+        _cost.flush()
         hist = self._stats_history.setdefault(fingerprint, [])
         hist.append(entry)
         del hist[: -self._history_cap]
@@ -498,6 +528,92 @@ class ExecutionContext:
         with METRICS.timer("verify"):
             _averify.check_plan(plan, functions=self.functions)
 
+    # -- feedback-driven planning seams (datafusion_tpu/cost) ----------
+    def cost_table_key(self, name: str) -> str:
+        """Stable cost-store identity of table `name`'s current data
+        (datafusion_tpu/cost.table_key; falls back to the bare name)."""
+        from datafusion_tpu import cost as _cost
+
+        try:
+            return _cost.table_key(self, name)
+        except Exception:  # noqa: BLE001 — keying must never fail a query
+            return name
+
+    def _cost_scan_source(self, name: str, ds):
+        """Learned scan chunk sizing: rebuild the datasource with a
+        batch size matched to the measured device link and the table's
+        observed bytes/row (cost/advisor.scan_chunk_rows).  Identity on
+        host-speed links, reusable in-memory sources, cold stores, or
+        with the subsystem disabled."""
+        from datafusion_tpu import cost as _cost
+
+        if not _cost.enabled() or getattr(ds, "reusable_batches", False):
+            return ds
+        cur = getattr(ds, "batch_size", None)
+        if not cur:
+            return ds
+        try:
+            from datafusion_tpu.cost import advisor
+
+            rows = advisor.scan_chunk_rows(
+                _cost.store(), self.cost_table_key(name), self.device, cur
+            )
+        except Exception:  # noqa: BLE001 — sizing is advisory
+            return ds
+        if rows is None or rows == cur:
+            return ds
+        import copy
+
+        sized = copy.copy(ds)
+        sized.batch_size = rows
+        return sized
+
+    def _cost_annotate_aggregate(self, rel: AggregateRelation,
+                                 plan: LogicalPlan) -> AggregateRelation:
+        """Wire an AggregateRelation into the cost loop: where its
+        actual group cardinality should be recorded, and — when the
+        store already knows this (table, GROUP BY shape) — the
+        estimated group count that pre-sizes the accumulator."""
+        from datafusion_tpu import cost as _cost
+        from datafusion_tpu.plan.expr import Column as _Col
+
+        if not rel.key_cols:
+            return rel
+        try:
+            from datafusion_tpu.cache import scan_tables
+
+            tables = scan_tables(plan)
+        except Exception:  # noqa: BLE001 — annotation is advisory
+            return rel
+        if len(tables) != 1:
+            return rel
+        sch = rel.child.schema
+        names = [
+            sch.field(e.index).name
+            if isinstance(e, _Col) and e.index < len(sch) else repr(e)
+            for e in rel._group_expr
+        ]
+        from datafusion_tpu.cost import advisor
+
+        tkey = self.cost_table_key(tables[0])
+        shape = advisor.agg_shape(names)
+        rel._cost_obs = (tkey, shape)  # observation flows even when off
+        if not _cost.enabled():
+            return rel
+        store = _cost.store()
+        est = advisor.agg_group_estimate(store, tkey, names)
+        if est:
+            from datafusion_tpu.exec.aggregate import group_capacity
+
+            rel._cost_hint = int(est)
+            rel._cost_decisions = [store.note_decision(
+                "agg.capacity", group_capacity(int(est)),
+                "grow-on-demand from 8",
+                f"observed ~{int(est)} groups for {shape}",
+                table=tables[0],
+            )]
+        return rel
+
     def _execute_plan(self, plan: LogicalPlan) -> Relation:
         fns = self._jax_functions()
         if fused.fusion_enabled():
@@ -510,10 +626,14 @@ class ExecutionContext:
                 raise ExecutionError(f"No datasource registered as {plan.table_name!r}")
             if plan.projection is not None:
                 ds = ds.with_projection(plan.projection)
+            ds = self._cost_scan_source(plan.table_name, ds)
             # the table name rides the relation so the datasource
             # boundary can feed the per-table scan histograms
-            # (`scan.<table>.latency` / `scan.<table>.bytes`)
-            return DataSourceRelation(ds, table_name=plan.table_name)
+            # (`scan.<table>.latency` / `scan.<table>.bytes`) and the
+            # cost store's per-table row statistics
+            rel = DataSourceRelation(ds, table_name=plan.table_name)
+            rel._cost_key = self.cost_table_key(plan.table_name)
+            return rel
         if isinstance(plan, EmptyRelation):
             return _EmptyRelationExec()
         if isinstance(plan, Selection):
@@ -543,10 +663,10 @@ class ExecutionContext:
             else:
                 child = self.execute(plan.input)
                 pred = None
-            return AggregateRelation(
+            return self._cost_annotate_aggregate(AggregateRelation(
                 child, plan.group_expr, plan.aggr_expr, plan.schema,
                 predicate=pred, functions=fns, device=self.device,
-            )
+            ), plan)
         if isinstance(plan, Sort):
             return SortRelation(
                 self.execute(plan.input), plan.expr, plan.schema, device=self.device
@@ -578,11 +698,25 @@ class ExecutionContext:
                 )
             except PlanError:
                 build_key = None
-            return HashJoinRelation(
+            rel = HashJoinRelation(
                 self.execute(plan.left), self.execute(plan.right),
                 plan.on, plan.join_type, plan.schema,
                 device=self.device, build_key=build_key,
             )
+            # build-side observation target: a single-table build side
+            # feeds the row statistics the build-side/order rewrites
+            # (cost/optimizer.py) decide from
+            try:
+                from datafusion_tpu.cache import scan_tables as _scan_tables
+
+                rtabs = _scan_tables(plan.right)
+                if len(rtabs) == 1:
+                    rel._cost_obs = (
+                        self.cost_table_key(rtabs[0]), "join-build"
+                    )
+            except Exception:  # noqa: BLE001 — annotation is advisory
+                pass
+            return rel
         raise ExecutionError(f"Cannot execute plan node {type(plan).__name__}")
 
     def _execute_fused(self, plan: LogicalPlan, fns) -> Optional[Relation]:
@@ -612,7 +746,7 @@ class ExecutionContext:
             except (NotSupportedError, PlanError):
                 return None  # inlined shape the kernel can't take
             rel._fused_chain = "filter+project+aggregate"
-            return rel
+            return self._cost_annotate_aggregate(rel, plan)
 
         if isinstance(plan, (Selection, Projection)):
             flat = fused.flatten_chain(plan)

@@ -136,6 +136,7 @@ class DataSourceRelation(Relation):
 
         produce_s = 0.0
         nbytes = 0
+        rows = 0
         it = self.datasource.batches()
         try:
             while True:
@@ -146,6 +147,7 @@ class DataSourceRelation(Relation):
                     return
                 finally:
                     produce_s += _time.perf_counter() - t0
+                rows += batch.num_rows
                 for arr in batch.data:
                     if isinstance(arr, np.ndarray):
                         nbytes += arr.nbytes
@@ -157,6 +159,18 @@ class DataSourceRelation(Relation):
             # observed once per scan, abandoned scans (bare LIMIT)
             # included — partial work is still work the table cost us
             observe_scan(self.table_name, produce_s, nbytes)
+            # ... and the cost store learns the table's cardinality and
+            # bytes/row (the planner's row statistics — cost/advisor).
+            # `rows_max` semantics there keep an abandoned partial scan
+            # from shrinking the learned row count.  Lock-free observe.
+            ckey = getattr(self, "_cost_key", None)
+            if ckey is not None and rows:
+                from datafusion_tpu import cost as _cost
+
+                _cost.store().observe(
+                    ckey, "scan",
+                    rows=rows, nbytes=nbytes, produce_s=produce_s,
+                )
 
 
 def _host_routed(e, metas, in_schema, host_scalar: bool) -> bool:

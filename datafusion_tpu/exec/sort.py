@@ -75,6 +75,23 @@ def _probe_bitonic_sort():
     np.asarray(out)
 
 
+def _sort_window() -> int:
+    """Pallas bitonic-sort engagement ceiling: the cost subsystem's
+    learned window when runtime history warrants deviating
+    (cost/advisor.pallas_sort_window), else the static env threshold —
+    byte-identical routing under DATAFUSION_TPU_COST=0 or a cold
+    store."""
+    from datafusion_tpu import cost as _cost
+
+    if _cost.enabled():
+        from datafusion_tpu.cost import advisor
+
+        return advisor.pallas_sort_window()
+    from datafusion_tpu.exec import pallas as _pallas
+
+    return _pallas.sort_max_rows()
+
+
 def _np_sort_key(
     values: np.ndarray,
     validity: Optional[np.ndarray],
@@ -1317,7 +1334,7 @@ class SortRelation(Relation):
                 np.dtype(getattr(o, "dtype", None)) == np.int64
                 for o in dev_ops
             )
-            and dev_ops[0].shape[0] <= _pallas.sort_max_rows()
+            and dev_ops[0].shape[0] <= _sort_window()
         )
         interp = _pallas.interpret_mode()
         if use_pallas and not interp:
@@ -1349,9 +1366,22 @@ class SortRelation(Relation):
             run_jit = SortRelation._SORT_RUN_JITS[jit_key] = jax.jit(run_sort)
         if use_pallas:
             METRICS.add("sort.pallas_runs")
+        import time as _time
+
+        t0 = _time.perf_counter()
         with _device_scope(self.device):
             planes = run_jit(tuple(dev_ops))
             host_planes = device_pull(tuple(planes))
+        # route evidence for the learned Pallas sort window
+        # (cost/advisor.pallas_sort_window) — lock-free observe
+        if _is_accelerator(self.device):
+            from datafusion_tpu import cost as _cost
+            from datafusion_tpu.cost import advisor as _advisor
+
+            _advisor.observe_sort_route(
+                _cost.store(), "pallas" if use_pallas else "xla",
+                dev_ops[0].shape[0], _time.perf_counter() - t0,
+            )
         perm = host_planes[0].astype(np.int32)
         for i in range(1, len(host_planes)):
             perm |= host_planes[i].astype(np.int32) << np.int32(8 * i)

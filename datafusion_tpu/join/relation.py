@@ -190,6 +190,14 @@ class HashJoinRelation(Relation):
             )
             METRICS.add("join.build.rows", n)
             self._try_dense(art)
+        # single-table build sides (the plan->operator boundary fills
+        # `_cost_obs`) teach the cost store the dimension's size — the
+        # evidence the build-side/order rewrites plan from next time
+        obs = getattr(self, "_cost_obs", None)
+        if obs is not None:
+            from datafusion_tpu import cost as _cost
+
+            _cost.store().observe(obs[0], obs[1], rows=n, nbytes=art.nbytes)
         return art
 
     def _try_dense(self, art: JoinBuildArtifact) -> None:

@@ -84,7 +84,7 @@ class ExplainAnalyzeResult:
     def __init__(self, plan, root, result, spans: list[dict],
                  trace_id: str, wall_s: float, counters: Optional[dict] = None,
                  phases: Optional[dict] = None, hbm: Optional[dict] = None,
-                 host_profile=None):
+                 host_profile=None, cost: Optional[dict] = None):
         self.plan = plan
         self.root = root
         self.result = result
@@ -103,6 +103,10 @@ class ExplainAnalyzeResult:
         # per-phase top frames — WHERE in host code each phase's wall
         # went (None when DATAFUSION_TPU_PROFILE_EXPLAIN=0)
         self.host_profile = host_profile
+        # cost-based planner decisions / runtime replans made DURING
+        # this query ({"decisions": [...], "replans": [...]}) — the
+        # feedback-driven planning subsystem's chosen-vs-default view
+        self.cost = cost or {}
 
     def report(self) -> str:
         lines = [f"EXPLAIN ANALYZE  (trace {self.trace_id}, "
@@ -153,6 +157,25 @@ class ExplainAnalyzeResult:
                 lines.append(
                     f"Plans rejected by verification: "
                     f"{c['coord.plan_rejected']}"
+                )
+        decisions = self.cost.get("decisions") or []
+        replans = self.cost.get("replans") or []
+        if decisions:
+            # chosen-vs-default with the driving observation: the
+            # statistics-fed planner shows its work, per decision
+            lines.append(f"Cost decisions ({len(decisions)}):")
+            for d in decisions:
+                where = f" [{d['table']}]" if d.get("table") else ""
+                lines.append(
+                    f"  {d['decision']}{where}: chose {d['chosen']} "
+                    f"(default {d['default']}) — {d['reason']}"
+                )
+        if replans:
+            lines.append(f"Replans ({len(replans)}):")
+            for r in replans:
+                lines.append(
+                    f"  {r['what']}: estimated {r['estimate']}, "
+                    f"observed {r['actual']} — {r['action']}"
                 )
         worker_spans = sum(
             1 for s in self.spans if str(s.get("proc", "")).startswith("worker")
@@ -228,7 +251,8 @@ class _RootTap:
         return iter_stats(self.rel)
 
 
-def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
+def explain_analyze(ctx, plan,
+                    decision_mark: Optional[int] = None) -> ExplainAnalyzeResult:
     """Execute `plan` on `ctx` under a fresh trace session and package
     the annotated result.  The query runs to completion (EXPLAIN
     ANALYZE measures a real execution, not an estimate)."""
@@ -266,6 +290,16 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
         name="explain_analyze",
         enabled=_env_flag("DATAFUSION_TPU_PROFILE_EXPLAIN", True),
     )
+    # slice out the cost-based planner's decisions / replans made
+    # during THIS query: the caller marks the store's decision serial
+    # before planning (logical rewrites decide there); lowering and
+    # runtime decisions land past the mark during execute/collect
+    from datafusion_tpu import cost as _cost
+
+    _cstore = _cost.store()
+    _decision_mark = (_cstore.decision_serial if decision_mark is None
+                      else decision_mark)
+    _replan_mark = time.time()
     with trace.session() as tc, _device.profile_sync(), \
             profile_scope as prof_cap:
         t0 = time.perf_counter()
@@ -273,6 +307,12 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
             rel = ctx.execute(plan)
             table = collect(_RootTap(rel))
         wall = time.perf_counter() - t0
+    cost_view = {
+        "decisions": [d for d in list(_cstore.decisions)
+                      if d.get("seq", 0) > _decision_mark],
+        "replans": [r for r in list(_cstore.replans)
+                    if r.get("ts", 0.0) >= _replan_mark],
+    }
     host_profile = None if prof_cap is None else prof_cap.report()
     phases = phase_breakdown(phase_before, wall)
     hbm = {"peak_bytes": LEDGER.window_peak_bytes(),
@@ -297,4 +337,5 @@ def explain_analyze(ctx, plan) -> ExplainAnalyzeResult:
     return ExplainAnalyzeResult(
         plan, rel, table, spans, tc.trace_id, wall, counters,
         phases=phases, hbm=hbm, host_profile=host_profile,
+        cost=cost_view,
     )

@@ -16,6 +16,8 @@ env-var'd file dumps.  This module puts them behind ONE HTTP port
 ``/debug/flights``    flight-recorder ring dump as JSON
                       (``?trace_id=`` filters to one query)
 ``/debug/hbm``        HBM residency ledger breakdown (per owner/device)
+``/debug/cost``       cost/statistics store: learned per-(table, shape)
+                      observations + recent planner decisions/replans
 ``/debug/top``        the fleet ``top`` view (fleet-wide on a
                       coordinator, local-node on a worker)
 ``/debug/profile``    on-demand host profile: ``?seconds=N`` capture
@@ -134,6 +136,14 @@ def build_bundle(*, label: Optional[str] = None,
         ),
         "slo": slo.WATCHDOG.evaluate() if slo.WATCHDOG.armed() else [],
     }
+    try:
+        from datafusion_tpu import cost as _cost
+
+        # the cost subsystem's learned statistics + recent decisions:
+        # lets a bundle answer "WHY did the planner pick that route"
+        doc["cost"] = _cost.store().snapshot()
+    except Exception:  # noqa: BLE001 — a broken provider must not block the bundle
+        METRICS.add("obs.debug_provider_errors")
     try:
         from datafusion_tpu.utils import wal as _wal
         wal_manifests = _wal.active_manifests()
@@ -268,6 +278,8 @@ GET /debug/serve              serving front door: admission counters,
                               pinned tables, megabatch stats (JSON)
 GET /debug/ingest             streaming ingest: appendable tables,
                               materialized views, freshness lags (JSON)
+GET /debug/cost               cost store: learned statistics + recent
+                              planner decisions / replans (JSON)
 GET /debug/tenants            per-client metering: device-seconds,
                               H2D bytes, pin byte-seconds, hedge
                               duplicates + conservation check (JSON)
@@ -391,6 +403,14 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
         from datafusion_tpu import ingest
 
         return _json_body({"node": srv.label, **ingest.debug_snapshot()})
+    if path == "/debug/cost":
+        from datafusion_tpu import cost as _cost
+
+        return _json_body({
+            "node": srv.label,
+            "enabled": _cost.enabled(),
+            **_cost.store().snapshot(),
+        })
     if path == "/debug/tenants":
         from datafusion_tpu.obs import attribution
 

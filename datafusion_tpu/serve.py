@@ -419,6 +419,15 @@ class Server:
             window_s if window_s is not None
             else _env_float("DATAFUSION_TPU_SERVE_WINDOW_MS", 2.0) / 1e3
         )
+        # adaptive window (datafusion_tpu/cost): an explicitly
+        # configured window — kwarg or env — is a contract and stays
+        # fixed; the default adapts to the observed arrival spacing
+        self._window_adaptive = (
+            window_s is None
+            and "DATAFUSION_TPU_SERVE_WINDOW_MS" not in os.environ
+        )
+        self._last_arrival_mono: Optional[float] = None
+        self._window_noted_s: Optional[float] = None
         self._megabatch_max = (
             megabatch_max if megabatch_max is not None
             else _env_int("DATAFUSION_TPU_SERVE_MEGABATCH", 16)
@@ -450,12 +459,6 @@ class Server:
         self.pins_rehydrated = 0
         self._loop = ServerLoop(pool_size=self._workers,
                                 name="df-tpu-serve")
-        # last observed scan cardinality per table (megabatch passes
-        # record what they scanned): the megabatch cost-apportionment
-        # weights come from these REAL row counts — a member whose
-        # plan also scans a join dimension table weighs more than a
-        # member touching only the shared fact scan
-        self._table_rows: dict = {}
         self._thread: Optional[threading.Thread] = None
         self._closed = False
         self._window: list[Ticket] = []          # loop thread only
@@ -743,6 +746,17 @@ class Server:
     # -- dispatch (loop thread) ----------------------------------------
     def _enqueue(self, t: Ticket) -> None:
         t.enqueued_mono = time.monotonic()
+        # arrival spacing feeds the adaptive window (cost/advisor):
+        # loop-thread only, lock-free observe into the cost store
+        prev = self._last_arrival_mono
+        self._last_arrival_mono = t.enqueued_mono
+        if prev is not None:
+            from datafusion_tpu import cost as _cost
+
+            _cost.store().observe(
+                _cost.SERVE_KEY, "arrivals",
+                interval_s=min(t.enqueued_mono - prev, 60.0),
+            )
         self._window.append(t)
         if len(self._window) >= max(self._megabatch_max, 1):
             # size-triggered early flush: the window is a MAXIMUM wait,
@@ -755,8 +769,33 @@ class Server:
             return
         if self._window_timer is None:
             self._window_timer = self._loop.call_later(
-                self._window_s, self._flush_window
+                self._effective_window_s(), self._flush_window
             )
+
+    def _effective_window_s(self) -> float:
+        """The megabatch wait actually armed: the configured window,
+        or — when it was left at its default and the cost subsystem is
+        on — the learned window from observed arrival spacing (don't
+        hold a lone query 2 ms for peers that historically never come;
+        stretch a little when arrivals are dense).  Decision recorded
+        on change, not per timer."""
+        from datafusion_tpu import cost as _cost
+
+        if not self._window_adaptive or not _cost.enabled():
+            return self._window_s
+        from datafusion_tpu.cost import advisor
+
+        store = _cost.store()
+        chosen = advisor.serve_window_s(store, self._window_s)
+        if chosen != self._window_s and chosen != self._window_noted_s:
+            self._window_noted_s = chosen
+            store.note_decision(
+                "serve.window_ms", round(chosen * 1e3, 3),
+                round(self._window_s * 1e3, 3),
+                "observed arrival spacing "
+                f"{(store.value(_cost.SERVE_KEY, 'arrivals', 'interval_s') or 0) * 1e3:.2f} ms",
+            )
+        return chosen
 
     def _flush_window(self) -> None:
         self._window_timer = None
@@ -973,18 +1012,26 @@ class Server:
     def _member_weights(self, tickets: list) -> list:
         """Per-member megabatch cost weights from REAL scan row
         counts: each member weighs by the total rows of the tables its
-        plan scans (`self._table_rows`, learned from earlier passes).
+        plan scans (the cost store's `scan` observations, learned from
+        earlier passes — the same statistics the planner consults).
         A member whose join also reads a dimension table therefore
         carries its extra rows; members touching only the shared scan
         split evenly, and unknown cardinalities (first pass over a
         table) fall back to the even split — never a zero weight."""
         from datafusion_tpu.cache import scan_tables
 
+        from datafusion_tpu import cost as _cost
+        from datafusion_tpu.cost import advisor
+
+        store = _cost.store()
         counts = []
         for t in tickets:
             try:
-                known = [self._table_rows.get(n)
-                         for n in scan_tables(t.plan)]
+                known = [
+                    advisor.table_rows(
+                        store, self.ctx.cost_table_key(n))
+                    for n in scan_tables(t.plan)
+                ]
             except Exception:  # noqa: BLE001 — weighting must not fail a query
                 known = []
             rows = sum(k for k in known if k)
@@ -996,7 +1043,13 @@ class Server:
 
     def _note_table_rows(self, table: str, rows: int) -> None:
         if table and rows > 0:
-            self._table_rows[table] = int(rows)
+            from datafusion_tpu import cost as _cost
+
+            try:
+                _cost.store().observe(
+                    self.ctx.cost_table_key(table), "scan", rows=int(rows))
+            except Exception:  # noqa: BLE001 — stats must not fail serving
+                pass
 
     def _mega_key(self, rel):
         """Concrete megabatch grouping key for an already-lowered

@@ -63,6 +63,9 @@ python scripts/ingest_smoke.py
 echo "== join smoke (2-worker shuffle joins: Q3-shaped 3-table exact, SIGKILL failover, warm pinned-build zero-H2D probe) =="
 python scripts/join_smoke.py
 
+echo "== adaptive smoke (cost-store feedback loop: cold-vs-trained decision flips across a restart, bit-exact, replan on poisoned stats) =="
+python scripts/adaptive_smoke.py
+
 echo "== example (reference csv_sql.rs workload) =="
 python examples/csv_sql.py > "${test_dir}/example_output.txt"
 grep -q "City: " "${test_dir}/example_output.txt"
