@@ -371,7 +371,8 @@ def config3_tpch_q1(device_kind: str, sf=None):
 
     # warm: the same rows resident in memory (and after warm-up, on
     # device) — steady-state re-query throughput
-    ctx = ExecutionContext(device="cpu", batch_size=1 << 19)
+    q1_batch = int(os.environ.get("BENCH_Q1_BATCH", str(1 << 19)))
+    ctx = ExecutionContext(device="cpu", batch_size=q1_batch)
     ctx.register_parquet("lineitem", path)
     scan_src = ctx.datasources["lineitem"]
     batches = list(scan_src.batches())
@@ -381,7 +382,9 @@ def config3_tpch_q1(device_kind: str, sf=None):
     if device_kind != "cpu":
         dev_warm_p50, dev_warm_out = _warm_query(device_kind, mem_src, "lineitem", Q1, rows)
         _assert_tables_match(dev_warm_out, cpu_warm_out, "config3 warm")
-        utilization = _q1_device_utilization(device_kind, mem_src, rows)
+        utilization = _q1_device_utilization(
+            device_kind, mem_src, rows, batch_size=q1_batch
+        )
         log(f"    utilization: {utilization}")
     else:
         dev_warm_p50 = cpu_warm_p50
@@ -405,7 +408,8 @@ def config3_tpch_q1(device_kind: str, sf=None):
     }
 
 
-def _q1_device_utilization(device_kind: str, mem_src, rows: int) -> dict:
+def _q1_device_utilization(device_kind: str, mem_src, rows: int,
+                           batch_size: "int | None" = None) -> dict:
     """Device-side throughput and bandwidth utilization for the warm Q1
     kernel, separated from the session's synchronization floor.
 
@@ -425,7 +429,15 @@ def _q1_device_utilization(device_kind: str, mem_src, rows: int) -> dict:
 
     from datafusion_tpu.exec.context import ExecutionContext
 
-    ctx = ExecutionContext(device=device_kind, batch_size=1 << 19)
+    if batch_size is None:
+        # derive from the source's ACTUAL batch geometry rather than a
+        # literal: the launch correction multiplies launches/pass, and
+        # launches/pass follows the batch count — a utilization context
+        # batched differently from the measured config would correct
+        # with the wrong launch count (this feeds BASELINE.md claims)
+        sizes = [b.num_rows for b in mem_src.batches()]
+        batch_size = max(sizes) if sizes else 1 << 19
+    ctx = ExecutionContext(device=device_kind, batch_size=batch_size)
     ctx.register_datasource("lineitem", mem_src)
     rel = ctx.sql(Q1)
     for _ in range(2):

@@ -218,6 +218,13 @@ class CacheStore:
     def entries(self) -> int:
         return len(self._entries)
 
+    def tags(self) -> set[str]:
+        """The live tag vocabulary (table names, for the fragment and
+        result stores) — pin advertisement (cluster/agent.py) folds it
+        into the worker's lease value under QoS."""
+        with self._lock:
+            return set(self._tags)
+
     def stats(self) -> dict:
         """Snapshot for status endpoints / smoke assertions."""
         with self._lock:

@@ -73,14 +73,41 @@ def fleet_top_text(ctx=None) -> str:
     return FleetAggregator().top_text(slo_rows=rows)
 
 
+def qos_text() -> str:
+    """The ``top --qos`` block: armed state, per-tenant share /
+    attained / normalized service (the WFQ clock the admission order
+    follows), and the elastic-capacity hint with its two inputs."""
+    from datafusion_tpu import qos as qos_mod
+
+    snap = qos_mod.debug_snapshot()
+    lines = [f"QoS: {'armed' if snap['enabled'] else 'off'}"]
+    for cid, row in snap.get("attained", {}).items():
+        lines.append(
+            f"  {cid}: share {row['share']:g}  "
+            f"attained {row['cost_s']:.3f}s  "
+            f"normalized {row['normalized']:.3f}"
+        )
+    sc = snap["scale"]
+    burn = sc["max_burn_rate"]
+    lines.append(
+        f"  scale hint: {sc['hint']:+d}  "
+        f"(max burn {'n/a' if burn is None else f'{burn:.2f}x'}, "
+        f"queue_wait share {sc['queue_wait_share']:.0%})"
+    )
+    return "\n".join(lines)
+
+
 def run_top(workers: Optional[str], cluster: Optional[str],
-            watch_s: float, out=None, tenants: bool = False) -> int:
+            watch_s: float, out=None, tenants: bool = False,
+            qos: bool = False) -> int:
     """`datafusion-tpu top [--workers a:1,b:2 | --cluster host:p]
-    [--watch N] [--tenants]`: print the fleet telemetry view once, or
-    every N seconds until interrupted.  ``--tenants`` appends the
-    per-client metering table (obs/attribution.py): device-seconds,
+    [--watch N] [--tenants] [--qos]`: print the fleet telemetry view
+    once, or every N seconds until interrupted.  ``--tenants`` appends
+    the per-client metering table (obs/attribution.py): device-seconds,
     H2D bytes, pin byte-seconds, hedge duplicates per ``client_id``,
-    with the conservation line."""
+    with the conservation line.  ``--qos`` appends the fair-share
+    view: per-tenant shares and attained/normalized service plus the
+    elastic-capacity scale hint."""
     import os
 
     out = out if out is not None else sys.stdout
@@ -111,6 +138,8 @@ def run_top(workers: Optional[str], cluster: Optional[str],
                         agg.fleet().get("tenants", {})), file=out)
                 else:
                     print(attribution.tenants_text(), file=out)
+            if qos:
+                print(qos_text(), file=out)
             if not watch_s:
                 return 0
             print("", file=out)
@@ -717,11 +746,17 @@ def main(argv=None) -> int:
              "(device-seconds, H2D bytes, pin byte-seconds, hedge "
              "duplicates per client_id)",
     )
+    parser.add_argument(
+        "--qos", action="store_true",
+        help="top mode: append the multi-tenant QoS view (per-tenant "
+             "shares, attained/normalized service, elastic-capacity "
+             "scale hint)",
+    )
     args = parser.parse_args(argv)
 
     if args.mode == "top":
         return run_top(args.workers, args.cluster, args.watch,
-                       tenants=args.tenants)
+                       tenants=args.tenants, qos=args.qos)
     if args.mode == "debug-bundle":
         return run_debug_bundle(args.cluster, args.workers, args.out,
                                 args.seconds, fmt=args.format)

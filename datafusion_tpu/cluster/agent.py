@@ -78,6 +78,9 @@ class WorkerClusterAgent:
         self.events_applied = 0
         self.reregistrations = 0
         self._lease_refreshed: Optional[float] = None
+        # last (pin set, saturated) put under the lease — QoS pin
+        # advertisement re-puts only when this changes
+        self._advertised_pins: Optional[tuple] = None
         # consecutive heartbeat failures: drives the capped full-jitter
         # backoff below so a fleet whose leases lapsed together (mass
         # expiry across a failover) re-registers SPREAD over a window
@@ -107,13 +110,8 @@ class WorkerClusterAgent:
             METRICS.add("worker.telemetry_snapshot_errors")
             return None
 
-    # -- registration / heartbeat --
-    def register(self) -> None:
-        granted = self.client.lease_grant(self.ttl_s)
-        self.lease = granted["lease"]
-        # resume the event log from the grant: events before this worker
-        # held a lease concern caches it does not have
-        self.last_rev = granted.get("rev", 0)
+    def _membership_info(self) -> dict:
+        """The membership record this worker puts under its lease."""
         info = {"addr": self.addr, "pid": os.getpid(),
                 "batch_size": self.worker_state.batch_size}
         # a rebooted worker that re-materialized HBM pins from its
@@ -130,9 +128,81 @@ class WorkerClusterAgent:
         debug_port = getattr(self.worker_state, "debug_port", None)
         if debug_port:
             info["debug_port"] = int(debug_port)
+        # pin-aware placement (datafusion_tpu/qos, default off): the
+        # resident-table fingerprints plus HBM headroom ride the lease
+        # value beside the debug port, so the coordinator routes a
+        # query to a worker already holding its tables — and spots a
+        # saturated holder it should replicate away from
+        adv = self._pin_advertisement()
+        if adv is not None:
+            pins, headroom = adv
+            info["pins"] = pins
+            if headroom is not None:
+                info["hbm_headroom_bytes"] = int(headroom)
+        return info
+
+    def _pin_advertisement(self):
+        """``(pins, hbm_headroom_bytes)`` to advertise, or None when
+        QoS is off (the lease value stays byte-identical to pre-QoS)
+        or the embedder's worker state exposes no fingerprints."""
+        from datafusion_tpu import qos
+
+        if not qos.enabled():
+            return None
+        fn = getattr(self.worker_state, "pinned_fingerprints", None)
+        if fn is None:
+            return None
+        try:
+            pins = list(fn())
+        except Exception:  # noqa: BLE001 — advertisement must not break the lease
+            METRICS.add("worker.pin_advert_errors")
+            return None
+        from datafusion_tpu.obs.device import LEDGER
+
+        return pins, LEDGER.headroom()
+
+    @staticmethod
+    def _pin_state(info: dict):
+        """The change-detection key for re-advertisement: the pin set
+        plus the SATURATED flag (headroom crossing zero flips routing
+        decisions; raw headroom jitter must not re-put every beat)."""
+        pins = info.get("pins")
+        if pins is None:
+            return None
+        headroom = info.get("hbm_headroom_bytes")
+        return tuple(pins), bool(headroom is not None and headroom <= 0)
+
+    # -- registration / heartbeat --
+    def register(self) -> None:
+        granted = self.client.lease_grant(self.ttl_s)
+        self.lease = granted["lease"]
+        # resume the event log from the grant: events before this worker
+        # held a lease concern caches it does not have
+        self.last_rev = granted.get("rev", 0)
+        info = self._membership_info()
         self.client.put(f"workers/{self.addr}", info, lease=self.lease)
+        self._advertised_pins = self._pin_state(info)
         self._lease_refreshed = time.monotonic()
         METRICS.add("worker.cluster_registered")
+
+    def _readvertise_pins(self) -> None:
+        """Re-put the membership record when the advertised pin set
+        (or the saturated flag) changed since the last put: re-putting
+        an existing ``workers/`` key bumps the revision — watchers
+        wake, views refresh their info dicts — WITHOUT bumping the
+        membership epoch, so placement sees fresh pins within one
+        heartbeat while epoch-driven machinery stays quiet."""
+        if self.lease is None:
+            return
+        info = self._membership_info()
+        state = self._pin_state(info)
+        if state is None or state == self._advertised_pins:
+            return
+        self.client.put(f"workers/{self.addr}", info, lease=self.lease)
+        self._advertised_pins = state
+        METRICS.add("worker.pins_readvertised")
+        recorder.record("pins.advertise", addr=self.addr,
+                        pins=len(state[0]), saturated=int(state[1]))
 
     def poll_once(self, stagger: bool = False) -> None:
         """One heartbeat: refresh the lease, apply any broadcast events
@@ -194,6 +264,7 @@ class WorkerClusterAgent:
         for ev in resp.get("events", ()):
             self._apply(ev)
         self.last_rev = resp.get("rev", self.last_rev)
+        self._readvertise_pins()
 
     def _apply(self, event: dict) -> None:
         if event.get("kind") != "invalidate":

@@ -899,6 +899,13 @@ class ClusterState:
                 "cluster.members": sum(
                     1 for k in self._kv if self._is_member_key(k)
                 ),
+                # total pin fingerprints the fleet advertises (QoS pin
+                # placement; 0 with QoS off — no member puts any)
+                "cluster.pins_advertised": sum(
+                    len(e.value.get("pins") or ())
+                    for k, e in self._kv.items()
+                    if self._is_member_key(k) and isinstance(e.value, dict)
+                ),
                 "cluster.telemetry_nodes": len(self._telemetry),
                 "cluster.watch_parked": len(self._async_waiters),
             }

@@ -429,6 +429,20 @@ class TailExplainer:
 EXPLAINER = TailExplainer()
 
 
+def queue_wait_share(window_s: Optional[float] = None) -> float:
+    """The ``queue_wait`` segment's share of observed query wall in
+    the explainer's window — the queueing half of the QoS
+    elastic-capacity signal (`datafusion_tpu/qos.scale_hint`): a
+    fleet whose tail is dominated by admission queueing needs more
+    capacity, one whose tail is compute-bound does not.  0.0 with no
+    observed paths (no evidence of queueing)."""
+    report = EXPLAINER.explain(window_s)
+    for row in report["segments"]:
+        if row["segment"] == "queue_wait":
+            return float(row["share_of_wall"])
+    return 0.0
+
+
 def observe_path(client_id: str, wall_s: float,
                  segments: dict[str, float]) -> None:
     """One served query's decomposed critical path (serve.Server's

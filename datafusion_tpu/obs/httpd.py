@@ -283,6 +283,8 @@ GET /debug/cost               cost store: learned statistics + recent
 GET /debug/tenants            per-client metering: device-seconds,
                               H2D bytes, pin byte-seconds, hedge
                               duplicates + conservation check (JSON)
+GET /debug/qos                multi-tenant QoS: shares, attained
+                              service, shed policy, scale hint (JSON)
 GET /debug/tail[?window_s=N]  tail explainer: per-segment p50/p95/p99
                               contributions, ranked (JSON)
 GET /debug/top                fleet/local top view (text)
@@ -418,6 +420,10 @@ def _route_request(srv: "DebugServer", path: str, q: dict):
             "node": srv.label,
             **attribution.tenants_snapshot(),
         })
+    if path == "/debug/qos":
+        from datafusion_tpu import qos
+
+        return _json_body({"node": srv.label, **qos.debug_snapshot()})
     if path == "/debug/tail":
         from datafusion_tpu.obs import attribution
 

@@ -249,6 +249,22 @@ class SloWatchdog:
         ]
 
 
+def max_burn_rate(rows: "list[dict] | None" = None) -> Optional[float]:
+    """The worst burn rate across the watchdog's objectives — the
+    overload half of the QoS elastic-capacity signal
+    (`datafusion_tpu/qos.scale_hint`).  Pass ``rows`` when the caller
+    already holds an `evaluate()` result (scrape paths evaluate once
+    and reuse); otherwise a side-effect-free `snapshot()` is taken.
+    None when the watchdog is unarmed: no objectives is *no
+    evidence*, which must read as "hold", never as idle-capacity
+    proof the hint could shrink on."""
+    if rows is None:
+        rows = WATCHDOG.snapshot() if WATCHDOG.armed() else []
+    if not rows:
+        return None
+    return max(row.get("burn_rate", 0.0) for row in rows)
+
+
 def _breach_extra(row: dict) -> dict:
     """The breach artifact's context: the burn-rate row PLUS the tail
     explainer's ranked per-segment report (obs/attribution.py) — the

@@ -392,6 +392,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
               request_type: str,
               deadline: Optional[Deadline] = None,
               hedge=None, local_exec=None, extra: Optional[dict] = None,
+              placement=None,
               ) -> list[tuple[PlanFragment, dict]]:
     """Send the fragments to the workers concurrently (round-robin over
     live workers; one thread per in-flight fragment, so N workers
@@ -430,6 +431,11 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
       when every worker is dead AND the synchronous probe rounds find
       nothing, run the fragment on the coordinator itself rather than
       failing the query (``coord.local_fallbacks``).
+    - `placement` (multi-tenant QoS, DATAFUSION_TPU_QOS): a
+      ``(fragment, live) -> WorkerHandle | None`` callable consulted
+      BEFORE round-robin — the coordinator's pin-aware router sends a
+      fragment to a worker already holding its tables pinned (lease-
+      advertised fingerprints); None falls through to round-robin.
     """
     import itertools
     import queue as _queue
@@ -454,6 +460,12 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
     # duplicate wall — reported from its own attempt thread, possibly
     # minutes later — must charge the hedging query's client
     meter_scope = _attribution.current_scope()
+    # the tenant the per-tenant isolation budgets bill (qos.py): the
+    # dispatch scope's solo client, or a shared scope's dominant-weight
+    # member — None (untenanted / QoS off) keeps the global-only path
+    from datafusion_tpu import qos as _qos
+
+    tenant = _qos.scope_client(meter_scope)
 
     def _breaker(w):
         return breaker_mod.breaker_for(f"worker:{w.host}:{w.port}")
@@ -540,7 +552,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                         meter_scope, time.perf_counter() - t0
                     )
 
-        hedge.observe_dispatch()
+        hedge.observe_dispatch(tenant)
         threading.Thread(
             target=attempt, args=(primary, msg, False, None, timeout),
             name="df-tpu-dispatch", daemon=True,
@@ -555,7 +567,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
             # budget after that reservation would leak the slot (no
             # request ever pairs a record() with it) — permanently
             # exiling a recovering worker
-            if not hedge.try_hedge():
+            if not hedge.try_hedge(tenant):
                 METRICS.add("coord.hedges_suppressed")
                 return
             # deadline BEFORE target, for the same reason as budget:
@@ -568,13 +580,13 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
             if deadline is not None:
                 remaining = deadline.remaining()
                 if remaining <= 0.001:
-                    hedge.refund()  # no budget left to hedge inside
+                    hedge.refund(tenant)  # no budget left to hedge inside
                     METRICS.add("coord.hedges_suppressed")
                     return
                 h_timeout = remaining
             alt = pick_hedge_target(primary)
             if alt is None:
-                hedge.refund()  # approved but nobody to send it to
+                hedge.refund(tenant)  # approved but nobody to send it to
                 METRICS.add("coord.hedges_suppressed")
                 return
             if deadline is not None and alt.request_timeout is not None:
@@ -666,7 +678,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
         attempts = 0
         probe_rounds = 0
         if budget is not None:
-            budget.earn()  # a fragment's first dispatch accrues credit
+            budget.earn(tenant)  # a fragment's first dispatch accrues credit
         while True:
             if deadline is not None:
                 deadline.check(f"fragment {fi}/{len(fragments)}")
@@ -696,7 +708,17 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                     f"all {len(workers)} workers are down "
                     f"(fragment {fi}/{len(fragments)})"
                 )
-            w = pick_worker(live)
+            w = None
+            if placement is not None and attempts == 0:
+                # pin-aware routing (first attempt only: a failover
+                # replay must not re-target the worker that just died)
+                try:
+                    w = placement(frag, live)
+                except Exception:  # noqa: BLE001 — placement is advisory, never fatal
+                    METRICS.add("coord.placement_errors")
+                    w = None
+            if w is None:
+                w = pick_worker(live)
             msg = {"type": request_type, "fragment": frag.to_json_str()}
             if extra:
                 # request-kind parameters riding beside the fragment
@@ -773,7 +795,7 @@ def _dispatch(workers: list[WorkerHandle], fragments: list[PlanFragment],
                         f"fragment reassignment exhausted "
                         f"(fragment {fi}: {attempts} attempts)"
                     ) from None
-                if budget is not None and not budget.spend():
+                if budget is not None and not budget.spend(tenant):
                     METRICS.add("coord.reassign_budget_denied")
                     raise ExecutionError(
                         f"fragment {fi} reassignment denied: the retry "
@@ -872,7 +894,7 @@ class DistributedAggregateRelation(Relation):
     def __init__(self, plan, agg, pred, scan, ds: PartitionedDataSource,
                  workers: list[WorkerHandle], functions=None,
                  query_deadline_s: Optional[float] = None,
-                 hedge=None, local_exec=None):
+                 hedge=None, local_exec=None, placement=None):
         # verified once at construction: the plan is immutable, and
         # batches()/re-collects must not re-walk it per iteration
         _check_fragment_plan(plan)
@@ -892,6 +914,7 @@ class DistributedAggregateRelation(Relation):
         self.query_deadline_s = query_deadline_s
         self.hedge = hedge
         self.local_exec = local_exec
+        self.placement = placement
 
     def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
         return _collect_worker_flight_dumps(self.workers, trace_id)
@@ -929,6 +952,7 @@ class DistributedAggregateRelation(Relation):
         responses = _dispatch(
             self.workers, self._fragments(), "execute_fragment", deadline,
             hedge=self.hedge, local_exec=self.local_exec,
+            placement=self.placement,
         )
 
         n_keys = len(t.key_cols)
@@ -1039,7 +1063,7 @@ class DistributedUnionRelation(Relation):
 
     def __init__(self, plan, ds: PartitionedDataSource, workers: list[WorkerHandle],
                  query_deadline_s: Optional[float] = None,
-                 hedge=None, local_exec=None):
+                 hedge=None, local_exec=None, placement=None):
         _check_fragment_plan(plan)
         self.plan = plan
         self.ds = ds
@@ -1048,6 +1072,7 @@ class DistributedUnionRelation(Relation):
         self.query_deadline_s = query_deadline_s
         self.hedge = hedge
         self.local_exec = local_exec
+        self.placement = placement
 
     def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
         return _collect_worker_flight_dumps(self.workers, trace_id)
@@ -1078,7 +1103,8 @@ class DistributedUnionRelation(Relation):
             else Deadline.after(self.query_deadline_s)
         )
         responses = _dispatch(self.workers, fragments, "execute_plan", deadline,
-                              hedge=self.hedge, local_exec=self.local_exec)
+                              hedge=self.hedge, local_exec=self.local_exec,
+                              placement=self.placement)
         dicts: list[Optional[StringDictionary]] = [
             StringDictionary() if f.data_type == DataType.UTF8 else None
             for f in self._schema.fields
@@ -1143,7 +1169,8 @@ class DistributedShuffleJoinRelation(Relation):
     """
 
     def __init__(self, plan, sides, workers: list[WorkerHandle],
-                 query_deadline_s: Optional[float] = None, hedge=None):
+                 query_deadline_s: Optional[float] = None, hedge=None,
+                 placement=None):
         # sides: per (left, right) input either ("frags", side_plan, ds)
         # or ("local", relation)
         self.plan = plan
@@ -1152,6 +1179,7 @@ class DistributedShuffleJoinRelation(Relation):
         self._schema = plan.schema
         self.query_deadline_s = query_deadline_s
         self.hedge = hedge
+        self.placement = placement
 
     def collect_flight_dumps(self, trace_id: Optional[str] = None) -> dict:
         return _collect_worker_flight_dumps(self.workers, trace_id)
@@ -1187,7 +1215,7 @@ class DistributedShuffleJoinRelation(Relation):
             ]
             responses = _dispatch(
                 self.workers, fragments, "shuffle_map", deadline,
-                hedge=self.hedge,
+                hedge=self.hedge, placement=self.placement,
                 extra={"keys": keys, "num_parts": num_parts, "side": tag},
             )
             for _frag, resp in _iter_unique_responses(responses):
@@ -1510,6 +1538,18 @@ class DistributedContext(ExecutionContext):
 
             hedge = hedge_mod.from_env()
         self.hedge = hedge
+        # pin-aware placement (datafusion_tpu/qos, default off): with
+        # QoS armed in cluster mode, fragments route to workers already
+        # advertising their tables pinned (the agent publishes pin
+        # fingerprints in its lease value).  Advisory and first-attempt
+        # only — failover replays and any placement miss fall through
+        # to the round-robin picker, so liveness never depends on it
+        from datafusion_tpu import qos as _qos_mod
+
+        self._placement = None
+        self._last_scale_hint: Optional[int] = None
+        if self.membership is not None and _qos_mod.enabled():
+            self._placement = self._pin_placement
         self._local_worker = None
         from datafusion_tpu.utils.retry import _env_bool
 
@@ -1558,6 +1598,60 @@ class DistributedContext(ExecutionContext):
         if self.membership is not None:
             gauges.update(self.membership.gauges())
         return gauges
+
+    def _pin_placement(self, frag: PlanFragment, live):
+        """Pin-aware placement (QoS): prefer a live worker already
+        advertising this fragment's tables pinned (``pins``
+        fingerprints in its lease value, beside the debug port).  When
+        every pin-holder reports zero HBM headroom while a non-holder
+        shows some, route to the non-holder instead — serving the
+        fragment there warms its caches, and the pins it then
+        advertises on its next heartbeat complete the hot-pin
+        replication (``pin.replicate`` flight event).  Advisory: any
+        miss returns None and dispatch round-robins as before."""
+        view = self.membership
+        if view is None or not live:
+            return None
+        names = frag.table_names()
+        if not names:
+            return None
+        wanted = {f"table:{n}" for n in names}
+        # .copy(): the view thread swaps the dict on refresh
+        info_by_addr = {
+            _resolve_addr(addr): info
+            for addr, info in view.workers.copy().items()
+            if isinstance(info, dict)
+        }
+        holders, spare = [], []
+        for w in live:
+            info = info_by_addr.get(_resolve_addr(f"{w.host}:{w.port}"))
+            if info is None:
+                continue
+            pins = info.get("pins") or ()
+            headroom = info.get("hbm_headroom_bytes")
+            if wanted & set(pins):
+                holders.append((w, headroom))
+            else:
+                spare.append((w, headroom))
+        if not holders:
+            return None
+        # a holder with headroom (or unknowable headroom) wins; ties
+        # break by advertisement order, which the view keeps stable
+        for w, headroom in holders:
+            if headroom is None or headroom > 0:
+                METRICS.add("coord.pin_routed")
+                return w
+        # every pin-holder saturated while the fleet view shows spare
+        # capacity: replicate the hot pin by routing there
+        for w, headroom in spare:
+            if headroom is not None and headroom > 0:
+                METRICS.add("coord.pin_replicated")
+                flight.record("pin.replicate",
+                              target=f"{w.host}:{w.port}",
+                              tables=",".join(sorted(names)))
+                return w
+        METRICS.add("coord.pin_routed")
+        return holders[0][0]
 
     def close(self) -> None:
         if self.heartbeat is not None:
@@ -1657,6 +1751,14 @@ class DistributedContext(ExecutionContext):
                     METRICS.add("coord.workers_retired", retired)
         if added:
             METRICS.add("coord.workers_discovered", len(added))
+            if getattr(self, "_placement", None) is not None:
+                # elastic capacity, event-driven half: membership GREW
+                # under QoS — record the rebalance opportunity so the
+                # next placement decisions (which read pins live from
+                # the view) spread hot pins onto the joiners, and the
+                # flight timeline shows why routing shifted
+                METRICS.add("coord.pin_rebalance_events")
+                flight.record("pin.rebalance", added=",".join(added))
         return added
 
     def sync_workers(self) -> list[str]:
@@ -1730,13 +1832,36 @@ class DistributedContext(ExecutionContext):
 
     def fleet_gauges(self) -> dict:
         """Fleet-aggregated gauges (freshly refreshed) plus SLO burn
-        rates — the extra_gauges block every scrape path folds in."""
+        rates — the extra_gauges block every scrape path folds in.
+        Under QoS the elastic-capacity signal rides every scrape: the
+        watchdog's worst burn rate and the tail explainer's queue_wait
+        share fold into ``fleet.scale_hint`` (+1 grow / 0 hold /
+        -1 shrink), and each hint TRANSITION emits a ``scale`` flight
+        event an operator or `deploy/` can act on."""
         from datafusion_tpu.obs import slo
 
         self.fleet_refresh()
         gauges = self.telemetry.gauges()
+        rows = None
         if slo.WATCHDOG.armed():
-            slo.WATCHDOG.evaluate()  # refreshes the slo.* METRICS gauges
+            rows = slo.WATCHDOG.evaluate()  # refreshes slo.* gauges
+        from datafusion_tpu import qos as _qos_mod
+
+        if _qos_mod.enabled():
+            from datafusion_tpu.obs import attribution as _attr
+
+            burn = slo.max_burn_rate(rows)
+            share = _attr.queue_wait_share()
+            hint = _qos_mod.scale_hint(burn, share)
+            gauges["fleet.scale_hint"] = hint
+            METRICS.gauge("fleet.scale_hint", hint)
+            if hint != self._last_scale_hint:
+                flight.record(
+                    "scale", hint=hint,
+                    burn_rate=round(burn, 4) if burn is not None else None,
+                    queue_wait_share=round(share, 4),
+                )
+                self._last_scale_hint = hint
         return gauges
 
     def top_text(self) -> str:
@@ -1785,6 +1910,7 @@ class DistributedContext(ExecutionContext):
                 functions=self._jax_functions(),
                 query_deadline_s=self.query_deadline_s,
                 hedge=self.hedge, local_exec=self._local_exec_fn,
+                placement=self._placement,
             )
         ds = _match_distributed_pipeline(plan, self.datasources)
         if ds is not None:
@@ -1796,6 +1922,7 @@ class DistributedContext(ExecutionContext):
                 plan, ds, self.workers,
                 query_deadline_s=self.query_deadline_s,
                 hedge=self.hedge, local_exec=self._local_exec_fn,
+                placement=self._placement,
             )
         if isinstance(plan, Join):
             rel = self._maybe_shuffle_join(plan)
@@ -1844,4 +1971,5 @@ class DistributedContext(ExecutionContext):
         return DistributedShuffleJoinRelation(
             plan, sides, self.workers,
             query_deadline_s=self.query_deadline_s, hedge=self.hedge,
+            placement=self._placement,
         )

@@ -246,6 +246,25 @@ class WorkerState:
             ),
         }
 
+    def pinned_fingerprints(self) -> list[str]:
+        """The resident-table fingerprints this worker advertises in
+        its cluster lease under QoS (pin-aware placement): the HBM
+        ledger's ``table:<name>`` pins (serve.py pinned tables; join
+        build artifacts pin under plan digests and are deliberately
+        NOT advertised — they name no routable table and would bloat
+        the lease value) plus the fragment cache's table tags as
+        ``table:<name>`` — a worker that has served a table's
+        fragments holds its batches warm even without an explicit
+        pin.  Sorted for a stable lease value (the agent re-puts only
+        on change)."""
+        from datafusion_tpu.obs.device import LEDGER
+
+        fps = {fp for fp in LEDGER.pins_snapshot()
+               if fp.startswith("table:")}
+        if self.fragment_cache is not None:
+            fps.update(f"table:{t}" for t in self.fragment_cache.tags())
+        return sorted(fps)
+
     def telemetry_snapshot(self) -> dict:
         """This worker's node snapshot for fleet aggregation, with the
         cluster gauges (lease age, term, epoch) folded in so the

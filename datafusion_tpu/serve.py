@@ -45,6 +45,12 @@ byte-identical to not importing it).  Env knobs, all prefixed
 window, default 2), ``MEGABATCH`` (max queries fused per launch,
 default 16; 0 disables fusion), ``PIN`` (1 pins tables, 0 streams),
 ``DEADLINE_S`` (default per-query budget; unset = none).
+
+Multi-tenant QoS (``DATAFUSION_TPU_QOS=1`` or ``Server(shares=...)``;
+see datafusion_tpu/qos.py) upgrades the admission queue to weighted
+fair queueing over the per-tenant cost meters and sheds the
+over-quota tenant first (``quota`` reason) under queue pressure —
+unset, every path above stays byte-identical FIFO.
 """
 
 from __future__ import annotations
@@ -406,7 +412,9 @@ class Server:
                  megabatch_max: Optional[int] = None,
                  pin: Optional[bool] = None,
                  default_deadline_s: Optional[float] = None,
-                 pin_manifest: Optional[str] = None):
+                 pin_manifest: Optional[str] = None,
+                 shares: Optional[dict] = None):
+        from datafusion_tpu import qos as qos_mod
         from datafusion_tpu.analysis import lockcheck
         from datafusion_tpu.utils.eventloop import ServerLoop
 
@@ -457,6 +465,11 @@ class Server:
                         wal_dir, "pin_manifest.json")
         self._pin_manifest_path = pin_manifest or None
         self.pins_rehydrated = 0
+        # multi-tenant QoS (datafusion_tpu/qos): weighted fair-share
+        # window ordering + over-quota shedding.  None unless
+        # DATAFUSION_TPU_QOS=1 or `shares=` was passed explicitly —
+        # and a None policy is the byte-identical FIFO path
+        self._qos = qos_mod.policy_from_config(shares)
         self._loop = ServerLoop(pool_size=self._workers,
                                 name="df-tpu-serve")
         self._thread: Optional[threading.Thread] = None
@@ -603,6 +616,32 @@ class Server:
                 self._queued_tickets[id(ticket)] = ticket
                 closed = self._closed
                 METRICS.gauge("serve.queue_depth", self._pending)
+        if at_depth and self._qos is not None:
+            # weighted fair shedding: the queue is full, so the tenant
+            # furthest over its share pays.  Either a queued victim of
+            # the over-quota tenant sheds (freeing the slot for this
+            # arrival), or — when the submitter itself is the most
+            # over-quota — the arrival sheds with the dedicated
+            # "quota" reason and nothing queued is disturbed.  The
+            # victim goes through _shed_ticket's exactly-once pop, so
+            # admitted + shed == submitted is untouched
+            with self._lock:
+                queued = list(self._queued_tickets.values())
+            victim, incoming_is_victim = self._qos.shed_victim(
+                queued, client)
+            if incoming_is_victim or victim is None:
+                raise self._shed_submit(sql, "quota", client)
+            self._shed_ticket(victim, "quota")
+            # re-run the reservation for the freed slot; a racing
+            # submitter may win it — then this arrival sheds "queue"
+            # like any other full-queue refusal
+            with self._lock:
+                at_depth = self._pending >= self._queue_depth
+                if not at_depth:
+                    self._pending += 1
+                    self._queued_tickets[id(ticket)] = ticket
+                    closed = self._closed
+                    METRICS.gauge("serve.queue_depth", self._pending)
         if at_depth:
             raise self._shed_submit(sql, "queue", client)
         if closed:
@@ -673,6 +712,11 @@ class Server:
             self.shed += 1
         METRICS.add("queries_shed")
         METER.charge(client, "shed", 1.0)
+        if self._qos is not None:
+            # per-tenant, per-reason shed meter (tenant.<id>.shed_quota
+            # and kin on the scrape) — QoS-only so the off path's
+            # tenant gauge set stays byte-identical
+            METER.charge(client, f"shed_{reason}", 1.0)
         recorder.record("serve.shed", reason=reason, client=client)
         return QueryShedError(
             f"query shed at admission ({reason}): {sql[:80]!r}",
@@ -698,6 +742,8 @@ class Server:
             METRICS.gauge("serve.queue_depth", self._pending)
         METRICS.add("queries_shed")
         METER.charge(t.client_id, "shed", 1.0)
+        if self._qos is not None:
+            METER.charge(t.client_id, f"shed_{reason}", 1.0)
         recorder.record("serve.shed", reason=reason, queued=True,
                         client=t.client_id)
         t._fail(QueryShedError(
@@ -802,6 +848,13 @@ class Server:
         if not self._window:
             return
         batch, self._window = self._window, []
+        if self._qos is not None and len(batch) > 1:
+            # weighted fair drain: the flushed window re-orders so each
+            # tenant's backlog advances in proportion to its configured
+            # share (deadline urgency breaks ties within a tenant);
+            # with QoS off the FIFO arrival order is untouched
+            batch = self._qos.order(batch,
+                                    unit_cost_s=self._service_ewma_s)
         now = time.monotonic()
         groups: dict = {}
         singles: list[list[Ticket]] = []
@@ -1561,4 +1614,6 @@ class Server:
             out["p50_s"] = h.quantile(0.5)
             out["p99_s"] = h.quantile(0.99)
             out["queries"] = h.count
+        if self._qos is not None:
+            out["qos"] = self._qos.snapshot()
         return out

@@ -56,7 +56,8 @@ class HedgeTracker:
 
     def __init__(self, factor: float = 3.0, floor_s: float = 0.25,
                  quantile: float = 0.95, min_samples: int = 4,
-                 ratio: float = 0.25, burst: float = 4.0):
+                 ratio: float = 0.25, burst: float = 4.0,
+                 tenant_buckets=None):
         self.factor = float(factor)
         self.floor_s = float(floor_s)
         self.quantile = float(quantile)
@@ -71,6 +72,17 @@ class HedgeTracker:
         self._fleet = LatencyHistogram()
         # one initial token: the very first straggler can hedge
         self._bucket = TokenBucket(self.ratio, self.burst, initial=1.0)
+        # multi-tenant QoS (datafusion_tpu/qos): per-tenant child
+        # buckets drawing on the global one — a spend passes the
+        # requesting tenant's child FIRST, and a child denial never
+        # drains the global reserve.  None (QoS off) = byte-identical
+        if tenant_buckets is None:
+            from datafusion_tpu import qos
+
+            tenant_buckets = qos.tenant_buckets_from_env(
+                self.ratio, self.burst
+            )
+        self._tenants = tenant_buckets
 
     # -- evidence (lock-free: rides the dispatch path, DF005) --
     def observe(self, target: str, seconds: float) -> None:
@@ -84,9 +96,12 @@ class HedgeTracker:
         self.ewma[target] = seconds if prev is None \
             else 0.8 * prev + 0.2 * seconds
 
-    def observe_dispatch(self) -> None:
-        """One primary dispatch: accrue hedge credit (ratio tokens)."""
+    def observe_dispatch(self, client: "str | None" = None) -> None:
+        """One primary dispatch: accrue hedge credit (ratio tokens) —
+        globally and, under QoS, in the dispatching tenant's child."""
         self._bucket.earn()
+        if self._tenants is not None and client is not None:
+            self._tenants.earn(client)
 
     def threshold_s(self, target: str) -> float:
         """How long `target`'s in-flight fragment may run before a
@@ -102,19 +117,43 @@ class HedgeTracker:
             return self.floor_s
         return max(self.floor_s, q * self.factor)
 
-    def try_hedge(self) -> bool:
+    def try_hedge(self, client: "str | None" = None) -> bool:
         """Spend one hedge token; False = budget exhausted, don't
-        hedge."""
+        hedge.  Under QoS the requesting tenant's child bucket is
+        spent FIRST: a tenant that burned its own hedge budget is
+        denied without the global bucket being consulted or drained
+        (``tenant.<id>.hedge_denied`` meter, ``hedge.tenant_denied``
+        flight event), so its storm cannot spend the fleet's
+        speculative-recovery reserve."""
+        if self._tenants is not None and client is not None:
+            if not self._tenants.spend(client):
+                from datafusion_tpu.obs.attribution import METER
+                from datafusion_tpu.obs.recorder import record
+                from datafusion_tpu.utils.metrics import METRICS
+
+                METRICS.add("hedge.tenant_denied")
+                METER.charge(client, "hedge_denied", 1.0)
+                record("hedge.tenant_denied", client=client)
+                return False
+            if not self._bucket.spend():
+                # global denial: the child token was never acted on
+                self._tenants.refund(client)
+                return False
+            return True
         return self._bucket.spend()
 
-    def refund(self) -> None:
+    def refund(self, client: "str | None" = None) -> None:
         """Return a spent token (the hedge was approved but never
         launched — e.g. no alternative worker existed)."""
         self._bucket.refund()
+        if self._tenants is not None and client is not None:
+            self._tenants.refund(client)
 
     # -- introspection --
     def gauges(self) -> dict:
         out = {"hedge.tokens": round(self._bucket.tokens, 3)}
+        if self._tenants is not None:
+            out.update(self._tenants.gauges("hedge"))
         # .copy(): dispatch threads insert new workers mid-scrape
         for target, v in sorted(self.ewma.copy().items()):
             out[f"hedge.ewma_s.{target}"] = round(v, 6)

@@ -139,14 +139,31 @@ class TokenBucket:
 
 class RetryBudget:
     """A `TokenBucket` bounding retries to a ratio of first attempts
-    (see module doc), with the metrics the acceptance gates assert."""
+    (see module doc), with the metrics the acceptance gates assert.
 
-    def __init__(self, ratio: float, burst: "float | None" = None):
+    Under multi-tenant QoS (``DATAFUSION_TPU_QOS=1``;
+    datafusion_tpu/qos.py) the global bucket grows per-tenant child
+    buckets: a spend must pass the requesting tenant's child FIRST,
+    and a child denial never touches the global bucket — one client's
+    retry storm exhausts its own isolation budget while the fleet's
+    shared recovery reserve stays intact for everyone else
+    (``tenant.<id>.retry_denied`` meter, ``retry.tenant_denied``
+    flight event).  QoS off = no children, byte-identical."""
+
+    def __init__(self, ratio: float, burst: "float | None" = None,
+                 tenant_buckets=None):
         ratio = max(0.0, float(ratio))
         self._bucket = TokenBucket(
             ratio,
             float(burst) if burst is not None else max(2.0, 10.0 * ratio),
         )
+        if tenant_buckets is None:
+            from datafusion_tpu import qos
+
+            tenant_buckets = qos.tenant_buckets_from_env(
+                self._bucket.ratio, self._bucket.burst
+            )
+        self._tenants = tenant_buckets
 
     @property
     def ratio(self) -> float:
@@ -156,14 +173,53 @@ class RetryBudget:
     def burst(self) -> float:
         return self._bucket.burst
 
-    def earn(self) -> None:
-        """One first attempt: accrue `ratio` tokens (capped)."""
+    @staticmethod
+    def _resolve_client(client: "str | None") -> "str | None":
+        """The tenant a budget operation bills: the explicit identity
+        (the coordinator passes its captured dispatch scope's) or this
+        thread's published charge scope."""
+        if client is not None:
+            return client
+        from datafusion_tpu import qos
+        from datafusion_tpu.obs.attribution import current_scope
+
+        return qos.scope_client(current_scope())
+
+    def earn(self, client: "str | None" = None) -> None:
+        """One first attempt: accrue `ratio` tokens (capped) — in the
+        global bucket and, under QoS, the tenant's child."""
         self._bucket.earn()
+        if self._tenants is not None:
+            client = self._resolve_client(client)
+            if client is not None:
+                self._tenants.earn(client)
         METRICS.add("retry.first_attempts")
 
-    def spend(self) -> bool:
+    def spend(self, client: "str | None" = None) -> bool:
         """One retry wants to happen: True = granted (token consumed),
         False = denied, fail now instead of amplifying the storm."""
+        if self._tenants is not None:
+            client = self._resolve_client(client)
+            if client is not None:
+                if not self._tenants.spend(client):
+                    # the tenant's own isolation budget is exhausted:
+                    # deny WITHOUT consulting (or draining) the global
+                    # bucket — that is the isolation contract
+                    METRICS.add("retry.budget_denied")
+                    METRICS.add("retry.tenant_denied")
+                    from datafusion_tpu.obs.attribution import METER
+                    from datafusion_tpu.obs.recorder import record
+
+                    METER.charge(client, "retry_denied", 1.0)
+                    record("retry.tenant_denied", client=client)
+                    return False
+                if not self._bucket.spend():
+                    # global denial: the child token was never acted on
+                    self._tenants.refund(client)
+                    METRICS.add("retry.budget_denied")
+                    return False
+                METRICS.add("retry.budget_spent")
+                return True
         if not self._bucket.spend():
             METRICS.add("retry.budget_denied")
             return False
@@ -173,6 +229,12 @@ class RetryBudget:
     @property
     def tokens(self) -> float:
         return self._bucket.tokens
+
+    def tenant_tokens(self, client: str) -> "float | None":
+        """`client`'s child-bucket balance (None when QoS is off)."""
+        if self._tenants is None:
+            return None
+        return self._tenants.tokens(client)
 
 
 def _budget_from_env() -> "RetryBudget | None":

@@ -57,6 +57,9 @@ python scripts/crash_smoke.py
 echo "== serve smoke (closed-loop concurrent clients: admission control, pinned-table H2D skip, megabatched launches, 3x throughput gate) =="
 python scripts/serve_smoke.py
 
+echo "== qos smoke (multi-tenant overload: weighted fair-share admission, noisy-neighbor p99 isolation, quota sheds, byte-identical FIFO with QoS off) =="
+python scripts/qos_smoke.py
+
 echo "== ingest smoke (streaming appends: kill -9 mid-append + ingest-log recovery, 30% seeded wal fsync faults, live view subscription) =="
 python scripts/ingest_smoke.py
 

@@ -1,0 +1,452 @@
+"""Multi-tenant QoS (datafusion_tpu/qos): weighted fair-share
+admission, per-tenant isolation budgets, pin-aware placement, and the
+elastic-capacity hint.
+
+The overload contract under test:
+- weighted fair drain: a share-3 tenant advances 3 queries per
+  share-1 query while both have backlog; deadline urgency reorders
+  only WITHIN a tenant, never across the fair queue;
+- shed-over-quota: at queue-full the tenant furthest over its share
+  pays — its newest / least-urgent queued ticket sheds with the
+  dedicated ``quota`` reason, and conservation
+  (admitted + shed == submitted) still holds;
+- isolation budgets: a tenant that exhausted its own retry/hedge
+  child bucket is denied WITHOUT the global bucket being consulted
+  or drained;
+- pin-aware placement: queries route to advertised pin-holders, and
+  a saturated holder set replicates onto spare capacity;
+- default-off: with ``DATAFUSION_TPU_QOS`` unset and no shares, the
+  admission path drains byte-identical FIFO (A/B asserted).
+"""
+
+from __future__ import annotations
+
+import os
+import types
+
+import pytest
+
+from datafusion_tpu import qos
+from datafusion_tpu.obs import attribution
+from datafusion_tpu.obs.attribution import METER
+from datafusion_tpu.utils.deadline import Deadline
+from datafusion_tpu.utils.hedge import HedgeTracker
+from datafusion_tpu.utils.metrics import METRICS
+from datafusion_tpu.utils.retry import RetryBudget
+
+
+@pytest.fixture(autouse=True)
+def _clean_tenant_state():
+    """Tests own the process-global meters and the QoS env knobs."""
+    prior = {
+        k: os.environ.pop(k, None)
+        for k in ("DATAFUSION_TPU_QOS", "DATAFUSION_TPU_QOS_SHARES",
+                  "DATAFUSION_TPU_HBM_BYTES")
+    }
+    attribution.reset_for_tests()
+    yield
+    attribution.reset_for_tests()
+    for k, v in prior.items():
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+
+
+class _T:
+    """A ticket stub: exactly the attributes the policy reads."""
+
+    def __init__(self, cid: str, seq: float, deadline=None):
+        self.client_id = cid
+        self.deadline = deadline
+        self.entry_mono = float(seq)
+
+
+def _clients(tickets) -> list:
+    return [t.client_id for t in tickets]
+
+
+class TestFairShareOrdering:
+    def test_weighted_drain_is_proportional(self):
+        """Shares a=3, b=1 with an alternating backlog must drain 3
+        of a's queries per b query — a,a,b,a,b,b, not strict priority
+        and not FIFO."""
+        pol = qos.FairSharePolicy({"a": 3.0, "b": 1.0})
+        backlog = [_T("a", 0), _T("b", 1), _T("a", 2),
+                   _T("b", 3), _T("a", 4), _T("b", 5)]
+        got = pol.order(backlog, unit_cost_s=1.0, attained={})
+        assert _clients(got) == ["a", "a", "b", "a", "b", "b"]
+
+    def test_attained_service_pushes_tenant_back(self):
+        """Equal shares, but tenant b already consumed 10s of service:
+        a's whole backlog drains before b advances at all."""
+        pol = qos.FairSharePolicy({"a": 1.0, "b": 1.0})
+        backlog = [_T("b", 0), _T("a", 1), _T("b", 2), _T("a", 3)]
+        got = pol.order(backlog, unit_cost_s=0.001,
+                        attained={"a": 0.0, "b": 10.0})
+        assert _clients(got) == ["a", "a", "b", "b"]
+
+    def test_deadline_urgency_reorders_within_tenant_only(self):
+        """A tight deadline moves a query ahead of its OWN tenant's
+        backlog, but cannot jump an over-quota tenant past the fair
+        queue."""
+        pol = qos.FairSharePolicy({"a": 1.0, "b": 1.0})
+        tight = _T("a", 2, deadline=Deadline.after(0.05))
+        loose = _T("a", 0, deadline=Deadline.after(10.0))
+        got = pol.order([loose, _T("a", 1), tight],
+                        unit_cost_s=1.0, attained={})
+        assert got[0] is tight
+        # cross-tenant: b is 10s over quota; its tight deadlines do
+        # NOT beat a's deadline-free backlog
+        got = pol.order(
+            [_T("b", 0, deadline=Deadline.after(0.01)),
+             _T("a", 1), _T("b", 2, deadline=Deadline.after(0.01))],
+            unit_cost_s=0.001, attained={"a": 0.0, "b": 10.0})
+        assert _clients(got) == ["a", "b", "b"]
+
+    def test_singleton_and_fifo_stability(self):
+        pol = qos.FairSharePolicy()
+        only = [_T("a", 0)]
+        assert pol.order(only, attained={}) == only
+        # equal shares, equal attained, no deadlines: arrival order
+        backlog = [_T(f"c{i}", i) for i in range(5)]
+        assert _clients(pol.order(backlog, attained={})) == \
+            [f"c{i}" for i in range(5)]
+
+
+class TestShedVictim:
+    def test_over_quota_tenants_newest_ticket_pays(self):
+        pol = qos.FairSharePolicy({"a": 1.0, "b": 1.0})
+        METER.charge("b", "device_seconds", 100.0)
+        b_old, b_new = _T("b", 1.0), _T("b", 2.0)
+        victim, incoming_is_victim = pol.shed_victim(
+            [b_old, _T("a", 0.5), b_new], incoming_client="a")
+        assert not incoming_is_victim
+        assert victim is b_new  # newest of the over-quota tenant
+
+    def test_incoming_over_quota_tenant_sheds_itself(self):
+        pol = qos.FairSharePolicy({"a": 1.0, "b": 1.0})
+        METER.charge("b", "device_seconds", 100.0)
+        victim, incoming_is_victim = pol.shed_victim(
+            [_T("a", 0.5)], incoming_client="b")
+        assert incoming_is_victim and victim is None
+
+    def test_least_urgent_sheds_first_within_tenant(self):
+        pol = qos.FairSharePolicy()
+        METER.charge("b", "device_seconds", 100.0)
+        urgent = _T("b", 2.0, deadline=Deadline.after(0.05))
+        lazy = _T("b", 1.0, deadline=Deadline.after(60.0))
+        victim, _ = pol.shed_victim([urgent, lazy], incoming_client="a")
+        assert victim is lazy
+
+
+class TestTenantBuckets:
+    def test_child_denial_never_drains_global(self):
+        """Shares a=1, b=7 over parent burst 8: a's child holds
+        exactly one token.  Its second spend is denied by the CHILD
+        while the global reserve is untouched — and b still spends."""
+        tb = qos.TenantBuckets(1.0, 8.0, {"a": 1.0, "b": 7.0})
+        budget = RetryBudget(1.0, 8.0, tenant_buckets=tb)
+        for _ in range(5):
+            budget.earn(client="a")  # global 1+5 -> 6; child a capped at 1
+        assert budget.spend(client="a") is True    # global 6 -> 5
+        assert budget.tenant_tokens("a") == 0.0
+        assert budget.spend(client="a") is False   # child empty: denied
+        assert budget.tokens == 5.0                # ... global untouched
+        assert budget.spend(client="b") is True    # b's own budget intact
+        assert METER.snapshot()["a"]["retry_denied"] == 1.0
+
+    def test_hedge_tenant_denial(self):
+        before = METRICS.counts.get("hedge.tenant_denied", 0)
+        tb = qos.TenantBuckets(0.25, 4.0, {"a": 1.0, "b": 1.0})
+        tracker = HedgeTracker(ratio=0.25, burst=4.0, tenant_buckets=tb)
+        assert tracker.try_hedge(client="a") is True   # the initial token
+        assert tracker.try_hedge(client="a") is False  # child exhausted
+        assert METRICS.counts.get("hedge.tenant_denied", 0) == before + 1
+        assert METER.snapshot()["a"]["hedge_denied"] == 1.0
+        # b's child is intact; after real traffic re-earns the GLOBAL
+        # reserve (a's denial never drained it), b still hedges
+        for _ in range(4):
+            tracker.observe_dispatch(client="b")
+        assert tracker.try_hedge(client="b") is True   # isolation held
+
+    def test_global_denial_refunds_child(self):
+        tb = qos.TenantBuckets(0.0, 4.0, {"a": 1.0})
+        # global bucket starts with its single initial token
+        budget = RetryBudget(0.0, 4.0, tenant_buckets=tb)
+        assert budget.spend(client="a") is True   # global 1 -> 0
+        budget.earn(client="a")                   # ratio 0: child refills? no
+        tb._bucket("a")._tokens = 1.0             # re-arm the child only
+        assert budget.spend(client="a") is False  # global empty
+        assert tb.tokens("a") == 1.0              # child token refunded
+
+    def test_overflow_fold_caps_cardinality(self):
+        tb = qos.TenantBuckets(1.0, 8.0)
+        before = METRICS.counts.get("qos.tenant_bucket_overflow", 0)
+        for i in range(qos._MAX_TENANT_BUCKETS + 3):
+            tb.earn(f"t{i}")
+        assert len(tb._buckets) <= qos._MAX_TENANT_BUCKETS + 1
+        assert METRICS.counts.get("qos.tenant_bucket_overflow", 0) > before
+        assert qos._OVERFLOW in tb._buckets
+
+    def test_off_by_default(self):
+        assert qos.tenant_buckets_from_env(0.25, 4.0) is None
+        assert qos.policy_from_config(None) is None
+        assert RetryBudget(0.25)._tenants is None
+
+
+class TestConfig:
+    def test_parse_shares(self):
+        assert qos.parse_shares("a=3, b=1") == {"a": 3.0, "b": 1.0}
+        assert qos.parse_shares("") == {}
+        assert qos.parse_shares(None) == {}
+        assert qos.parse_shares("solo") == {"solo": 1.0}  # bare = share 1
+        assert qos.parse_shares("x=notanum") == {}
+
+    def test_env_arming(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_QOS", "1")
+        monkeypatch.setenv("DATAFUSION_TPU_QOS_SHARES", "a=3,b=1")
+        pol = qos.policy_from_config()
+        assert pol is not None and pol.share("a") == 3.0
+        assert qos.tenant_buckets_from_env(0.25, 4.0) is not None
+
+    def test_explicit_shares_arm_without_env(self):
+        pol = qos.policy_from_config({"a": 2.0})
+        assert pol is not None and pol.share("a") == 2.0
+
+
+class TestScaleHint:
+    def test_truth_table(self):
+        assert qos.scale_hint(None, 0.9) == 0     # no evidence: hold
+        assert qos.scale_hint(1.2, 0.8) == 1      # burning + queue-bound
+        assert qos.scale_hint(1.2, 0.1) == 0      # burning, compute-bound
+        assert qos.scale_hint(0.05, 0.1) == -1    # idle everywhere
+        assert qos.scale_hint(0.5, 0.2) == 0      # steady
+
+    def test_max_burn_rate(self):
+        from datafusion_tpu.obs import slo
+
+        assert slo.max_burn_rate(rows=[]) is None
+        rows = [{"burn_rate": 0.2}, {"burn_rate": 1.7}, {}]
+        assert slo.max_burn_rate(rows=rows) == 1.7
+        if not slo.WATCHDOG.armed():
+            assert slo.max_burn_rate() is None  # unarmed: no evidence
+
+    def test_queue_wait_share(self):
+        assert attribution.queue_wait_share() == 0.0
+        attribution.EXPLAINER.observe(
+            1.0, {"queue_wait": 0.8, "launch_wall": 0.2})
+        share = attribution.queue_wait_share()
+        assert 0.7 < share <= 0.8
+
+    def test_debug_snapshot_shape(self):
+        doc = qos.debug_snapshot(qos.FairSharePolicy({"a": 2.0}))
+        assert doc["shares"] == {"a": 2.0}
+        assert set(doc["scale"]) == \
+            {"hint", "max_burn_rate", "queue_wait_share"}
+
+
+class _FakeWorker:
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+
+
+class _Frag:
+    def __init__(self, names):
+        self._names = names
+
+    def table_names(self):
+        return self._names
+
+
+def _placement(workers_info, frag, live):
+    """Drive `_pin_placement` with stub membership/fragments — the
+    decision logic needs only the view's workers dict."""
+    from datafusion_tpu.parallel.coordinator import DistributedContext
+
+    view = types.SimpleNamespace(workers=workers_info)
+    coord = types.SimpleNamespace(membership=view)
+    return DistributedContext._pin_placement(coord, frag, live)
+
+
+class TestPinPlacement:
+    def test_routes_to_pin_holder(self):
+        before = METRICS.counts.get("coord.pin_routed", 0)
+        w1, w2 = _FakeWorker("h1", 1), _FakeWorker("h2", 2)
+        info = {"h1:1": {"pins": ["table:other"]},
+                "h2:2": {"pins": ["table:t"],
+                         "hbm_headroom_bytes": 1 << 20}}
+        got = _placement(info, _Frag(["t"]), [w1, w2])
+        assert got is w2
+        assert METRICS.counts.get("coord.pin_routed", 0) == before + 1
+
+    def test_saturated_holders_replicate_to_spare(self):
+        before = METRICS.counts.get("coord.pin_replicated", 0)
+        holder = _FakeWorker("h1", 1)
+        spare = _FakeWorker("h2", 2)
+        info = {"h1:1": {"pins": ["table:t"], "hbm_headroom_bytes": 0},
+                "h2:2": {"pins": [], "hbm_headroom_bytes": 1 << 20}}
+        got = _placement(info, _Frag(["t"]), [holder, spare])
+        assert got is spare
+        assert METRICS.counts.get("coord.pin_replicated", 0) == before + 1
+
+    def test_everyone_saturated_falls_back_to_holder(self):
+        holder = _FakeWorker("h1", 1)
+        spare = _FakeWorker("h2", 2)
+        info = {"h1:1": {"pins": ["table:t"], "hbm_headroom_bytes": 0},
+                "h2:2": {"pins": [], "hbm_headroom_bytes": 0}}
+        assert _placement(info, _Frag(["t"]), [holder, spare]) is holder
+
+    def test_no_holders_is_advisory_none(self):
+        w = _FakeWorker("h1", 1)
+        assert _placement({"h1:1": {"pins": []}}, _Frag(["t"]), [w]) is None
+        assert _placement({}, _Frag(["t"]), [w]) is None
+        assert _placement({"h1:1": {"pins": ["table:t"]}},
+                          _Frag([]), [w]) is None
+
+    def test_unknown_headroom_counts_as_headroom(self):
+        w = _FakeWorker("h1", 1)
+        assert _placement({"h1:1": {"pins": ["table:t"]}},
+                          _Frag(["t"]), [w]) is w
+
+
+class TestPinAdvertisement:
+    def _harness(self):
+        from datafusion_tpu.cluster import ClusterState, LocalClusterClient
+        from datafusion_tpu.cluster.agent import WorkerClusterAgent
+
+        class _WS:
+            batch_size = 4
+            fragment_cache = None
+            pins = ["table:hot"]
+
+            def pinned_fingerprints(self):
+                return list(self.pins)
+
+        client = LocalClusterClient(ClusterState())
+        ws = _WS()
+        agent = WorkerClusterAgent(client, "w:1", ws, ttl_s=30.0)
+        return client, ws, agent
+
+    def test_lease_value_untouched_when_off(self):
+        client, ws, agent = self._harness()
+        agent.poll_once()
+        info = client.membership()["workers"]["w:1"]
+        assert "pins" not in info
+
+    def test_pins_ride_lease_and_reput_on_change(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_QOS", "1")
+        client, ws, agent = self._harness()
+        agent.poll_once()
+        assert client.membership()["workers"]["w:1"]["pins"] == \
+            ["table:hot"]
+        before = METRICS.counts.get("worker.pins_readvertised", 0)
+        agent.poll_once()  # unchanged pin set: no re-put
+        assert METRICS.counts.get("worker.pins_readvertised", 0) == before
+        ws.pins = ["table:hot", "table:warm"]
+        agent.poll_once()  # changed: re-put within one heartbeat
+        assert METRICS.counts.get("worker.pins_readvertised", 0) == \
+            before + 1
+        assert client.membership()["workers"]["w:1"]["pins"] == \
+            ["table:hot", "table:warm"]
+
+    def test_cluster_gauge_counts_advertised_pins(self, monkeypatch):
+        monkeypatch.setenv("DATAFUSION_TPU_QOS", "1")
+        client, ws, agent = self._harness()
+        agent.poll_once()
+        assert client.state.gauges()["cluster.pins_advertised"] >= 1
+
+
+class TestServingIntegration:
+    """End-to-end over a real `Server` (CPU execution path)."""
+
+    def _ctx(self):
+        from tests.test_serve import _ctx, _table
+
+        return _ctx({"t": _table(7)})
+
+    def _record_order(self, ctx, order: list):
+        """Shadow `ctx.execute` on the instance: `_run_group` executes
+        tickets in drained-window order under each ticket's client
+        scope, so the recorded scopes ARE the admission drain order."""
+        orig = ctx.execute
+        depth = [0]  # execute() recurses into sub-plans: record top-level only
+
+        def recording(plan):
+            if depth[0] == 0:
+                order.append(attribution.current_client())
+            depth[0] += 1
+            try:
+                return orig(plan)
+            finally:
+                depth[0] -= 1
+
+        ctx.execute = recording
+
+    def test_fifo_byte_identical_when_off(self):
+        from tests.test_serve import _q
+
+        ctx = self._ctx()
+        # a skewed meter that WOULD reorder under QoS must not matter
+        METER.charge("c0", "device_seconds", 100.0)
+        order: list = []
+        self._record_order(ctx, order)
+        srv = ctx.serve(workers=1, window_s=0.25, megabatch_max=32)
+        try:
+            assert srv._qos is None
+            tickets = [srv.submit(_q("t", 0.3 + 0.01 * i),
+                                  client_id=f"c{i}") for i in range(6)]
+            for t in tickets:
+                t.result(timeout=60)
+        finally:
+            srv.stop()
+        assert order == [f"c{i}" for i in range(6)]  # pure arrival FIFO
+        assert srv.admitted + srv.shed == srv.submitted
+
+    def test_fair_drain_pushes_heavy_tenant_back(self):
+        from tests.test_serve import _q
+
+        ctx = self._ctx()
+        METER.charge("hog", "device_seconds", 100.0)
+        order: list = []
+        self._record_order(ctx, order)
+        srv = ctx.serve(workers=1, window_s=0.5, megabatch_max=32,
+                        shares={"hog": 1.0, "small": 1.0})
+        try:
+            assert srv._qos is not None
+            tickets = [srv.submit(_q("t", 0.3 + 0.01 * i),
+                                  client_id="hog" if i < 3 else "small")
+                       for i in range(6)]
+            for t in tickets:
+                t.result(timeout=60)
+        finally:
+            srv.stop()
+        # the attained-service-heavy tenant drains after the light one
+        assert order == ["small"] * 3 + ["hog"] * 3
+        assert "qos" in srv.stats()
+
+    def test_quota_shed_names_the_over_quota_tenant(self):
+        from datafusion_tpu.errors import QueryShedError
+        from tests.test_serve import _q
+
+        ctx = self._ctx()
+        METER.charge("b", "device_seconds", 100.0)
+        srv = ctx.serve(workers=1, queue_depth=2, window_s=0.75,
+                        megabatch_max=32,
+                        shares={"a": 1.0, "b": 1.0})
+        try:
+            t1 = srv.submit(_q("t", 0.3), client_id="b")
+            t2 = srv.submit(_q("t", 0.31), client_id="b")
+            # the queue is full; a's arrival evicts b's NEWEST ticket
+            # with the dedicated "quota" reason
+            t3 = srv.submit(_q("t", 0.32), client_id="a")
+            with pytest.raises(QueryShedError) as exc:
+                t2.result(timeout=60)
+            assert exc.value.reason == "quota"
+            t1.result(timeout=60)
+            t3.result(timeout=60)
+        finally:
+            srv.stop()
+        assert srv.admitted + srv.shed == srv.submitted
+        assert METER.snapshot()["b"]["shed_quota"] == 1.0
+        assert "shed_quota" not in METER.snapshot().get("a", {})
